@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Git merge-driver shim.
+
+Git invokes this once per conflicted file. Configure with the real
+pathname placeholder ``%P`` included::
+
+    git config merge.semmerge.driver \
+        "python3 scripts/semmerge-driver.py %O %A %B %P"
+
+``%O/%A/%B`` are *temporary* files git materializes (``.merge_file_*``)
+— only ``%P`` names the actual conflicted path, which is why the
+reference driver (reference ``scripts/semmerge-driver.py:46-49``),
+which computes the path by relpath-ing ``%A`` against the repo root,
+ends up copying the temp file onto itself and silently publishing
+"ours" as the merge result. This driver requires ``%P`` and copies the
+engine-resolved working-tree file onto ``%A``.
+
+The engine merges at repo scope, so the first file invocation runs the
+full CLI merge ``--inplace`` and records the merge in a latch file
+under ``.git/``; later invocations for the *same* merge skip straight
+to the copy-back. The reference's lock unlinks itself in a ``finally``
+as soon as the first invocation completes, so sequential per-file
+driver calls each re-run the full merge; here the latch persists for
+the duration of the merge (cleared by age or a different merge head),
+so the repo-level merge truly runs once.
+"""
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import time
+
+STALE_LOCK_SECONDS = 3600
+
+
+def run(cmd: list[str], cwd: str | None = None) -> str:
+    proc = subprocess.run(cmd, cwd=cwd, stdout=subprocess.PIPE,
+                          stderr=subprocess.PIPE, text=True)
+    if proc.returncode != 0:
+        if proc.stderr:
+            sys.stderr.write(proc.stderr)
+        sys.exit(proc.returncode)
+    return proc.stdout.strip()
+
+
+def incoming_head(repo_root: pathlib.Path) -> str | None:
+    """The rev being merged in: MERGE_HEAD for merges, REBASE_HEAD /
+    CHERRY_PICK_HEAD when the driver fires during rebase or
+    cherry-pick (git never sets a GITHEAD_REF env var)."""
+    for ref in ("MERGE_HEAD", "REBASE_HEAD", "CHERRY_PICK_HEAD"):
+        proc = subprocess.run(["git", "rev-parse", "--verify", "--quiet", ref],
+                              cwd=repo_root, stdout=subprocess.PIPE, text=True)
+        if proc.returncode == 0 and proc.stdout.strip():
+            return proc.stdout.strip()
+    return None
+
+
+def main() -> None:
+    if len(sys.argv) < 5:
+        sys.exit(
+            "semmerge-driver requires %O %A %B %P arguments — configure "
+            "merge.semmerge.driver with the %P placeholder"
+        )
+    _base_file, ours_file, _theirs_file, pathname = sys.argv[1:5]
+
+    repo_root = pathlib.Path(run(["git", "rev-parse", "--show-toplevel"]))
+    head = run(["git", "rev-parse", "HEAD"])
+    merge_head = incoming_head(repo_root)
+    if merge_head is None:
+        # No merge in progress that we understand: leave the file
+        # conflicted rather than guessing.
+        sys.exit(1)
+    base_commit = run(["git", "merge-base", "HEAD", merge_head])
+
+    lock = repo_root / ".git" / ".semmerge.lock"
+    lock.parent.mkdir(parents=True, exist_ok=True)
+    stale = lock.exists() and time.time() - lock.stat().st_mtime > STALE_LOCK_SECONDS
+    same_merge = (
+        lock.exists() and not stale
+        and lock.read_text().strip() == f"{head} {merge_head}"
+    )
+    if not same_merge:
+        lock.write_text(f"{head} {merge_head}")
+        try:
+            code = subprocess.run(
+                [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+                 base_commit, head, merge_head, "--inplace", "--git"],
+                cwd=repo_root,
+            ).returncode
+            if code != 0:
+                sys.exit(code)
+        except BaseException:
+            # A failed run must not latch; the next invocation retries.
+            lock.unlink(missing_ok=True)
+            raise
+
+    resolved = repo_root / pathname
+    if resolved.exists():
+        shutil.copyfile(resolved, ours_file)
+        sys.exit(0)
+    # The engine deleted/moved the file away; report conflict so git
+    # keeps the user in the loop rather than silently taking "ours".
+    sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""semantic_merge_tpu — a TPU-native semantic merge framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of the
+jimmc414/semantic_merge reference engine (see SURVEY.md): git-integrated
+three-way *semantic* merges of TypeScript repositories, where per-file
+AST indexing, symbol diffing, op-log lifting, composition, and CRDT
+ordering run as batched, sharded device programs instead of a per-file
+Node.js worker + sequential Python loops.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+- ``runtime/``  — host orchestration: git plumbing, notes, applier,
+  formatter/typecheck hooks, tracing (reference L7/L5/L1).
+- ``cli.py``    — the ``semmerge``/``semdiff`` orchestrator (reference L6).
+- ``core/``     — pure data contracts: Op/OpLog/Target/Conflict, the
+  deterministic id scheme, and string→integer encoding (reference L4 data).
+- ``ops/``      — device compute: batched diff joins, vectorized lift,
+  segmented-scan compose, sorted-CRDT reconciliation (reference L4 loops
+  + the L2 worker hot path, lifted onto the TPU).
+- ``frontend/`` — host-side TS/JS declaration scanner (Python + native
+  C++), replacing the Node worker's parse/index stage (reference L2).
+- ``backends/`` — the ``lang/`` plugin slot: ``ts_host`` is the CPU
+  parity oracle, ``ts_tpu`` is the device path (reference L3).
+- ``parallel/`` — mesh construction, shardings, collective joins.
+- ``models/``   — the DeclAligner similarity matcher (the P1 learned
+  matcher from the reference design docs) and its distributed trainer.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from .base import Backend, BuildAndDiffResult, get_backend, register_backend
+
+__all__ = ["Backend", "BuildAndDiffResult", "get_backend", "register_backend"]

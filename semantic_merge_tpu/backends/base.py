@@ -1,0 +1,73 @@
+"""Language-backend plugin interface.
+
+The reference reaches its language backend through a per-language bridge
+class speaking JSON-RPC to a Node child process (reference
+``semmerge/lang/ts/bridge.py:21-47``; stubs for Java/C# at
+``semmerge/lang/java/bridge.py`` and ``semmerge/lang/cs/bridge.py``).
+Here the same seam is an in-process registry: backends implement
+``build_and_diff`` / ``diff`` over snapshots and are selected by name
+via ``.semmerge.toml`` ``[engine] backend`` — the configuration hook the
+reference documents but never wires (reference ``semmerge/config.py``
+is dead code; the BASELINE north star makes it the backend selector).
+
+The data contract matches the reference worker protocol
+(reference ``workers/ts/src/protocol.ts:15-27``):
+``(base, left, right snapshots) → {opLogLeft, opLogRight, symbolMaps,
+diagnostics}``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Protocol
+
+from ..core.ops import Op
+from ..frontend.snapshot import Snapshot
+
+
+@dataclass
+class BuildAndDiffResult:
+    op_log_left: List[Op]
+    op_log_right: List[Op]
+    symbol_maps: Dict[str, List[dict]]
+    diagnostics: List[object] = field(default_factory=list)
+
+
+class Backend(Protocol):
+    name: str
+
+    def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
+                       *, base_rev: str = "base", seed: str = "0",
+                       timestamp: str | None = None) -> BuildAndDiffResult: ...
+
+    def diff(self, base: Snapshot, right: Snapshot,
+             *, base_rev: str = "base", seed: str = "0",
+             timestamp: str | None = None) -> List[Op]: ...
+
+    def close(self) -> None: ...
+
+
+_REGISTRY: Dict[str, Callable[[], Backend]] = {}
+
+
+def register_backend(name: str, factory: Callable[[], Backend]) -> None:
+    _REGISTRY[name] = factory
+
+
+def get_backend(name: str) -> Backend:
+    # Import side registers the built-in backends lazily so that the
+    # host-only path never pays a JAX import.
+    if name not in _REGISTRY:
+        try:
+            if name in ("host", "ts_host"):
+                from . import ts_host  # noqa: F401
+            elif name in ("tpu", "ts_tpu"):
+                from . import ts_tpu  # noqa: F401
+            elif name == "java":
+                from . import java  # noqa: F401
+            elif name in ("cs", "csharp"):
+                from . import cs  # noqa: F401
+        except ImportError as exc:
+            raise KeyError(f"Backend {name!r} failed to load: {exc}") from exc
+    if name not in _REGISTRY:
+        raise KeyError(f"Unknown backend {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]()

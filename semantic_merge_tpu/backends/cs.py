@@ -1,0 +1,21 @@
+"""C# backend stub (reference ``semmerge/lang/cs/bridge.py:4-8``)."""
+from __future__ import annotations
+
+from .base import register_backend
+
+
+class CSBackend:
+    name = "cs"
+
+    def build_and_diff(self, *args, **kwargs):
+        raise NotImplementedError("C# backend not implemented (P1)")
+
+    def diff(self, *args, **kwargs):
+        raise NotImplementedError("C# backend not implemented (P1)")
+
+    def close(self) -> None:
+        pass
+
+
+register_backend("cs", CSBackend)
+register_backend("csharp", CSBackend)

@@ -1,0 +1,20 @@
+"""Java backend stub (reference ``semmerge/lang/java/bridge.py:4-8``)."""
+from __future__ import annotations
+
+from .base import register_backend
+
+
+class JavaBackend:
+    name = "java"
+
+    def build_and_diff(self, *args, **kwargs):
+        raise NotImplementedError("Java backend not implemented (P1)")
+
+    def diff(self, *args, **kwargs):
+        raise NotImplementedError("Java backend not implemented (P1)")
+
+    def close(self) -> None:
+        pass
+
+
+register_backend("java", JavaBackend)

@@ -1,0 +1,62 @@
+"""Host (CPU) TypeScript backend — the parity oracle.
+
+Plays the role of the reference's Node.js worker behind the bridge
+(reference ``workers/ts/src/index.ts:16-44``): scan all three snapshot
+trees, diff left and right against base, lift to op logs, and report the
+per-revision ``symbolMaps`` of ``{symbolId, addressId}`` pairs. Pure
+Python end to end; the TPU backend is tested bit-for-bit against this
+implementation.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..core.difflift import diff_nodes, lift
+from ..core.ids import EPOCH_ISO
+from ..core.ops import Op
+from ..frontend.scanner import DeclNode, scan_snapshot
+from ..frontend.snapshot import Snapshot
+from .base import BuildAndDiffResult, register_backend
+
+
+class HostTSBackend:
+    name = "host"
+
+    def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
+                       *, base_rev: str = "base", seed: str = "0",
+                       timestamp: str | None = None) -> BuildAndDiffResult:
+        ts = timestamp or EPOCH_ISO
+        base_nodes = scan_snapshot(base.files)
+        left_nodes = scan_snapshot(left.files)
+        right_nodes = scan_snapshot(right.files)
+        diffs_l = diff_nodes(base_nodes, left_nodes)
+        diffs_r = diff_nodes(base_nodes, right_nodes)
+        return BuildAndDiffResult(
+            op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts),
+            op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts),
+            symbol_maps={
+                "base": _symbol_map(base_nodes),
+                "left": _symbol_map(left_nodes),
+                "right": _symbol_map(right_nodes),
+            },
+        )
+
+    def diff(self, base: Snapshot, right: Snapshot,
+             *, base_rev: str = "base", seed: str = "0",
+             timestamp: str | None = None) -> List[Op]:
+        ts = timestamp or EPOCH_ISO
+        base_nodes = scan_snapshot(base.files)
+        right_nodes = scan_snapshot(right.files)
+        return lift(base_rev, diff_nodes(base_nodes, right_nodes),
+                    seed=seed + "/R", timestamp=ts)
+
+    def close(self) -> None:
+        pass
+
+
+def _symbol_map(nodes: List[DeclNode]) -> List[dict]:
+    return [{"symbolId": n.symbolId, "addressId": n.addressId} for n in nodes]
+
+
+register_backend("host", HostTSBackend)
+register_backend("ts_host", HostTSBackend)

@@ -1,0 +1,247 @@
+"""Command-line orchestrator.
+
+The reference CLI surface (reference ``semmerge/__main__.py:28-88``)
+with the same observable contract:
+
+- ``semdiff REV1 REV2 [--json-out]`` — print the op log between two
+  revisions (pretty lines or JSON).
+- ``semmerge BASE A B [--inplace] [--git]`` — full 3-way semantic merge.
+  Exit codes: 0 merged; 1 conflicts (written to
+  ``.semmerge-conflicts.json``); 2 type errors (diagnostics on stderr).
+
+Additions over the reference: ``--backend`` / ``--trace`` / ``--seed``
+flags, config actually loaded (backend + seed + formatter resolved from
+``.semmerge.toml``), deterministic provenance (commit timestamps), and
+``semrebase`` replay of a stored op log onto a new base.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import shutil
+import subprocess
+import sys
+from typing import Iterable, List, Sequence
+
+from .backends.base import get_backend
+from .config import load_config
+from .core.compose import compose_oplogs
+from .core.ops import OpLog
+from .runtime.applier import apply_ops
+from .runtime.emitter import emit_files
+from .runtime.git import commit_timestamp_iso, resolve_rev, snapshot_rev
+from .runtime.notes import notes_get, notes_put
+from .runtime.trace import Tracer
+from .runtime.verify import typecheck_ts
+from .utils.loggingx import logger
+
+CONFLICTS_ARTIFACT = ".semmerge-conflicts.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="semmerge", description="TPU-native semantic merge engine")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_diff = sub.add_parser("semdiff", help="Semantic diff: print op log between two revisions")
+    p_diff.add_argument("rev1")
+    p_diff.add_argument("rev2")
+    p_diff.add_argument("--json-out", action="store_true", help="Emit JSON instead of a pretty listing")
+    p_diff.add_argument("--backend", default=None, help="Language backend (host|tpu)")
+    p_diff.add_argument("--trace", action="store_true", help="Write .semmerge-trace.json")
+
+    p_merge = sub.add_parser("semmerge", help="Semantic merge base A B into working tree")
+    p_merge.add_argument("base")
+    p_merge.add_argument("a")
+    p_merge.add_argument("b")
+    p_merge.add_argument("--inplace", action="store_true",
+                         help="Write the merge result into the current working tree")
+    p_merge.add_argument("--git", action="store_true",
+                         help="Flag set when invoked via git merge driver")
+    p_merge.add_argument("--backend", default=None, help="Language backend (host|tpu)")
+    p_merge.add_argument("--trace", action="store_true", help="Write .semmerge-trace.json")
+    p_merge.add_argument("--seed", default=None, help="Deterministic id seed override")
+
+    p_rebase = sub.add_parser("semrebase", help="Replay a commit's stored op log onto a revision")
+    p_rebase.add_argument("commit", help="Commit whose semmerge note holds the op log")
+    p_rebase.add_argument("onto", help="Revision to replay onto")
+    p_rebase.add_argument("--inplace", action="store_true")
+    return parser
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.command == "semdiff":
+            return cmd_semdiff(args)
+        if args.command == "semmerge":
+            return cmd_semmerge(args)
+        if args.command == "semrebase":
+            return cmd_semrebase(args)
+    except subprocess.CalledProcessError as exc:
+        cmd = exc.cmd if isinstance(exc.cmd, str) else " ".join(map(str, exc.cmd))
+        print(f"error: subprocess failed ({cmd}): exit {exc.returncode}", file=sys.stderr)
+        return 3
+    return 2
+
+
+def _resolve_backend(name_flag: str | None):
+    config = load_config()
+    name = name_flag or config.engine.backend
+    try:
+        return get_backend(name), config
+    except Exception as exc:  # TPU backend unavailable → host fallback
+        if name != "host":
+            logger.warning("Backend %r unavailable (%s); falling back to host", name, exc)
+            return get_backend("host"), config
+        raise
+
+
+def cmd_semdiff(args: argparse.Namespace) -> int:
+    tracer = Tracer(enabled=args.trace)
+    backend, _config = _resolve_backend(args.backend)
+    try:
+        with tracer.phase("snapshot"):
+            base_snap = snapshot_rev(args.rev1)
+            right_snap = snapshot_rev(args.rev2)
+        with tracer.phase("diff"):
+            ops = backend.diff(base_snap, right_snap,
+                               base_rev=resolve_rev(args.rev1),
+                               timestamp=commit_timestamp_iso(args.rev2))
+    finally:
+        backend.close()
+    if args.json_out:
+        print(json.dumps([op.to_dict() for op in ops], indent=2))
+    else:
+        for op in ops:
+            print(op.pretty())
+    tracer.write()
+    return 0
+
+
+def cmd_semmerge(args: argparse.Namespace) -> int:
+    logger.info("Starting semantic merge base=%s A=%s B=%s", args.base, args.a, args.b)
+    tracer = Tracer(enabled=args.trace)
+    backend, config = _resolve_backend(args.backend)
+    merged_tree: pathlib.Path | None = None
+    try:
+        with tracer.phase("snapshot"):
+            from .runtime.git import archive_bytes, snapshot_from_bytes
+            base_tar = archive_bytes(args.base)
+            base_snap = snapshot_from_bytes(base_tar)
+            left_snap = snapshot_rev(args.a)
+            right_snap = snapshot_rev(args.b)
+        base_rev = resolve_rev(args.base)
+        seed = args.seed or config.core.deterministic_seed
+        if seed == "auto":
+            seed = base_rev
+        timestamp = commit_timestamp_iso(args.base)
+
+        with tracer.phase("build_and_diff", backend=backend.name):
+            result = backend.build_and_diff(
+                base_snap, left_snap, right_snap,
+                base_rev=base_rev, seed=seed, timestamp=timestamp,
+            )
+        tracer.count("ops_left", len(result.op_log_left))
+        tracer.count("ops_right", len(result.op_log_right))
+
+        with tracer.phase("compose"):
+            composed, conflicts = compose_oplogs(result.op_log_left, result.op_log_right)
+        tracer.count("composed_ops", len(composed))
+        tracer.count("conflicts", len(conflicts))
+
+        if conflicts:
+            _write_conflict_reports(conflicts)
+            tracer.write()
+            return 1
+        # A clean merge must not leave a stale artifact from a previous
+        # conflicted run next to a success exit code.
+        pathlib.Path(CONFLICTS_ARTIFACT).unlink(missing_ok=True)
+
+        with tracer.phase("materialize"):
+            from .runtime.git import extract_tree_to_temp
+            base_tree = extract_tree_to_temp(base_tar)
+            try:
+                merged_tree = apply_ops(base_tree, composed)
+            finally:
+                _cleanup([base_tree])
+        with tracer.phase("format"):
+            formatter = None
+            ts_cfg = config.languages.get("typescript")
+            if ts_cfg and ts_cfg.formatter_cmd:
+                formatter = [*ts_cfg.formatter_cmd, "."]
+            emit_files(merged_tree, formatter)
+        with tracer.phase("typecheck"):
+            if config.ci.require_typecheck:
+                ok, diagnostics = typecheck_ts(merged_tree)
+            else:
+                ok, diagnostics = True, []
+        if not ok:
+            for line in diagnostics:
+                print(line, file=sys.stderr)
+            tracer.write()
+            return 2
+
+        if args.inplace:
+            _copy_tree_into_cwd(merged_tree)
+
+        with tracer.phase("notes"):
+            notes_put(resolve_rev(args.a), OpLog(result.op_log_left))
+            notes_put(resolve_rev(args.b), OpLog(result.op_log_right))
+        logger.info("Merge complete")
+        tracer.write()
+        return 0
+    finally:
+        backend.close()
+        if merged_tree is not None:
+            _cleanup([merged_tree])
+
+
+def cmd_semrebase(args: argparse.Namespace) -> int:
+    """Replay the op log stored on *commit* onto *onto* — the [SPEC]
+    ``semrebase`` flow (reference ``requirements.md:119-124``), made real
+    by the readable notes store."""
+    oplog = notes_get(resolve_rev(args.commit))
+    if oplog is None:
+        print(f"No semmerge op log stored for {args.commit}", file=sys.stderr)
+        return 1
+    from .runtime.git import checkout_tree_to_temp
+    base_tree = checkout_tree_to_temp(args.onto)
+    try:
+        merged = apply_ops(base_tree, list(oplog))
+        emit_files(merged)
+        if args.inplace:
+            _copy_tree_into_cwd(merged)
+            _cleanup([merged])
+        else:
+            print(str(merged))
+    finally:
+        _cleanup([base_tree])
+    return 0
+
+
+def _copy_tree_into_cwd(tmp_path: pathlib.Path) -> None:
+    tmp_path = pathlib.Path(tmp_path)
+    cwd = pathlib.Path.cwd()
+    for path in tmp_path.rglob("*"):
+        if path.is_file():
+            target = cwd / path.relative_to(tmp_path)
+            target.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy2(path, target)
+
+
+def _write_conflict_reports(conflicts: Sequence[object]) -> None:
+    payload = [c.to_dict() if hasattr(c, "to_dict") else c for c in conflicts]
+    pathlib.Path(CONFLICTS_ARTIFACT).write_text(json.dumps(payload, indent=2), encoding="utf-8")
+
+
+def _cleanup(paths: Iterable[pathlib.Path]) -> None:
+    for path in paths:
+        try:
+            shutil.rmtree(path)
+        except (FileNotFoundError, OSError):
+            pass
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

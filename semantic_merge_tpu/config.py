@@ -1,0 +1,133 @@
+"""``.semmerge.toml`` configuration — loaded and actually wired.
+
+The reference ships a complete TOML loader that the live CLI never calls
+(reference ``semmerge/config.py`` is dead code; the worker always
+receives ``config: {}``, reference ``semmerge/lang/ts/bridge.py:33``).
+Here the config is the real control surface: it selects the language
+backend (``tpu`` vs ``host``), fixes the deterministic seed, and carries
+the device-batching knobs.
+
+Schema (superset of the reference's documented schema at reference
+``implementation.md:86-106``):
+
+    [core]
+    deterministic_seed = "auto"   # "auto" => derived from the base rev
+    memory_cap_mb = 4096
+    formatter = "prettier"
+
+    [engine]                       # new: TPU execution knobs
+    backend = "tpu"                # "tpu" | "host"
+    parity_mode = true             # reproduce reference quirks bit-for-bit
+    max_nodes_per_bucket = 2048    # padding bucket sizes, powers of two
+    mesh_shape = "auto"            # or e.g. "dp=4,tp=2"
+
+    [languages.typescript]
+    enabled = true
+    project_globs = ["**/tsconfig.json"]
+    formatter_cmd = ["npx", "prettier", "--write"]
+
+    [ci]
+    require_typecheck = true
+    require_tests = false
+"""
+from __future__ import annotations
+
+import pathlib
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+
+@dataclass
+class CoreConfig:
+    deterministic_seed: str = "auto"
+    memory_cap_mb: int = 4096
+    formatter: str | None = None
+
+
+@dataclass
+class EngineConfig:
+    backend: str = "tpu"
+    parity_mode: bool = True
+    max_nodes_per_bucket: int = 2048
+    mesh_shape: str = "auto"
+
+
+@dataclass
+class LanguageConfig:
+    enabled: bool = False
+    project_globs: List[str] = field(default_factory=list)
+    formatter_cmd: List[str] | None = None
+
+
+@dataclass
+class CiConfig:
+    require_typecheck: bool = True
+    require_tests: bool = False
+
+
+@dataclass
+class Config:
+    root: pathlib.Path
+    core: CoreConfig = field(default_factory=CoreConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    languages: Dict[str, LanguageConfig] = field(default_factory=dict)
+    ci: CiConfig = field(default_factory=CiConfig)
+
+
+def find_config_file(start: pathlib.Path) -> pathlib.Path | None:
+    """Search ``start`` and its parents for ``.semmerge.toml``
+    (upward search per reference ``semmerge/config.py:98-105``)."""
+    for directory in [start, *start.parents]:
+        candidate = directory / ".semmerge.toml"
+        if candidate.is_file():
+            return candidate
+    return None
+
+
+def load_config(start: pathlib.Path | None = None) -> Config:
+    start = pathlib.Path(start) if start is not None else pathlib.Path.cwd()
+    cfg_path = find_config_file(start)
+    config = Config(root=cfg_path.parent if cfg_path else start)
+    if cfg_path is None:
+        return config
+
+    with cfg_path.open("rb") as fh:
+        data = tomllib.load(fh)
+
+    core = data.get("core", {})
+    config.core = CoreConfig(
+        deterministic_seed=str(core.get("deterministic_seed", config.core.deterministic_seed)),
+        memory_cap_mb=int(core.get("memory_cap_mb", config.core.memory_cap_mb)),
+        formatter=core.get("formatter", config.core.formatter),
+    )
+
+    engine = data.get("engine", {})
+    config.engine = EngineConfig(
+        backend=str(engine.get("backend", config.engine.backend)),
+        parity_mode=bool(engine.get("parity_mode", config.engine.parity_mode)),
+        max_nodes_per_bucket=int(
+            engine.get("max_nodes_per_bucket", config.engine.max_nodes_per_bucket)
+        ),
+        mesh_shape=str(engine.get("mesh_shape", config.engine.mesh_shape)),
+    )
+
+    for lang, ldata in data.get("languages", {}).items():
+        config.languages[lang] = LanguageConfig(
+            enabled=bool(ldata.get("enabled", False)),
+            project_globs=[str(g) for g in _as_list(ldata.get("project_globs", []))],
+            formatter_cmd=[str(c) for c in _as_list(ldata.get("formatter_cmd", []))] or None,
+        )
+
+    ci = data.get("ci", {})
+    config.ci = CiConfig(
+        require_typecheck=bool(ci.get("require_typecheck", config.ci.require_typecheck)),
+        require_tests=bool(ci.get("require_tests", config.ci.require_tests)),
+    )
+    return config
+
+
+def _as_list(value: Any) -> List[Any]:
+    if isinstance(value, (list, tuple)):
+        return [v for v in value if v is not None]
+    return [value] if value else []

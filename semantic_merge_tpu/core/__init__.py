@@ -1,0 +1,13 @@
+from .ops import Op, OpLog, OpType, Target, OP_TYPES, OP_PRECEDENCE
+from .conflict import Conflict, divergent_rename_conflict
+
+__all__ = [
+    "Op",
+    "OpLog",
+    "OpType",
+    "Target",
+    "OP_TYPES",
+    "OP_PRECEDENCE",
+    "Conflict",
+    "divergent_rename_conflict",
+]

@@ -1,0 +1,122 @@
+"""Host (oracle) implementation of op-log composition.
+
+Deterministic two-way composition of the op logs of branches A and B.
+Observable semantics are bit-for-bit those of the reference composer
+(reference ``semmerge/compose.py:11-114``), which the device
+implementation (:mod:`semantic_merge_tpu.ops.compose`) must match:
+
+- Each log is sorted by ``(type precedence, provenance.timestamp, id)``
+  and the two sorted streams are merged two-pointer style, ties taken
+  from A.
+- A *DivergentRename* conflict is detected **only head-vs-head**: when
+  the current heads of both streams are ``renameSymbol`` ops on the same
+  symbol with different new names, a conflict is emitted and *both* ops
+  are dropped (no chain updates, nothing materialized). Interleaved
+  unrelated ops can mask a divergent rename — a reference quirk kept in
+  parity mode.
+- ``renameSymbol`` records ``symbolId → newName`` in the rename chain;
+  ``moveDecl`` merges ``newAddress`` / ``newFile`` (falling back to
+  ``params["file"]``) per symbol into the move chain.
+- Materialization clones the op, then: retargets ``target.addressId``
+  to the chained ``newAddress``; rewrites a ``moveDecl``'s own params to
+  the chained destination; rewrites a ``renameSymbol``'s ``file`` (and
+  ``newFile``) to the chained file; and tags non-rename ops on renamed
+  symbols with ``renameContext``. The current op's own chain
+  contribution is visible to itself (a move sees its own destination).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Tuple
+
+from .conflict import Conflict, divergent_rename_conflict
+from .ops import Op, Target
+
+
+def compose_oplogs(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List[Conflict]]:
+    ops_a = sorted(delta_a, key=Op.sort_key)
+    ops_b = sorted(delta_b, key=Op.sort_key)
+
+    out: List[Op] = []
+    conflicts: List[Conflict] = []
+    rename_chain: Dict[str, str] = {}
+    move_chain: Dict[str, Dict[str, str]] = {}
+
+    ia = ib = 0
+    while ia < len(ops_a) or ib < len(ops_b):
+        a_head = ops_a[ia] if ia < len(ops_a) else None
+        b_head = ops_b[ib] if ib < len(ops_b) else None
+        take_a = a_head is not None and (
+            b_head is None or a_head.sort_key() <= b_head.sort_key()
+        )
+        op = a_head if take_a else b_head
+        other = b_head if take_a else a_head
+        assert op is not None
+
+        if (
+            op.type == "renameSymbol"
+            and other is not None
+            and other.type == "renameSymbol"
+            and op.target.symbolId == other.target.symbolId
+            and op.params.get("newName") != other.params.get("newName")
+        ):
+            # Conflict record always lists A's op as opA, regardless of
+            # which side's head was consumed first (reference
+            # semmerge/compose.py:67,95 passes op_a first in both arms).
+            conflicts.append(divergent_rename_conflict(a_head, b_head))
+            ia += 1
+            ib += 1
+            continue
+
+        if op.type == "renameSymbol":
+            rename_chain[op.target.symbolId] = str(op.params.get("newName"))
+        elif op.type == "moveDecl":
+            entry = dict(move_chain.get(op.target.symbolId, {}))
+            new_addr = op.params.get("newAddress")
+            new_file = op.params.get("newFile") or op.params.get("file")
+            if new_addr is not None:
+                entry["newAddress"] = str(new_addr)
+            if new_file is not None:
+                entry["newFile"] = str(new_file)
+            if entry:
+                move_chain[op.target.symbolId] = entry
+
+        out.append(_materialize(op, rename_chain, move_chain))
+        if take_a:
+            ia += 1
+        else:
+            ib += 1
+
+    return out, conflicts
+
+
+def _materialize(op: Op, rename_chain: Dict[str, str],
+                 move_chain: Dict[str, Dict[str, str]]) -> Op:
+    cloned = Op(
+        id=op.id,
+        schemaVersion=op.schemaVersion,
+        type=op.type,
+        target=Target(symbolId=op.target.symbolId, addressId=op.target.addressId),
+        params=copy.deepcopy(op.params),
+        guards=copy.deepcopy(op.guards),
+        effects=copy.deepcopy(op.effects),
+        provenance=copy.deepcopy(op.provenance),
+    )
+    sym = cloned.target.symbolId
+    moved = move_chain.get(sym)
+    if moved is not None:
+        new_addr = moved.get("newAddress")
+        new_file = moved.get("newFile")
+        if cloned.type == "moveDecl":
+            if new_addr is not None:
+                cloned.params["newAddress"] = new_addr
+            if new_file is not None:
+                cloned.params["newFile"] = new_file
+        if new_addr is not None:
+            cloned.target = Target(symbolId=sym, addressId=new_addr)
+        if cloned.type == "renameSymbol" and new_file is not None:
+            cloned.params["newFile"] = new_file
+            cloned.params["file"] = new_file
+    if sym in rename_chain and cloned.type != "renameSymbol":
+        cloned.params = {**cloned.params, "renameContext": rename_chain[sym]}
+    return cloned

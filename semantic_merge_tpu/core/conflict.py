@@ -1,0 +1,80 @@
+"""Conflict data model.
+
+JSON-shape parity with the reference conflict record (reference
+``semmerge/conflict.py:10-49``), which the CLI persists as
+``.semmerge-conflicts.json``. The factory reproduces the reference's
+observable construction exactly: id ``conf-<a8>-<b8>``, empty minimal
+slice, and keepA/keepB suggestions.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List
+
+from .ops import Op
+
+
+@dataclass
+class Conflict:
+    id: str
+    category: str
+    symbolId: str
+    addressIds: Dict[str, Any]
+    opA: Dict[str, Any]
+    opB: Dict[str, Any]
+    minimalSlice: Dict[str, Any]
+    suggestions: List[Dict[str, Any]]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "category": self.category,
+            "symbolId": self.symbolId,
+            "addressIds": self.addressIds,
+            "opA": self.opA,
+            "opB": self.opB,
+            "minimalSlice": self.minimalSlice,
+            "suggestions": self.suggestions,
+        }
+
+
+def divergent_rename_conflict(op_a: Op, op_b: Op) -> Conflict:
+    """Two sides renamed the same symbol to different names
+    (reference ``semmerge/conflict.py:34-49``)."""
+    return Conflict(
+        id=f"conf-{op_a.id[:8]}-{op_b.id[:8]}",
+        category="DivergentRename",
+        symbolId=op_a.target.symbolId,
+        addressIds={"A": op_a.target.addressId, "B": op_b.target.addressId, "base": None},
+        opA=op_a.to_dict(),
+        opB=op_b.to_dict(),
+        minimalSlice={"path": "", "start": 0, "end": 0, "code": ""},
+        suggestions=[
+            {"id": "keepA", "label": f"Rename to {op_a.params.get('newName')}", "ops": [op_a.id]},
+            {"id": "keepB", "label": f"Rename to {op_b.params.get('newName')}", "ops": [op_b.id]},
+        ],
+    )
+
+
+def delete_vs_edit_conflict(op_del: Op, op_edit: Op, delete_side: str) -> Conflict:
+    """One side deleted a declaration the other side edited.
+
+    This conflict category is specified but unimplemented in the reference
+    (reference ``requirements.md:93-99``); the record shape follows the
+    reference's Conflict schema so tooling reads both categories uniformly.
+    ``delete_side`` is ``"A"`` or ``"B"`` — which branch performed the delete.
+    """
+    op_a, op_b = (op_del, op_edit) if delete_side == "A" else (op_edit, op_del)
+    return Conflict(
+        id=f"conf-{op_a.id[:8]}-{op_b.id[:8]}",
+        category="DeleteVsEdit",
+        symbolId=op_del.target.symbolId,
+        addressIds={"A": op_a.target.addressId, "B": op_b.target.addressId, "base": None},
+        opA=op_a.to_dict(),
+        opB=op_b.to_dict(),
+        minimalSlice={"path": "", "start": 0, "end": 0, "code": ""},
+        suggestions=[
+            {"id": "keepDelete", "label": "Keep the deletion", "ops": [op_del.id]},
+            {"id": "keepEdit", "label": "Keep the edit", "ops": [op_edit.id]},
+        ],
+    )

@@ -1,0 +1,126 @@
+"""Declaration diffing and op lifting — host (oracle) implementation.
+
+Reproduces the reference worker's diff/lift stage exactly
+(reference ``workers/ts/src/diff.ts:5-31`` and
+``workers/ts/src/lift.ts:11-66``), with the nondeterministic identity
+fields (uuid4 ids, wall-clock timestamps) replaced by the seeded scheme
+from :mod:`semantic_merge_tpu.core.ids`.
+
+Diff semantics (parity-critical quirks included):
+
+- Both node lists collapse into symbolId-keyed maps with JS ``Map``
+  semantics: iteration follows *first* insertion order, but a duplicate
+  symbolId keeps the *last* node (coarse signatures like ``class{2}``
+  collide by design; reference ``implementation.md:1309`` acknowledges
+  last-wins).
+- Per base symbol, in map order: absent on the side → ``delete``;
+  differing addressId → ``move``; differing non-null names → ``rename``
+  (a symbol can emit both move and rename).
+- Per side *list* entry (not map — duplicates emit repeatedly): symbolId
+  absent in base → ``add``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+from ..frontend.scanner import DeclNode
+from .ids import EPOCH_ISO, deterministic_op_id
+from .ops import Op, Target
+
+
+@dataclass
+class Diff:
+    kind: str  # "rename" | "move" | "add" | "delete" | "changeSig"
+    a: DeclNode | None = None
+    b: DeclNode | None = None
+
+
+def diff_nodes(base: List[DeclNode], side: List[DeclNode]) -> List[Diff]:
+    base_map: Dict[str, DeclNode] = {}
+    for n in base:
+        base_map[n.symbolId] = n  # dict: first-insert order, last value wins
+    side_map: Dict[str, DeclNode] = {}
+    for n in side:
+        side_map[n.symbolId] = n
+
+    diffs: List[Diff] = []
+    for sid, bnode in base_map.items():
+        snode = side_map.get(sid)
+        if snode is None:
+            diffs.append(Diff("delete", a=bnode))
+            continue
+        if bnode.addressId != snode.addressId:
+            diffs.append(Diff("move", a=bnode, b=snode))
+        if bnode.name and snode.name and bnode.name != snode.name:
+            diffs.append(Diff("rename", a=bnode, b=snode))
+    for snode in side:
+        if snode.symbolId not in base_map:
+            diffs.append(Diff("add", b=snode))
+    return diffs
+
+
+def lift(base_rev: str, diffs: List[Diff], *, seed: str = "0",
+         timestamp: str = EPOCH_ISO) -> List[Op]:
+    """Diff records → Op records.
+
+    Op ids are deterministic: a function of the seed, the diff content,
+    and the diff's position in the stream — the same inputs yield
+    bit-identical op logs from any backend.
+    """
+    ops: List[Op] = []
+    for idx, d in enumerate(diffs):
+        prov = {"rev": base_rev, "timestamp": timestamp}
+        if d.kind == "rename" and d.a and d.b:
+            ops.append(Op.new(
+                "renameSymbol",
+                Target(symbolId=d.a.symbolId, addressId=d.a.addressId),
+                params={"oldName": d.a.name, "newName": d.b.name, "file": d.b.file},
+                guards={"exists": True, "addressMatch": d.a.addressId},
+                effects={"summary": f"rename {d.a.name}→{d.b.name}"},
+                provenance=prov,
+                op_id=_op_id(seed, base_rev, idx, "renameSymbol", d),
+            ))
+        elif d.kind == "move" and d.a and d.b:
+            ops.append(Op.new(
+                "moveDecl",
+                Target(symbolId=d.a.symbolId, addressId=d.a.addressId),
+                params={
+                    "oldAddress": d.a.addressId,
+                    "newAddress": d.b.addressId,
+                    "oldFile": d.a.file,
+                    "newFile": d.b.file,
+                },
+                guards={"exists": True, "addressMatch": d.a.addressId},
+                effects={"summary": f"move {d.a.addressId}→{d.b.addressId}"},
+                provenance=prov,
+                op_id=_op_id(seed, base_rev, idx, "moveDecl", d),
+            ))
+        elif d.kind == "add" and d.b:
+            ops.append(Op.new(
+                "addDecl",
+                Target(symbolId=d.b.symbolId, addressId=d.b.addressId),
+                params={"file": d.b.file},
+                guards={},
+                effects={"summary": "add decl"},
+                provenance=prov,
+                op_id=_op_id(seed, base_rev, idx, "addDecl", d),
+            ))
+        elif d.kind == "delete" and d.a:
+            ops.append(Op.new(
+                "deleteDecl",
+                Target(symbolId=d.a.symbolId, addressId=d.a.addressId),
+                params={"file": d.a.file},
+                guards={},
+                effects={"summary": "delete decl"},
+                provenance=prov,
+                op_id=_op_id(seed, base_rev, idx, "deleteDecl", d),
+            ))
+    return ops
+
+
+def _op_id(seed: str, rev: str, idx: int, op_type: str, d: Diff) -> str:
+    a_addr = d.a.addressId if d.a else ""
+    b_addr = d.b.addressId if d.b else ""
+    sym = (d.a or d.b).symbolId  # type: ignore[union-attr]
+    return deterministic_op_id(seed, rev, idx, op_type, sym, a_addr, b_addr)

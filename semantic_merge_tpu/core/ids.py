@@ -1,0 +1,55 @@
+"""Deterministic identity scheme.
+
+The reference extractor mints ``crypto.randomUUID()`` op ids and
+wall-clock ISO timestamps (reference ``workers/ts/src/lift.ts:5-9``),
+which makes its op logs nondeterministic and breaks its own
+byte-identical-output requirement (reference ``requirements.md:163``
+[NFR-DET-001]) — the compose sort key includes both fields (reference
+``semmerge/compose.py:16-18``).
+
+Here every id is a pure function of ``(seed, content, sequence number)``:
+
+- op ids are UUID-formatted hex derived from SHA-256, so they are
+  drop-in-compatible with consumers that slice them like UUIDs (the
+  conflict id uses ``op.id[:8]``, reference ``semmerge/conflict.py:38``);
+- timestamps are the source revision's commit time (or the epoch), not
+  wall clock.
+
+Any backend (host CPU oracle, TPU device path, a future native worker)
+that derives ops from the same inputs with the same seed produces
+bit-identical op logs — the parity property the BASELINE north star
+demands.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+EPOCH_ISO = "1970-01-01T00:00:00Z"
+
+
+def stable_hash_hex(*parts: Any, n_hex: int = 64) -> str:
+    """SHA-256 over the ``|``-joined string forms of *parts*."""
+    payload = "|".join(str(p) for p in parts)
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()[:n_hex]
+
+
+def deterministic_op_id(seed: str, *content: Any) -> str:
+    """A UUID-shaped (8-4-4-4-12) deterministic id."""
+    h = stable_hash_hex(seed, *content, n_hex=32)
+    return f"{h[0:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:32]}"
+
+
+def stable_hash64(*parts: Any) -> int:
+    """First 64 bits of the SHA-256, as a Python int in [0, 2**64)."""
+    return int(stable_hash_hex(*parts, n_hex=16), 16)
+
+
+def symbol_id_from_signature(sig: str) -> str:
+    """SymbolId = first 16 hex chars of sha256(structural signature).
+
+    Identical to the reference's scheme (reference
+    ``workers/ts/src/sast.ts:69-71,96``); exactly 64 bits, so device code
+    can carry symbol ids losslessly as int64 lanes.
+    """
+    return hashlib.sha256(sig.encode("utf-8")).hexdigest()[:16]

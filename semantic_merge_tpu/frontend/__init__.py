@@ -1,0 +1,4 @@
+from .scanner import DeclNode, scan_file, scan_snapshot
+from .snapshot import Snapshot, snapshot_tree
+
+__all__ = ["DeclNode", "scan_file", "scan_snapshot", "Snapshot", "snapshot_tree"]

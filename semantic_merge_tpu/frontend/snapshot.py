@@ -1,0 +1,37 @@
+"""Snapshot construction: a checked-out tree → in-memory file list.
+
+Mirrors the reference bridge's snapshot semantics (reference
+``semmerge/lang/ts/bridge.py:66-78``): every ``.ts/.tsx/.js/.jsx`` file
+under the tree, path as POSIX-relative, full contents in memory. File
+order is sorted for determinism (the reference relies on ``rglob``
+order, which is OS-dependent — a determinism bug this framework fixes;
+reference ``requirements.md:163`` [NFR-DET-001]).
+"""
+from __future__ import annotations
+
+import pathlib
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+TS_EXTENSIONS = {".ts", ".tsx", ".js", ".jsx"}
+
+
+@dataclass
+class Snapshot:
+    files: List[Dict[str, str]] = field(default_factory=list)
+    project: str | None = None
+
+    def to_dict(self) -> dict:
+        return {"files": self.files, "project": self.project}
+
+
+def snapshot_tree(root: pathlib.Path) -> Snapshot:
+    root = pathlib.Path(root)
+    files = []
+    for path in sorted(root.rglob("*")):
+        if path.is_file() and path.suffix in TS_EXTENSIONS:
+            files.append({
+                "path": path.relative_to(root).as_posix(),
+                "content": path.read_text(encoding="utf-8"),
+            })
+    return Snapshot(files=files)

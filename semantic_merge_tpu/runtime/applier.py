@@ -1,0 +1,157 @@
+"""Materialize composed ops onto a tree (reference ``semmerge/applier.py``).
+
+Applies a composed op list to a copy of the base tree. Implemented
+handlers (the reference's set): ``moveDecl`` moves the *whole file*
+old→new; ``renameSymbol`` rewrites word-boundary occurrences across the
+file; ``modifyImport`` is a literal replace; ``moveFile`` moves by
+old/new path. Everything else is logged and skipped (reference
+``semmerge/applier.py:30-31``). Additionally ``reorderImports`` is
+applied via the RGA CRDT ordering (wired in here; dead code in the
+reference, ``semmerge/crdt.py``).
+"""
+from __future__ import annotations
+
+import pathlib
+import re
+import shutil
+import tempfile
+from typing import Iterable
+
+from ..core.ops import Op
+from ..utils.loggingx import logger
+
+
+def apply_ops(base_tree: pathlib.Path, ops: Iterable[Op]) -> pathlib.Path:
+    base_tree = pathlib.Path(base_tree)
+    out = pathlib.Path(tempfile.mkdtemp(prefix="semmerge_merged_"))
+    shutil.copytree(base_tree, out, dirs_exist_ok=True)
+
+    for op in ops:
+        handler = _HANDLERS.get(op.type)
+        if handler is None:
+            logger.debug("No applier hook for op %s", op.type)
+            continue
+        handler(out, op)
+    return out
+
+
+def _apply_move_decl(root: pathlib.Path, op: Op) -> None:
+    old_file = op.params.get("oldFile") or op.params.get("file")
+    new_file = op.params.get("newFile") or op.params.get("file")
+    if not old_file or not new_file:
+        return
+    src = root / _normalize_relpath(old_file)
+    dst = root / _normalize_relpath(new_file)
+    if src == dst:
+        return
+    if not src.exists():
+        logger.debug("moveDecl source missing: %s", src)
+        return
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.move(src, dst)
+
+
+def _apply_move_file(root: pathlib.Path, op: Op) -> None:
+    old_path = op.params.get("oldPath")
+    new_path = op.params.get("newPath")
+    if not old_path or not new_path:
+        return
+    src = root / _normalize_relpath(old_path)
+    dst = root / _normalize_relpath(new_path)
+    if not src.exists():
+        logger.debug("moveFile source missing: %s", src)
+        return
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.move(src, dst)
+
+
+def _apply_rename_symbol(root: pathlib.Path, op: Op) -> None:
+    file_path = op.params.get("file") or op.params.get("newFile")
+    old_name = op.params.get("oldName")
+    new_name = op.params.get("newName")
+    if not file_path or not old_name or not new_name:
+        return
+    path = root / _normalize_relpath(file_path)
+    if not path.exists():
+        logger.debug("renameSymbol target missing: %s", path)
+        return
+    code = path.read_text(encoding="utf-8")
+    code = re.sub(rf"\b{re.escape(str(old_name))}\b", str(new_name), code)
+    path.write_text(code, encoding="utf-8")
+
+
+def _apply_modify_import(root: pathlib.Path, op: Op) -> None:
+    file_path = op.params.get("file")
+    old_import = op.params.get("oldImport")
+    new_import = op.params.get("newImport")
+    if not file_path or old_import is None or new_import is None:
+        return
+    path = root / _normalize_relpath(file_path)
+    if not path.exists():
+        logger.debug("modifyImport target missing: %s", path)
+        return
+    code = path.read_text(encoding="utf-8")
+    path.write_text(code.replace(str(old_import), str(new_import)), encoding="utf-8")
+
+
+def _apply_reorder_imports(root: pathlib.Path, op: Op) -> None:
+    """Reorder a file's leading import block per the op's CRDT keys.
+
+    The op's ``params["order"]`` is a list of ``{value, anchor, t,
+    author, opid}`` records; ordering is resolved by the RGA CRDT
+    (specified at reference ``requirements.md:71-75`` [CRD-001..004] and
+    ``architecture.md:173-178`` but left dead in the reference)."""
+    from ..core.crdt import RGA, Key
+
+    file_path = op.params.get("file")
+    order = op.params.get("order")
+    if not file_path or not order:
+        return
+    path = root / _normalize_relpath(file_path)
+    if not path.exists():
+        return
+    lines = path.read_text(encoding="utf-8").splitlines(keepends=True)
+    import_idx = [i for i, ln in enumerate(lines) if ln.lstrip().startswith("import ")]
+    if not import_idx:
+        return
+    rga = RGA()
+    for entry in order:
+        rga.insert(Key(str(entry.get("anchor", "")), int(entry.get("t", 0)),
+                       str(entry.get("author", "")), str(entry.get("opid", ""))),
+                   str(entry.get("value", "")))
+    ordered = [v for v in rga.materialize()]
+    by_text = {lines[i].strip(): i for i in import_idx}
+    new_imports = [lines[by_text[v]] for v in ordered if v in by_text]
+    remaining = [lines[i] for i in import_idx if lines[i].strip() not in set(ordered)]
+    block = new_imports + remaining
+    first = import_idx[0]
+    kept = [ln for i, ln in enumerate(lines) if i not in set(import_idx)]
+    kept[first:first] = block
+    path.write_text("".join(kept), encoding="utf-8")
+
+
+def _normalize_relpath(value: str) -> pathlib.Path:
+    """Normalize an op-supplied path to a tree-relative path.
+
+    Strips absolute anchors (reference ``semmerge/applier.py:97-104``)
+    and additionally rejects ``..`` traversal segments — op logs can
+    arrive from fetched git notes (``semrebase``), so a hostile note
+    must not be able to address files outside the merge tree.
+    """
+    path = pathlib.Path(value)
+    if path.is_absolute():
+        try:
+            path = path.relative_to(path.anchor)
+        except ValueError:
+            path = pathlib.Path(path.name)
+    parts = [p for p in path.parts if p not in ("..", ".")]
+    return pathlib.Path(*parts) if parts else pathlib.Path(path.name)
+
+
+_HANDLERS = {
+    "moveDecl": _apply_move_decl,
+    "moveFile": _apply_move_file,
+    "renameSymbol": _apply_rename_symbol,
+    "modifyImport": _apply_modify_import,
+    "reorderImports": _apply_reorder_imports,
+}

@@ -1,0 +1,30 @@
+"""Formatter hook (reference ``semmerge/emitter.py``).
+
+Best-effort formatting of the merged tree. The formatter command comes
+from config (``[core] formatter`` / per-language ``formatter_cmd``),
+defaulting to Prettier via npx. A missing toolchain downgrades to a
+debug log; a failing run to a warning — formatting never fails a merge
+(reference ``semmerge/emitter.py:22-25``; ``requirements.md:107``
+[FBK-003]).
+"""
+from __future__ import annotations
+
+import pathlib
+import subprocess
+from typing import Sequence
+
+from ..utils.loggingx import logger
+
+DEFAULT_FORMATTER = ("npx", "prettier", "--write", ".")
+
+
+def emit_files(tree_path: pathlib.Path, formatter_cmd: Sequence[str] | None = None) -> None:
+    tree_path = pathlib.Path(tree_path)
+    cmd = list(formatter_cmd) if formatter_cmd else list(DEFAULT_FORMATTER)
+    try:
+        subprocess.run(cmd, cwd=tree_path, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    except FileNotFoundError:
+        logger.debug("Formatter %s not available; skipping", cmd[0])
+    except subprocess.CalledProcessError as exc:
+        logger.warning("Formatter exited with code %s", exc.returncode)

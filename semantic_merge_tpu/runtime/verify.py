@@ -1,0 +1,27 @@
+"""Post-merge verification (reference ``semmerge/verify.py``).
+
+Type-checks the merged tree with ``tsc --noEmit``. A missing toolchain
+passes vacuously — the documented graceful-degradation contract
+(reference ``semmerge/verify.py:28-30``; ``requirements.md:107``
+[FBK-003]; ``runbook.md:57``).
+"""
+from __future__ import annotations
+
+import pathlib
+import subprocess
+from typing import List, Tuple
+
+from ..utils.loggingx import logger
+
+
+def typecheck_ts(tree_path: pathlib.Path) -> Tuple[bool, List[str]]:
+    tree_path = pathlib.Path(tree_path)
+    try:
+        proc = subprocess.run(
+            ["npx", "tsc", "-p", ".", "--noEmit"],
+            cwd=tree_path, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+    except FileNotFoundError:
+        logger.debug("TypeScript compiler not available; skipping type-check")
+        return True, []
+    return proc.returncode == 0, proc.stdout.splitlines()

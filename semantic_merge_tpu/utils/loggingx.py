@@ -1,0 +1,19 @@
+"""Shared logger for the framework.
+
+Behavioral parity with the reference logger (reference
+``semmerge/loggingx.py:7-13``): a single package logger with a plain
+``LEVEL message`` stream format whose level is taken from the
+``SEMMERGE_LOG`` environment variable (default ``INFO``).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger("semantic_merge_tpu")
+
+if not logger.handlers:
+    _handler = logging.StreamHandler()
+    _handler.setFormatter(logging.Formatter("%(levelname)s %(message)s"))
+    logger.addHandler(_handler)
+logger.setLevel(os.environ.get("SEMMERGE_LOG", "INFO"))

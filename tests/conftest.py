@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so that every sharding and
+collective path compiles and executes without TPU hardware; the bench
+harness runs the same code on the real chip. The env vars must be set
+before the first ``import jax`` anywhere in the process.
+"""
+import os
+import sys
+import pathlib
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))

@@ -1,0 +1,107 @@
+"""Applier/materialization tests (reference semmerge/applier.py behavior)."""
+import pathlib
+
+from semantic_merge_tpu.core.ops import Op, Target
+from semantic_merge_tpu.runtime.applier import apply_ops
+
+
+def mk_tree(tmp_path: pathlib.Path, files: dict) -> pathlib.Path:
+    root = tmp_path / "tree"
+    for rel, content in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    return root
+
+
+def test_move_decl_moves_whole_file(tmp_path):
+    tree = mk_tree(tmp_path, {"src/util.ts": "export function foo() {}\n"})
+    op = Op.new("moveDecl", Target(symbolId="s"),
+                params={"oldFile": "src/util.ts", "newFile": "lib/util.ts"})
+    out = apply_ops(tree, [op])
+    assert not (out / "src/util.ts").exists()
+    assert (out / "lib/util.ts").read_text() == "export function foo() {}\n"
+
+
+def test_rename_symbol_word_boundary(tmp_path):
+    tree = mk_tree(tmp_path, {"a.ts": "function foo() { return foofoo + foo; }\n"})
+    op = Op.new("renameSymbol", Target(symbolId="s"),
+                params={"file": "a.ts", "oldName": "foo", "newName": "bar"})
+    out = apply_ops(tree, [op])
+    assert (out / "a.ts").read_text() == "function bar() { return foofoo + bar; }\n"
+
+
+def test_rename_then_move_sequence(tmp_path):
+    # Composed order: move first (precedence 10), then rename with file
+    # rewritten to the destination — the flagship e2e scenario.
+    tree = mk_tree(tmp_path, {"src/util.ts": "export function foo(): void {}\n"})
+    move = Op.new("moveDecl", Target(symbolId="s"),
+                  params={"oldFile": "src/util.ts", "newFile": "lib/util.ts"})
+    rename = Op.new("renameSymbol", Target(symbolId="s"),
+                    params={"file": "lib/util.ts", "oldName": "foo", "newName": "bar"})
+    out = apply_ops(tree, [move, rename])
+    assert (out / "lib/util.ts").read_text() == "export function bar(): void {}\n"
+
+
+def test_modify_import_literal_replace(tmp_path):
+    tree = mk_tree(tmp_path, {"a.ts": 'import { x } from "./old";\n'})
+    op = Op.new("modifyImport", Target(symbolId="s"),
+                params={"file": "a.ts", "oldImport": "./old", "newImport": "./new"})
+    out = apply_ops(tree, [op])
+    assert (out / "a.ts").read_text() == 'import { x } from "./new";\n'
+
+
+def test_move_file_op(tmp_path):
+    tree = mk_tree(tmp_path, {"a.ts": "x\n"})
+    op = Op.new("moveFile", Target(symbolId="s"),
+                params={"oldPath": "a.ts", "newPath": "b/renamed.ts"})
+    out = apply_ops(tree, [op])
+    assert (out / "b/renamed.ts").exists() and not (out / "a.ts").exists()
+
+
+def test_missing_sources_skipped_gracefully(tmp_path):
+    tree = mk_tree(tmp_path, {"a.ts": "x\n"})
+    ops = [
+        Op.new("moveDecl", Target(symbolId="s"),
+               params={"oldFile": "nope.ts", "newFile": "other.ts"}),
+        Op.new("renameSymbol", Target(symbolId="s"),
+               params={"file": "nope.ts", "oldName": "a", "newName": "b"}),
+        Op.new("addDecl", Target(symbolId="s"), params={"file": "a.ts"}),
+    ]
+    out = apply_ops(tree, ops)  # must not raise
+    assert (out / "a.ts").read_text() == "x\n"
+
+
+def test_absolute_paths_normalized(tmp_path):
+    tree = mk_tree(tmp_path, {"a.ts": "foo\n"})
+    op = Op.new("renameSymbol", Target(symbolId="s"),
+                params={"file": "/a.ts", "oldName": "foo", "newName": "bar"})
+    out = apply_ops(tree, [op])
+    assert (out / "a.ts").read_text() == "bar\n"
+
+
+def test_path_traversal_rejected(tmp_path):
+    # Op logs can arrive from fetched git notes (semrebase) — '..' segments
+    # must not escape the merge tree.
+    tree = mk_tree(tmp_path, {"a.ts": "x\n"})
+    escape = tmp_path / "escape.ts"
+    op = Op.new("moveDecl", Target(symbolId="s"),
+                params={"oldFile": "a.ts", "newFile": "../../escape.ts"})
+    out = apply_ops(tree, [op])
+    assert not escape.exists()
+    # The file went somewhere inside the merged tree instead.
+    assert (out / "escape.ts").exists()
+
+
+def test_reorder_imports_via_crdt(tmp_path):
+    tree = mk_tree(tmp_path, {"a.ts": 'import b from "b";\nimport a from "a";\nconst x = 1;\n'})
+    order = [
+        {"value": 'import a from "a";', "anchor": "0", "t": 1, "author": "u", "opid": "1"},
+        {"value": 'import b from "b";', "anchor": "0", "t": 2, "author": "u", "opid": "2"},
+    ]
+    op = Op.new("reorderImports", Target(symbolId="s"),
+                params={"file": "a.ts", "order": order})
+    out = apply_ops(tree, [op])
+    text = (out / "a.ts").read_text()
+    assert text.index('import a') < text.index('import b')
+    assert text.endswith("const x = 1;\n")

@@ -1,0 +1,132 @@
+"""Composer semantics tests (reference semmerge/compose.py behavior)."""
+from semantic_merge_tpu.core.compose import compose_oplogs
+from semantic_merge_tpu.core.ops import Op, Target
+
+
+def mk(op_type, sym, params=None, ts="2024-01-01T00:00:00Z", op_id=None, addr=None):
+    return Op.new(op_type, Target(symbolId=sym, addressId=addr),
+                  params=params or {}, provenance={"timestamp": ts},
+                  op_id=op_id)
+
+
+def test_move_decl_rewrites_own_target_address():
+    move = mk("moveDecl", "sym-1", {"newAddress": "new-addr"}, addr="old-addr")
+    composed, conflicts = compose_oplogs([move], [])
+    assert conflicts == []
+    (op,) = composed
+    assert op.target.addressId == "new-addr"
+    assert op.params["newAddress"] == "new-addr"
+
+
+def test_rename_from_a_move_from_b_compose_cleanly():
+    # The flagship scenario (reference tests/e2e_rename_move_decl.sh):
+    # A renames foo→bar in src/util.ts, B moves src/util.ts→lib/util.ts.
+    rename = mk("renameSymbol", "sym-1",
+                {"oldName": "foo", "newName": "bar", "file": "src/util.ts"},
+                op_id="a" * 32)
+    move = mk("moveDecl", "sym-1",
+              {"oldFile": "src/util.ts", "newFile": "lib/util.ts",
+               "oldAddress": "src/util.ts::foo::0", "newAddress": "lib/util.ts::foo::0"},
+              op_id="b" * 32)
+    composed, conflicts = compose_oplogs([rename], [move])
+    assert conflicts == []
+    assert [o.type for o in composed] == ["moveDecl", "renameSymbol"]
+    # The move chain rewrote the rename's file to the moved location.
+    rename_out = composed[1]
+    assert rename_out.params["file"] == "lib/util.ts"
+    assert rename_out.params["newFile"] == "lib/util.ts"
+    assert rename_out.target.addressId == "lib/util.ts::foo::0"
+
+
+def test_divergent_rename_head_vs_head_conflict():
+    ra = mk("renameSymbol", "s", {"newName": "x"}, op_id="1" * 32)
+    rb = mk("renameSymbol", "s", {"newName": "y"}, op_id="2" * 32)
+    composed, conflicts = compose_oplogs([ra], [rb])
+    assert composed == []
+    assert len(conflicts) == 1
+    conf = conflicts[0]
+    assert conf.category == "DivergentRename"
+    # A's op is always reported as opA regardless of which side sorted first.
+    assert conf.opA["id"] == ra.id
+    assert conf.opB["id"] == rb.id
+
+
+def test_divergent_rename_opA_is_side_A_even_when_B_sorts_first():
+    ra = mk("renameSymbol", "s", {"newName": "x"}, op_id="9" * 32)
+    rb = mk("renameSymbol", "s", {"newName": "y"}, op_id="1" * 32)
+    _, conflicts = compose_oplogs([ra], [rb])
+    assert conflicts[0].opA["id"] == ra.id
+    assert conflicts[0].suggestions[0]["label"] == "Rename to x"
+
+
+def test_same_rename_both_sides_is_not_a_conflict():
+    ra = mk("renameSymbol", "s", {"newName": "x"}, op_id="1" * 32)
+    rb = mk("renameSymbol", "s", {"newName": "x"}, op_id="2" * 32)
+    composed, conflicts = compose_oplogs([ra], [rb])
+    assert conflicts == []
+    assert len(composed) == 2
+
+
+def test_interleaved_op_masks_divergent_rename_reference_quirk():
+    # Conflict detection is head-vs-head only: if an unrelated B op sorts
+    # *between* the two divergent renames, A's rename is consumed while
+    # B's head is still the unrelated op, and B's rename is consumed after
+    # A is exhausted — the conflict is masked. Reference behavior
+    # (semmerge/compose.py:60-70), kept bit-for-bit in parity mode.
+    ra = mk("renameSymbol", "s", {"newName": "x"}, op_id="1" * 32)
+    other_b = mk("renameSymbol", "unrelated", {"newName": "n"}, op_id="2" * 32)
+    rb = mk("renameSymbol", "s", {"newName": "y"}, op_id="3" * 32)
+    composed, conflicts = compose_oplogs([ra], [other_b, rb])
+    assert conflicts == []  # masked!
+    assert len(composed) == 3
+
+
+def test_adjacent_divergent_rename_still_detected_with_other_ops_around():
+    ra = mk("renameSymbol", "s", {"newName": "x"}, op_id="2" * 32)
+    early_b = mk("renameSymbol", "unrelated", {"newName": "n"}, op_id="1" * 32)
+    rb = mk("renameSymbol", "s", {"newName": "y"}, op_id="3" * 32)
+    composed, conflicts = compose_oplogs([ra], [early_b, rb])
+    # early_b consumed first (smaller id); then heads are ra vs rb → conflict.
+    assert len(conflicts) == 1
+    assert len(composed) == 1
+
+
+def test_rename_context_attached_to_other_ops():
+    rename = mk("renameSymbol", "s", {"newName": "bar"}, op_id="1" * 32)
+    edit = mk("editStmtBlock", "s", {}, op_id="2" * 32)
+    composed, _ = compose_oplogs([rename, edit], [])
+    edit_out = [o for o in composed if o.type == "editStmtBlock"][0]
+    assert edit_out.params["renameContext"] == "bar"
+    rename_out = [o for o in composed if o.type == "renameSymbol"][0]
+    assert "renameContext" not in rename_out.params
+
+
+def test_move_chain_merges_address_and_file_separately():
+    m1 = mk("moveDecl", "s", {"newAddress": "addr1"}, op_id="1" * 32)
+    m2 = mk("moveDecl", "s", {"newFile": "f2.ts"}, op_id="2" * 32)
+    composed, _ = compose_oplogs([m1, m2], [])
+    last = composed[-1]
+    # Second move inherits the first move's address through the chain.
+    assert last.params["newAddress"] == "addr1"
+    assert last.params["newFile"] == "f2.ts"
+
+
+def test_ties_prefer_side_a():
+    a = mk("addDecl", "s1", {"file": "a.ts"}, op_id="5" * 32)
+    b = mk("addDecl", "s2", {"file": "b.ts"}, op_id="5" * 32)
+    composed, _ = compose_oplogs([a], [b])
+    assert composed[0].target.symbolId == "s1"
+
+
+def test_sort_by_precedence_then_timestamp_then_id():
+    late_move = mk("moveDecl", "m", {"newAddress": "x"}, ts="2025-01-01T00:00:00Z")
+    early_add = mk("addDecl", "a", {"file": "f.ts"}, ts="2020-01-01T00:00:00Z")
+    composed, _ = compose_oplogs([early_add, late_move], [])
+    # moveDecl (prec 10) composes before addDecl (prec 30) despite timestamps.
+    assert [o.type for o in composed] == ["moveDecl", "addDecl"]
+
+
+def test_input_ops_not_mutated():
+    move = mk("moveDecl", "s", {"newAddress": "new"}, addr="old")
+    compose_oplogs([move], [])
+    assert move.target.addressId == "old"

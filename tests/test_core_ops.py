@@ -1,0 +1,81 @@
+"""Data-contract tests: Op/OpLog/Conflict JSON parity surface."""
+import json
+
+from semantic_merge_tpu.core.conflict import divergent_rename_conflict
+from semantic_merge_tpu.core.ids import deterministic_op_id, symbol_id_from_signature
+from semantic_merge_tpu.core.ops import OP_PRECEDENCE, OP_TYPES, Op, OpLog, Target
+
+
+def test_op_round_trip():
+    op = Op.new(
+        "renameSymbol",
+        Target(symbolId="abc123", addressId="a.ts::foo::0"),
+        params={"oldName": "foo", "newName": "bar", "file": "a.ts"},
+        guards={"exists": True},
+        effects={"summary": "rename foo→bar"},
+        provenance={"rev": "base", "timestamp": "2024-01-01T00:00:00Z"},
+    )
+    d = op.to_dict()
+    assert set(d) == {"id", "schemaVersion", "type", "target", "params",
+                      "guards", "effects", "provenance"}
+    assert d["target"] == {"symbolId": "abc123", "addressId": "a.ts::foo::0"}
+    restored = Op.from_dict(d)
+    assert restored == op
+
+
+def test_oplog_json_round_trip_is_compact():
+    op = Op.new("addDecl", Target(symbolId="s1"), params={"file": "a.ts"})
+    log = OpLog([op])
+    payload = log.to_json()
+    # Compact separators — byte-compatible with the reference's orjson output.
+    assert ": " not in payload and ", " not in payload
+    assert OpLog.from_json(payload).ops == [op]
+
+
+def test_all_17_op_types_and_precedence():
+    assert len(OP_TYPES) == 17
+    assert set(OP_PRECEDENCE) == set(OP_TYPES)
+    assert OP_PRECEDENCE["moveDecl"] == 10
+    assert OP_PRECEDENCE["renameSymbol"] == 11
+    assert OP_PRECEDENCE["modifyNamespace"] == 70
+
+
+def test_sort_key_matches_reference_semantics():
+    op = Op.new("moveDecl", Target(symbolId="s"), provenance={})
+    prec, ts, _ = op.sort_key()
+    assert prec == 10
+    assert ts == "1970-01-01T00:00:00Z"  # missing-timestamp default
+    unknown = Op.new("notARealOp", Target(symbolId="s"))
+    assert unknown.sort_key()[0] == 99
+
+
+def test_deterministic_ids_are_stable_and_uuid_shaped():
+    a = deterministic_op_id("seed", "rev", 0, "renameSymbol")
+    b = deterministic_op_id("seed", "rev", 0, "renameSymbol")
+    c = deterministic_op_id("seed", "rev", 1, "renameSymbol")
+    assert a == b != c
+    parts = a.split("-")
+    assert [len(p) for p in parts] == [8, 4, 4, 4, 12]
+
+
+def test_symbol_id_matches_reference_hash_scheme():
+    # sha256("fn(number,number)->number")[:16] — the reference's exact
+    # symbolId derivation (workers/ts/src/sast.ts:69-71,96).
+    import hashlib
+    sig = "fn(number,number)->number"
+    assert symbol_id_from_signature(sig) == hashlib.sha256(sig.encode()).hexdigest()[:16]
+    assert len(symbol_id_from_signature("class{2}")) == 16
+
+
+def test_divergent_rename_conflict_shape():
+    op_a = Op.new("renameSymbol", Target(symbolId="s", addressId="a"),
+                  params={"newName": "x"})
+    op_b = Op.new("renameSymbol", Target(symbolId="s", addressId="b"),
+                  params={"newName": "y"})
+    conf = divergent_rename_conflict(op_a, op_b)
+    assert conf.category == "DivergentRename"
+    assert conf.id == f"conf-{op_a.id[:8]}-{op_b.id[:8]}"
+    assert conf.addressIds == {"A": "a", "B": "b", "base": None}
+    assert [s["id"] for s in conf.suggestions] == ["keepA", "keepB"]
+    assert "Rename to x" == conf.suggestions[0]["label"]
+    json.dumps(conf.to_dict())  # serializable

@@ -1,0 +1,48 @@
+"""RGA CRDT host-implementation tests."""
+from semantic_merge_tpu.core.crdt import RGA, Key
+
+
+def test_insert_orders_by_key_tuple():
+    rga = RGA()
+    rga.insert(Key("a", 2, "u1", "op2"), "second")
+    rga.insert(Key("a", 1, "u1", "op1"), "first")
+    rga.insert(Key("b", 1, "u1", "op3"), "third")
+    assert rga.materialize() == ["first", "second", "third"]
+
+
+def test_equal_keys_keep_insertion_order():
+    rga = RGA()
+    k = Key("a", 1, "u", "same")
+    rga.insert(k, "x")
+    rga.insert(k, "y")
+    assert rga.materialize() == ["x", "y"]
+
+
+def test_delete_tombstones_all_matches():
+    rga = RGA()
+    rga.insert(Key("a", 1, "u", "1"), "v")
+    rga.insert(Key("a", 2, "u", "2"), "v")
+    rga.delete("v")
+    assert rga.materialize() == []
+
+
+def test_move_relocates_first_live_element():
+    rga = RGA()
+    rga.insert(Key("a", 1, "u", "1"), "x")
+    rga.insert(Key("a", 2, "u", "2"), "y")
+    rga.move("x", Key("a", 3, "u", "3"))
+    assert rga.materialize() == ["y", "x"]
+
+
+def test_convergence_any_op_order():
+    ops = [
+        (Key("a", 1, "u1", "1"), "alpha"),
+        (Key("a", 1, "u2", "2"), "beta"),
+        (Key("b", 0, "u1", "3"), "gamma"),
+    ]
+    r1, r2 = RGA(), RGA()
+    for k, v in ops:
+        r1.insert(k, v)
+    for k, v in reversed(ops):
+        r2.insert(k, v)
+    assert r1.materialize() == r2.materialize()

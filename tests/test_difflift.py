@@ -1,0 +1,99 @@
+"""Diff/lift semantics tests against the reference's observable behavior."""
+from semantic_merge_tpu.core.difflift import diff_nodes, lift
+from semantic_merge_tpu.frontend.scanner import scan_file
+
+
+def _scan(src_base, src_side, path="a.ts"):
+    return scan_file(path, src_base), scan_file(path, src_side)
+
+
+def test_rename_detected_via_stable_symbol_id():
+    base, side = _scan(
+        "export function foo(a: number): number { return a; }\n",
+        "export function bar(a: number): number { return a; }\n",
+    )
+    diffs = diff_nodes(base, side)
+    # addressId embeds the name (file::name::pos), so a rename also shifts
+    # the address: the reference emits BOTH move and rename for the symbol
+    # (workers/ts/src/diff.ts:16-21).
+    assert [d.kind for d in diffs] == ["move", "rename"]
+    ops = lift("base", diffs)
+    op = [o for o in ops if o.type == "renameSymbol"][0]
+    assert op.type == "renameSymbol"
+    assert op.params["oldName"] == "foo"
+    assert op.params["newName"] == "bar"
+    assert op.params["file"] == "a.ts"
+    assert op.guards == {"exists": True, "addressMatch": base[0].addressId}
+    assert op.effects == {"summary": "rename foo→bar"}
+
+
+def test_move_across_files():
+    base = scan_file("a.ts", "export function f(x: string): string { return x; }\n")
+    side = scan_file("lib/a.ts", "export function f(x: string): string { return x; }\n")
+    diffs = diff_nodes(base, side)
+    assert [d.kind for d in diffs] == ["move"]
+    (op,) = lift("base", diffs)
+    assert op.type == "moveDecl"
+    assert op.params["oldFile"] == "a.ts"
+    assert op.params["newFile"] == "lib/a.ts"
+    assert op.params["oldAddress"] == base[0].addressId
+    assert op.params["newAddress"] == side[0].addressId
+
+
+def test_move_and_rename_both_emitted_for_one_symbol():
+    base, side = _scan(
+        "export function foo(n: number): void {}\n",
+        "// moved down\n\nexport function renamed(n: number): void {}\n",
+    )
+    diffs = diff_nodes(base, side)
+    assert sorted(d.kind for d in diffs) == ["move", "rename"]
+
+
+def test_add_and_delete():
+    base, side = _scan(
+        "export function f(): void {}\n",
+        "export function f(): void {}\nexport function g(s: string): string { return s; }\n",
+    )
+    diffs = diff_nodes(base, side)
+    assert [d.kind for d in diffs] == ["add"]
+    (op,) = lift("base", diffs)
+    assert op.type == "addDecl" and op.params == {"file": "a.ts"}
+
+    diffs_rev = diff_nodes(side, base)
+    assert [d.kind for d in diffs_rev] == ["delete"]
+    (op,) = lift("base", diffs_rev)
+    assert op.type == "deleteDecl" and op.params == {"file": "a.ts"}
+
+
+def test_signature_change_reports_delete_plus_add():
+    # Changing a function's type changes symbolId → delete+add, not rename
+    # (the reference quirk documented in SURVEY §3.4).
+    base, side = _scan(
+        "export function f(a: number): number { return a; }\n",
+        "export function f(a: string): string { return a; }\n",
+    )
+    assert sorted(d.kind for d in diff_nodes(base, side)) == ["add", "delete"]
+
+
+def test_duplicate_symbol_ids_last_wins_and_adds_repeat():
+    # Base has one vars{1}; side has two vars{1} (same symbolId). The side
+    # map keeps the last, and the add loop walks the raw list.
+    base = scan_file("a.ts", "const a = 1;\n")
+    side = scan_file("a.ts", "const a = 1;\nconst b = 2;\n")
+    diffs = diff_nodes(base, side)
+    # Same symbolId exists in both → no add; address compare is against the
+    # LAST side occurrence (map last-wins), which moved → move op.
+    assert [d.kind for d in diffs] == ["move"]
+    assert diffs[0].b.addressId == side[1].addressId
+
+
+def test_lift_is_deterministic():
+    base, side = _scan(
+        "export function foo(a: number): number { return a; }\n",
+        "export function bar(a: number): number { return a; }\n",
+    )
+    ops1 = lift("base", diff_nodes(base, side), seed="s")
+    ops2 = lift("base", diff_nodes(base, side), seed="s")
+    assert [o.to_dict() for o in ops1] == [o.to_dict() for o in ops2]
+    ops3 = lift("base", diff_nodes(base, side), seed="other")
+    assert ops1[0].id != ops3[0].id

@@ -1,0 +1,119 @@
+"""Frontend scanner tests: declaration indexing semantics."""
+from semantic_merge_tpu.frontend.scanner import scan_file, scan_snapshot
+
+
+def kinds(nodes):
+    return [(n.kind, n.name) for n in nodes]
+
+
+def test_function_declaration_signature_and_address():
+    nodes = scan_file("src/a.ts", "export function add(a: number, b: number): number {\n  return a + b;\n}\n")
+    assert len(nodes) == 1
+    n = nodes[0]
+    assert n.kind == "FunctionDeclaration"
+    assert n.name == "add"
+    assert n.signature == "fn(number,number)->number"
+    # First declaration in a file has fullstart 0 (TS node.pos semantics).
+    assert n.addressId == "src/a.ts::add::0"
+
+
+def test_untyped_params_display_as_any():
+    nodes = scan_file("a.ts", "function f(x, y) { return x; }\n")
+    assert nodes[0].signature == "fn(any,any)->any"
+
+
+def test_nested_declarations_are_indexed_preorder():
+    src = "function outer() {\n  function inner(s: string): void {}\n}\n"
+    nodes = scan_file("a.ts", src)
+    assert [n.name for n in nodes] == ["outer", "inner"]
+    assert nodes[1].signature == "fn(string)->void"
+
+
+def test_class_interface_enum_member_counts():
+    src = (
+        "class Point { x = 0; y = 0; dist(): number { return 0; } }\n"
+        "interface Shape { area(): number; name: string; }\n"
+        "enum Color { Red, Green, Blue }\n"
+    )
+    nodes = scan_file("a.ts", src)
+    sigs = {n.name: n.signature for n in nodes}
+    assert sigs == {"Point": "class{3}", "Shape": "iface{2}", "Color": "enum{3}"}
+
+
+def test_variable_statements_anon_and_declarator_counts():
+    nodes = scan_file("a.ts", "const a = 1, b = 2;\nlet msg = 'hi';\n")
+    assert [(n.kind, n.name, n.signature) for n in nodes] == [
+        ("VariableStatement", None, "vars{2}"),
+        ("VariableStatement", None, "vars{1}"),
+    ]
+    assert nodes[0].addressId.endswith("::anon::0")
+
+
+def test_expressions_and_for_heads_not_indexed():
+    src = (
+        "const f = function named() { return 1; };\n"
+        "const C = class Named {};\n"
+        "const g = () => 1;\n"
+        "for (const i of [1, 2]) { }\n"
+    )
+    nodes = scan_file("a.ts", src)
+    # Only the three VariableStatements; no function/class declarations,
+    # no for-head const.
+    assert [n.kind for n in nodes] == ["VariableStatement"] * 3
+
+
+def test_rename_preserves_symbol_id():
+    base = scan_file("a.ts", "export function foo(a: number): number { return a; }\n")
+    side = scan_file("a.ts", "export function bar(a: number): number { return a; }\n")
+    assert base[0].symbolId == side[0].symbolId
+    assert base[0].name != side[0].name
+
+
+def test_position_shift_changes_address_spurious_move_quirk():
+    # Any upstream edit shifts n.pos → addressId differs (the reference's
+    # documented spurious-move quirk, workers/ts/src/sast.ts:65-67).
+    base = scan_file("a.ts", "function f(): void {}\nfunction g(x: string): string { return x; }\n")
+    side = scan_file("a.ts", "// comment\nfunction f(): void {}\nfunction g(x: string): string { return x; }\n")
+    assert base[1].symbolId == side[1].symbolId
+    assert base[1].addressId != side[1].addressId
+
+
+def test_snapshot_type_resolution_cross_file():
+    files = [
+        {"path": "types.ts", "content": "export interface Vec { x: number; }\n"},
+        {"path": "main.ts", "content": "export function len(v: Vec): number { return v.x; }\n"},
+    ]
+    nodes = scan_snapshot(files)
+    by_name = {n.name: n for n in nodes if n.name}
+    # Vec is declared in the snapshot → keeps its name in the signature.
+    assert by_name["len"].signature == "fn(Vec)->number"
+
+
+def test_unresolved_type_reference_displays_any():
+    # No default lib is loaded (reference host returns "" for lib files),
+    # so Array<T> and unknown names collapse to any.
+    nodes = scan_file("a.ts", "function f(xs: Array<number>, p: Promise<void>): Missing { return xs; }\n")
+    assert nodes[0].signature == "fn(any,any)->any"
+
+
+def test_array_and_union_rendering():
+    nodes = scan_file("a.ts", "function f(xs: number[], u: string | number): void {}\n")
+    assert nodes[0].signature == "fn(number[],string | number)->void"
+
+
+def test_template_and_regex_do_not_confuse_scanner():
+    src = (
+        "const s = `hello ${name} {brace}`;\n"
+        "const re = /function notreal\\//g;\n"
+        "function real(): void {}\n"
+    )
+    nodes = scan_file("a.ts", src)
+    assert ("FunctionDeclaration", "real") in kinds(nodes)
+    assert len([n for n in nodes if n.kind == "FunctionDeclaration"]) == 1
+
+
+def test_same_shape_decls_collide_last_wins_in_diff():
+    # Two classes with the same member count share a symbolId — the
+    # reference's coarse-signature collision (implementation.md:1309).
+    nodes = scan_file("a.ts", "class A { x = 1; }\nclass B { y = 2; }\n")
+    assert nodes[0].symbolId == nodes[1].symbolId

@@ -43,7 +43,22 @@ class Backend(Protocol):
              *, base_rev: str = "base", seed: str = "0",
              timestamp: str | None = None) -> List[Op]: ...
 
+    def compose(self, delta_a: List[Op], delta_b: List[Op]):
+        """Compose two op logs; backends override to run composition on
+        their own execution engine (default: host composer)."""
+        ...
+
     def close(self) -> None: ...
+
+
+def host_compose(delta_a: List[Op], delta_b: List[Op]):
+    from ..core.compose import compose_oplogs
+    return compose_oplogs(delta_a, delta_b)
+
+
+def symbol_map(nodes) -> List[dict]:
+    """SymbolMaps payload entry (reference ``workers/ts/src/index.ts:30-35``)."""
+    return [{"symbolId": n.symbolId, "addressId": n.addressId} for n in nodes]
 
 
 _REGISTRY: Dict[str, Callable[[], Backend]] = {}

@@ -14,9 +14,10 @@ from typing import List
 from ..core.difflift import diff_nodes, lift
 from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
-from ..frontend.scanner import DeclNode, scan_snapshot
+from ..frontend.scanner import scan_snapshot
 from ..frontend.snapshot import Snapshot
-from .base import BuildAndDiffResult, register_backend
+from .base import (BuildAndDiffResult, host_compose, register_backend,
+                   symbol_map)
 
 
 class HostTSBackend:
@@ -35,9 +36,9 @@ class HostTSBackend:
             op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts),
             op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts),
             symbol_maps={
-                "base": _symbol_map(base_nodes),
-                "left": _symbol_map(left_nodes),
-                "right": _symbol_map(right_nodes),
+                "base": symbol_map(base_nodes),
+                "left": symbol_map(left_nodes),
+                "right": symbol_map(right_nodes),
             },
         )
 
@@ -50,12 +51,11 @@ class HostTSBackend:
         return lift(base_rev, diff_nodes(base_nodes, right_nodes),
                     seed=seed + "/R", timestamp=ts)
 
+    def compose(self, delta_a: List[Op], delta_b: List[Op]):
+        return host_compose(delta_a, delta_b)
+
     def close(self) -> None:
         pass
-
-
-def _symbol_map(nodes: List[DeclNode]) -> List[dict]:
-    return [{"symbolId": n.symbolId, "addressId": n.addressId} for n in nodes]
 
 
 register_backend("host", HostTSBackend)

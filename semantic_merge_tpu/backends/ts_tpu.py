@@ -1,0 +1,138 @@
+"""TPU TypeScript backend — the device execution path.
+
+Same contract as :mod:`semantic_merge_tpu.backends.ts_host`, but the
+diff join and op lifting run as fused XLA programs over interned int32
+tensors (:mod:`semantic_merge_tpu.ops.diff`). Host work is reduced to
+scanning (parsing) and string interning; the per-symbol join — the
+reference worker's per-file hot path (reference
+``workers/ts/src/diff.ts``, ``workers/ts/src/lift.ts``) — happens on
+the accelerator. Output op logs are bit-identical to the host backend
+(same deterministic ids, same enumeration order).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..core.encode import NULL_ID, Interner, encode_decls
+from ..core.ids import EPOCH_ISO, deterministic_op_id
+from ..core.ops import Op, Target
+from ..frontend.scanner import scan_snapshot
+from ..frontend.snapshot import Snapshot
+from ..ops.diff import (KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME,
+                        DiffOpsTensor, diff_lift_device)
+from .base import BuildAndDiffResult, register_backend, symbol_map
+
+
+class TpuTSBackend:
+    name = "tpu"
+
+    def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
+                       *, base_rev: str = "base", seed: str = "0",
+                       timestamp: str | None = None) -> BuildAndDiffResult:
+        ts = timestamp or EPOCH_ISO
+        base_nodes = scan_snapshot(base.files)
+        left_nodes = scan_snapshot(left.files)
+        right_nodes = scan_snapshot(right.files)
+        interner = Interner()
+        base_t = encode_decls(base_nodes, interner)
+        left_t = encode_decls(left_nodes, interner)
+        right_t = encode_decls(right_nodes, interner)
+        ops_l = decode_diff_ops(diff_lift_device(base_t, left_t), interner,
+                                base_rev, seed + "/L", ts)
+        ops_r = decode_diff_ops(diff_lift_device(base_t, right_t), interner,
+                                base_rev, seed + "/R", ts)
+        return BuildAndDiffResult(
+            op_log_left=ops_l,
+            op_log_right=ops_r,
+            symbol_maps={
+                "base": symbol_map(base_nodes),
+                "left": symbol_map(left_nodes),
+                "right": symbol_map(right_nodes),
+            },
+        )
+
+    def diff(self, base: Snapshot, right: Snapshot,
+             *, base_rev: str = "base", seed: str = "0",
+             timestamp: str | None = None) -> List[Op]:
+        ts = timestamp or EPOCH_ISO
+        base_nodes = scan_snapshot(base.files)
+        right_nodes = scan_snapshot(right.files)
+        interner = Interner()
+        base_t = encode_decls(base_nodes, interner)
+        right_t = encode_decls(right_nodes, interner)
+        return decode_diff_ops(diff_lift_device(base_t, right_t), interner,
+                               base_rev, seed + "/R", ts)
+
+    def compose(self, delta_a: List[Op], delta_b: List[Op]):
+        from ..ops.compose import compose_oplogs_device
+        return compose_oplogs_device(delta_a, delta_b)
+
+    def close(self) -> None:
+        pass
+
+
+def decode_diff_ops(t: DiffOpsTensor, interner: Interner, base_rev: str,
+                    seed: str, timestamp: str) -> List[Op]:
+    """Device op tensor → Op records, byte-identical to the host lift
+    (:func:`semantic_merge_tpu.core.difflift.lift`)."""
+    ops: List[Op] = []
+    prov = {"rev": base_rev, "timestamp": timestamp}
+
+    def s(idx: int) -> str | None:
+        return interner.lookup(int(idx)) if idx != NULL_ID else None
+
+    for i in range(t.n_ops):
+        kind = int(t.kind[i])
+        sym = s(t.sym[i])
+        a_addr = s(t.a_addr[i]) or ""
+        b_addr = s(t.b_addr[i]) or ""
+        if kind == KIND_RENAME:
+            op_type = "renameSymbol"
+            op = Op.new(
+                op_type, Target(symbolId=sym, addressId=a_addr),
+                params={"oldName": s(t.a_name[i]), "newName": s(t.b_name[i]),
+                        "file": s(t.b_file[i])},
+                guards={"exists": True, "addressMatch": a_addr},
+                effects={"summary": f"rename {s(t.a_name[i])}→{s(t.b_name[i])}"},
+                provenance=dict(prov),
+                op_id=deterministic_op_id(seed, base_rev, i, op_type, sym, a_addr, b_addr),
+            )
+        elif kind == KIND_MOVE:
+            op_type = "moveDecl"
+            op = Op.new(
+                op_type, Target(symbolId=sym, addressId=a_addr),
+                params={"oldAddress": a_addr, "newAddress": b_addr,
+                        "oldFile": s(t.a_file[i]), "newFile": s(t.b_file[i])},
+                guards={"exists": True, "addressMatch": a_addr},
+                effects={"summary": f"move {a_addr}→{b_addr}"},
+                provenance=dict(prov),
+                op_id=deterministic_op_id(seed, base_rev, i, op_type, sym, a_addr, b_addr),
+            )
+        elif kind == KIND_ADD:
+            op_type = "addDecl"
+            op = Op.new(
+                op_type, Target(symbolId=sym, addressId=b_addr),
+                params={"file": s(t.b_file[i])},
+                guards={},
+                effects={"summary": "add decl"},
+                provenance=dict(prov),
+                op_id=deterministic_op_id(seed, base_rev, i, op_type, sym, "", b_addr),
+            )
+        elif kind == KIND_DELETE:
+            op_type = "deleteDecl"
+            op = Op.new(
+                op_type, Target(symbolId=sym, addressId=a_addr),
+                params={"file": s(t.a_file[i])},
+                guards={},
+                effects={"summary": "delete decl"},
+                provenance=dict(prov),
+                op_id=deterministic_op_id(seed, base_rev, i, op_type, sym, a_addr, ""),
+            )
+        else:  # padding rows should never appear below n_ops
+            raise AssertionError(f"bad kind {kind} at row {i}")
+        ops.append(op)
+    return ops
+
+
+register_backend("tpu", TpuTSBackend)
+register_backend("ts_tpu", TpuTSBackend)

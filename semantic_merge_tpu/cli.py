@@ -146,7 +146,8 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
         tracer.count("ops_right", len(result.op_log_right))
 
         with tracer.phase("compose"):
-            composed, conflicts = compose_oplogs(result.op_log_left, result.op_log_right)
+            compose_fn = getattr(backend, "compose", None) or compose_oplogs
+            composed, conflicts = compose_fn(result.op_log_left, result.op_log_right)
         tracer.count("composed_ops", len(composed))
         tracer.count("conflicts", len(conflicts))
 

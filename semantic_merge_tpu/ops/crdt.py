@@ -1,0 +1,89 @@
+"""Batched RGA materialization on device.
+
+The host RGA (:mod:`semantic_merge_tpu.core.crdt`) resolves one list's
+order by O(n) insert scans. A converged RGA's materialized order is a
+pure function of its elements: stable sort by the key tuple
+``(anchor, t, author, opid)`` with insertion sequence as tiebreaker,
+tombstones masked. That makes whole *batches* of lists — every
+import-block and parameter-list reorder in a 10k-file merge — one
+vmapped segmented sort on device.
+
+String key components are order-rank interned
+(:func:`semantic_merge_tpu.core.encode.rank_intern`) so integer sorts
+reproduce lexicographic string comparison exactly.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.crdt import RGA
+from ..core.encode import bucket_size, rank_intern
+
+#: Padding rank — sorts after every real element.
+_PAD = np.int32(2**31 - 1)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _materialize_kernel(anchor, t, author, opid, seq, tombstone, n: int):
+    order = jnp.lexsort((seq, opid, author, t, anchor))
+    keep = ~tombstone[order]
+    # Compact: positions of kept elements in output order.
+    out_pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    perm = jnp.full((n,), n, dtype=jnp.int32)  # n = "dropped"
+    perm = perm.at[jnp.where(keep, out_pos, n)].set(order.astype(jnp.int32), mode="drop")
+    count = jnp.sum(keep.astype(jnp.int32))
+    return perm, count
+
+
+_batched_kernel = jax.jit(
+    jax.vmap(lambda a, t, u, o, s, tb, n: _materialize_kernel(a, t, u, o, s, tb, n=n),
+             in_axes=(0, 0, 0, 0, 0, 0, None)),
+    static_argnames=("n",),
+)
+
+
+def materialize_batch(rgas: Sequence[RGA]) -> List[List[str]]:
+    """Materialize many RGA lists in one device program.
+
+    Output is identical to calling ``rga.materialize()`` on each list
+    (property-tested against the host implementation).
+    """
+    if not rgas:
+        return []
+    all_elems = [r.elems for r in rgas]
+    n = bucket_size(max((len(e) for e in all_elems), default=1))
+    b = len(all_elems)
+
+    anchors = rank_intern([e.key.anchor for elems in all_elems for e in elems])[0]
+    authors = rank_intern([e.key.author for elems in all_elems for e in elems])[0]
+    opids = rank_intern([e.key.opid for elems in all_elems for e in elems])[0]
+
+    a = np.full((b, n), _PAD, np.int32)
+    t = np.full((b, n), _PAD, np.int32)
+    u = np.full((b, n), _PAD, np.int32)
+    o = np.full((b, n), _PAD, np.int32)
+    s = np.full((b, n), _PAD, np.int32)
+    tb = np.ones((b, n), bool)  # padding is tombstoned
+    flat = 0
+    for i, elems in enumerate(all_elems):
+        for j, e in enumerate(elems):
+            a[i, j] = anchors[flat]
+            u[i, j] = authors[flat]
+            o[i, j] = opids[flat]
+            t[i, j] = e.key.t
+            s[i, j] = j  # elems list order = converged insert order
+            tb[i, j] = e.tombstone
+            flat += 1
+
+    perm, count = _batched_kernel(a, t, u, o, s, tb, n)
+    perm = np.asarray(perm)
+    count = np.asarray(count)
+    out: List[List[str]] = []
+    for i, elems in enumerate(all_elems):
+        out.append([elems[perm[i, k]].value for k in range(int(count[i]))])
+    return out

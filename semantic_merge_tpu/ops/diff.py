@@ -1,0 +1,183 @@
+"""Batched declaration diff + lift on device.
+
+Replaces the reference worker's ``diffNodes`` hash-map join and ``lift``
+loop (reference ``workers/ts/src/diff.ts:5-31``,
+``workers/ts/src/lift.ts:11-66``) with a sort-join over interned int32
+ids, executed as one fused XLA program. The whole computation is
+data-parallel over decl slots — no Python loops, static shapes, ready
+to shard the slot axis across a mesh.
+
+JS ``Map`` semantics are reproduced exactly on device:
+
+- iteration order = first-occurrence order (a slot "emits" only if it
+  is the first slot with its symbol id);
+- duplicate keys keep the *last* value (per-slot data is gathered from
+  the last occurrence via a right-searchsorted into the stable
+  sort-by-symbol order);
+- the side list's ``add`` loop walks raw slots, so duplicate unseen
+  symbols emit repeatedly (reference ``workers/ts/src/diff.ts:24-28``).
+
+Emission layout parity (one op stream, same enumeration as the
+reference): per base symbol in map order — ``delete`` *or* (``move``
+then ``rename``) — followed by per-side-slot ``add`` ops.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.encode import NULL_ID, PAD_ID, DeclTensor, bucket_size, pad_to
+
+KIND_RENAME = 0
+KIND_MOVE = 1
+KIND_ADD = 2
+KIND_DELETE = 3
+
+
+@dataclass
+class DiffOpsTensor:
+    """Device-lifted op stream (struct of arrays, padded).
+
+    ``kind`` is ``-1`` on padding rows. ``a_*`` columns describe the
+    base-side node, ``b_*`` the side node; ``NULL_ID`` where absent.
+    Row order is exactly the reference's diff enumeration, so row index
+    == the deterministic-id sequence number.
+    """
+
+    kind: np.ndarray
+    sym: np.ndarray
+    a_addr: np.ndarray
+    a_name: np.ndarray
+    a_file: np.ndarray
+    b_addr: np.ndarray
+    b_name: np.ndarray
+    b_file: np.ndarray
+    n_ops: int
+
+
+def _occurrence_bounds(sym, order, sorted_sym, n_pad):
+    """For each slot: the first and last slot index holding its symbol."""
+    left = jnp.searchsorted(sorted_sym, sym, side="left")
+    right = jnp.searchsorted(sorted_sym, sym, side="right") - 1
+    left = jnp.clip(left, 0, n_pad - 1)
+    right = jnp.clip(right, 0, n_pad - 1)
+    first_idx = order[left]
+    last_idx = order[right]
+    return first_idx, last_idx
+
+
+@partial(jax.jit, static_argnames=("nb", "ns"))
+def _diff_lift_kernel(b_sym, b_addr, b_name, b_file,
+                      s_sym, s_addr, s_name, s_file,
+                      nb: int, ns: int):
+    idx_b = jnp.arange(nb, dtype=jnp.int32)
+    idx_s = jnp.arange(ns, dtype=jnp.int32)
+    b_valid = b_sym != PAD_ID
+    s_valid = s_sym != PAD_ID
+
+    # Stable sort by symbol: ties keep slot order, so right-1 = last occurrence.
+    b_order = jnp.argsort(b_sym, stable=True).astype(jnp.int32)
+    s_order = jnp.argsort(s_sym, stable=True).astype(jnp.int32)
+    b_sorted = b_sym[b_order]
+    s_sorted = s_sym[s_order]
+
+    b_first, b_last = _occurrence_bounds(b_sym, b_order, b_sorted, nb)
+
+    # Side representative (Map last-wins) for each base symbol.
+    pos = jnp.searchsorted(s_sorted, b_sym, side="right") - 1
+    pos_c = jnp.clip(pos, 0, ns - 1)
+    found = (pos >= 0) & (s_sorted[pos_c] == b_sym) & b_valid
+    s_repr = s_order[pos_c]
+
+    # Base-map emission: only the first occurrence emits; data from last.
+    emits = b_valid & (idx_b == b_first)
+    bl = b_last  # node data index (last occurrence)
+    b_addr_l = b_addr[bl]
+    b_name_l = b_name[bl]
+    b_file_l = b_file[bl]
+    s_addr_r = s_addr[s_repr]
+    s_name_r = s_name[s_repr]
+    s_file_r = s_file[s_repr]
+
+    is_delete = emits & ~found
+    is_move = emits & found & (b_addr_l != s_addr_r)
+    is_rename = (emits & found & (b_name_l != NULL_ID) & (s_name_r != NULL_ID)
+                 & (b_name_l != s_name_r))
+
+    # Adds: every raw side slot whose symbol is absent from base.
+    in_base = jnp.searchsorted(b_sorted, s_sym, side="left")
+    in_base_c = jnp.clip(in_base, 0, nb - 1)
+    present = b_sorted[in_base_c] == s_sym
+    is_add = s_valid & ~present
+
+    # Emission positions: per base slot `delete ? 1 : move+rename`,
+    # move before rename within a slot, adds after all base emissions.
+    base_count = jnp.where(is_delete, 1, is_move.astype(jnp.int32) + is_rename.astype(jnp.int32))
+    base_off = jnp.cumsum(base_count) - base_count
+    total_base = jnp.sum(base_count)
+    add_count = is_add.astype(jnp.int32)
+    add_off = total_base + jnp.cumsum(add_count) - add_count
+    n_ops = total_base + jnp.sum(add_count)
+
+    m = 2 * nb + ns  # static output capacity
+    neg = jnp.int32(NULL_ID)
+
+    def init(fill=neg):
+        return jnp.full((m,), fill, dtype=jnp.int32)
+
+    kind = init()
+    o_sym = init(); o_a_addr = init(); o_a_name = init(); o_a_file = init()
+    o_b_addr = init(); o_b_name = init(); o_b_file = init()
+
+    def scatter(arrs, posn, mask, values):
+        posn = jnp.where(mask, posn, m)  # out-of-range rows drop
+        out = []
+        for arr, val in zip(arrs, values):
+            out.append(arr.at[posn].set(val, mode="drop"))
+        return out
+
+    cols = [kind, o_sym, o_a_addr, o_a_name, o_a_file, o_b_addr, o_b_name, o_b_file]
+
+    # deletes (1 op at base_off)
+    cols = scatter(cols, base_off, is_delete,
+                   [jnp.full((nb,), KIND_DELETE, jnp.int32), b_sym, b_addr_l,
+                    b_name_l, b_file_l, jnp.full((nb,), neg), jnp.full((nb,), neg),
+                    jnp.full((nb,), neg)])
+    # moves (first in slot)
+    cols = scatter(cols, base_off, is_move,
+                   [jnp.full((nb,), KIND_MOVE, jnp.int32), b_sym, b_addr_l,
+                    b_name_l, b_file_l, s_addr_r, s_name_r, s_file_r])
+    # renames (after the move when both emit)
+    ren_pos = base_off + is_move.astype(jnp.int32)
+    cols = scatter(cols, ren_pos, is_rename,
+                   [jnp.full((nb,), KIND_RENAME, jnp.int32), b_sym, b_addr_l,
+                    b_name_l, b_file_l, s_addr_r, s_name_r, s_file_r])
+    # adds
+    cols = scatter(cols, add_off, is_add,
+                   [jnp.full((ns,), KIND_ADD, jnp.int32), s_sym,
+                    jnp.full((ns,), neg), jnp.full((ns,), neg), jnp.full((ns,), neg),
+                    s_addr, s_name, s_file])
+
+    return (*cols, n_ops)
+
+
+def diff_lift_device(base: DeclTensor, side: DeclTensor) -> DiffOpsTensor:
+    """Run the fused diff+lift program for one (base, side) pair."""
+    nb = bucket_size(max(base.n, 1))
+    ns = bucket_size(max(side.n, 1))
+    args = []
+    for t, size in ((base, nb), (side, ns)):
+        args += [pad_to(t.sym, size, PAD_ID), pad_to(t.addr, size, NULL_ID),
+                 pad_to(t.name, size, NULL_ID), pad_to(t.file, size, NULL_ID)]
+    out = _diff_lift_kernel(*args, nb=nb, ns=ns)
+    (kind, sym, a_addr, a_name, a_file, b_addr, b_name, b_file, n_ops) = out
+    return DiffOpsTensor(
+        kind=np.asarray(kind), sym=np.asarray(sym),
+        a_addr=np.asarray(a_addr), a_name=np.asarray(a_name), a_file=np.asarray(a_file),
+        b_addr=np.asarray(b_addr), b_name=np.asarray(b_name), b_file=np.asarray(b_file),
+        n_ops=int(n_ops),
+    )

@@ -9,7 +9,28 @@ import os
 import sys
 import pathlib
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Persistent XLA compilation cache: device-kernel tests compile a handful
+# of padded shapes; caching makes repeat suite runs take seconds.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/semmerge_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+# If a TPU plugin (e.g. an 'axon' loopback relay) was registered by a
+# sitecustomize hook, drop its factory so CPU-only tests never dial the
+# accelerator — backend discovery would otherwise block on the relay.
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    # jax may already be imported (a sitecustomize hook importing the
+    # plugin pulls jax in before conftest runs), so the env vars above
+    # were read too late — update the live config as well.
+    jax.config.update("jax_platforms", "cpu")
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu", "interpreter"):
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))

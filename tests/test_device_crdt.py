@@ -1,0 +1,38 @@
+"""Device RGA materialization parity vs the host CRDT."""
+import random
+
+from semantic_merge_tpu.core.crdt import RGA, Key
+from semantic_merge_tpu.ops.crdt import materialize_batch
+
+
+def test_empty_batch():
+    assert materialize_batch([]) == []
+
+
+def test_single_list_matches_host():
+    r = RGA()
+    r.insert(Key("a", 2, "u1", "op2"), "second")
+    r.insert(Key("a", 1, "u1", "op1"), "first")
+    r.delete("second")
+    assert materialize_batch([r]) == [r.materialize()]
+
+
+def test_fuzz_batch_matches_host():
+    rng = random.Random(3)
+    rgas = []
+    for _ in range(25):
+        r = RGA()
+        for _ in range(rng.randint(0, 9)):
+            k = Key(rng.choice("abc"), rng.randint(0, 3), rng.choice("uv"),
+                    f"op{rng.randint(0, 20)}")
+            v = f"val{rng.randint(0, 5)}"
+            action = rng.random()
+            if action < 0.6:
+                r.insert(k, v)
+            elif action < 0.8:
+                r.move(v, k)
+            else:
+                r.insert(k, v)
+                r.delete(f"val{rng.randint(0, 5)}")
+        rgas.append(r)
+    assert materialize_batch(rgas) == [r.materialize() for r in rgas]

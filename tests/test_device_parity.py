@@ -1,0 +1,182 @@
+"""Bit-parity tests: device pipeline vs host oracle.
+
+The TPU backend must produce byte-identical op logs, composed streams,
+and conflict records to the host implementations — the framework's
+equivalent of the reference BASELINE's "bit-identical op logs vs the
+Node worker" north star.
+"""
+import random
+
+import pytest
+
+from semantic_merge_tpu.backends.ts_host import HostTSBackend
+from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+from semantic_merge_tpu.core.compose import compose_oplogs
+from semantic_merge_tpu.core.ops import Op, Target
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+from semantic_merge_tpu.ops.compose import compose_oplogs_device
+
+
+def dicts(ops):
+    return [o.to_dict() for o in ops]
+
+
+def mk(op_type, sym, params=None, ts="2024-01-01T00:00:00Z", op_id=None, addr=None):
+    return Op.new(op_type, Target(symbolId=sym, addressId=addr),
+                  params=params or {}, provenance={"timestamp": ts}, op_id=op_id)
+
+
+@pytest.fixture(scope="module")
+def backends():
+    return HostTSBackend(), TpuTSBackend()
+
+
+def snap(files):
+    return Snapshot(files=[{"path": p, "content": c} for p, c in files.items()])
+
+
+class TestDiffLiftParity:
+    def test_rename_move_add_delete(self, backends):
+        host, tpu = backends
+        base = snap({
+            "src/util.ts": "export function foo(n: number): number { return n; }\n"
+                           "export function keep(s: string): string { return s; }\n",
+            "src/other.ts": "class P { x = 1; }\nconst a = 1;\n",
+        })
+        left = snap({
+            "src/util.ts": "export function bar(n: number): number { return n; }\n"
+                           "export function keep(s: string): string { return s; }\n",
+            "src/other.ts": "class P { x = 1; }\nconst a = 1;\n",
+        })
+        right = snap({
+            "lib/util.ts": "export function foo(n: number): number { return n; }\n"
+                           "export function keep(s: string): string { return s; }\n",
+            "src/other.ts": "class P { x = 1; }\nconst a = 1;\nenum E { A, B }\n",
+        })
+        h = host.build_and_diff(base, left, right, base_rev="rev", seed="s", timestamp="T")
+        t = tpu.build_and_diff(base, left, right, base_rev="rev", seed="s", timestamp="T")
+        assert dicts(h.op_log_left) == dicts(t.op_log_left)
+        assert dicts(h.op_log_right) == dicts(t.op_log_right)
+        assert h.symbol_maps == t.symbol_maps
+
+    def test_duplicate_symbol_collisions(self, backends):
+        host, tpu = backends
+        # Same-shape decls collide (class{1} == class{1}); Map last-wins
+        # must hold on device too.
+        base = snap({"a.ts": "class A { x = 1; }\nclass B { y = 2; }\n"})
+        side = snap({"a.ts": "class A { x = 1; }\nclass C { z = 9; }\nclass D { w = 0; }\n"})
+        h = host.diff(base, side, base_rev="r", seed="s", timestamp="T")
+        t = tpu.diff(base, side, base_rev="r", seed="s", timestamp="T")
+        assert dicts(h) == dicts(t)
+
+    def test_empty_and_identical_snapshots(self, backends):
+        host, tpu = backends
+        empty = snap({})
+        same = snap({"a.ts": "export function f(): void {}\n"})
+        for b, s in [(empty, same), (same, empty), (same, same), (empty, empty)]:
+            h = host.diff(b, s, base_rev="r", seed="s", timestamp="T")
+            t = tpu.diff(b, s, base_rev="r", seed="s", timestamp="T")
+            assert dicts(h) == dicts(t)
+
+    def test_many_files_fuzz(self, backends):
+        host, tpu = backends
+        rng = random.Random(13)
+        names = ["alpha", "beta", "gamma", "delta", "eps"]
+        def gen(n_files, shift):
+            files = {}
+            for i in range(n_files):
+                decls = []
+                for j in range(rng.randint(0, 4)):
+                    nm = rng.choice(names) + str(j + shift)
+                    ty = rng.choice(["number", "string", "boolean"])
+                    decls.append(f"export function {nm}(x: {ty}): {ty} {{ return x; }}")
+                files[f"f{i}.ts"] = "\n".join(decls) + "\n"
+            return snap(files)
+        for trial in range(5):
+            base = gen(rng.randint(1, 6), 0)
+            side = gen(rng.randint(1, 6), rng.randint(0, 1))
+            h = host.diff(base, side, base_rev="r", seed="s", timestamp="T")
+            t = tpu.diff(base, side, base_rev="r", seed="s", timestamp="T")
+            assert dicts(h) == dicts(t), f"trial {trial}"
+
+
+class TestComposeParity:
+    def test_rename_vs_move_chain(self):
+        rename = mk("renameSymbol", "sym-1",
+                    {"oldName": "foo", "newName": "bar", "file": "src/util.ts"},
+                    op_id="a" * 32)
+        move = mk("moveDecl", "sym-1",
+                  {"oldFile": "src/util.ts", "newFile": "lib/util.ts",
+                   "oldAddress": "src/util.ts::foo::0",
+                   "newAddress": "lib/util.ts::foo::0"}, op_id="b" * 32)
+        h = compose_oplogs([rename], [move])
+        d = compose_oplogs_device([rename], [move])
+        assert dicts(h[0]) == dicts(d[0])
+        assert [c.to_dict() for c in h[1]] == [c.to_dict() for c in d[1]]
+
+    def test_divergent_rename_conflict(self):
+        ra = mk("renameSymbol", "s", {"newName": "x"}, op_id="1" * 32)
+        rb = mk("renameSymbol", "s", {"newName": "y"}, op_id="2" * 32)
+        h = compose_oplogs([ra], [rb])
+        d = compose_oplogs_device([ra], [rb])
+        assert dicts(h[0]) == dicts(d[0])
+        assert [c.to_dict() for c in h[1]] == [c.to_dict() for c in d[1]]
+
+    def test_masked_conflict_quirk(self):
+        ra = mk("renameSymbol", "s", {"newName": "x"}, op_id="1" * 32)
+        ob = mk("renameSymbol", "unrelated", {"newName": "n"}, op_id="2" * 32)
+        rb = mk("renameSymbol", "s", {"newName": "y"}, op_id="3" * 32)
+        h = compose_oplogs([ra], [ob, rb])
+        d = compose_oplogs_device([ra], [ob, rb])
+        assert dicts(h[0]) == dicts(d[0])
+        assert len(h[1]) == len(d[1]) == 0
+
+    def test_newname_type_sensitivity(self):
+        # The host conflict check compares raw values: 1 != "1" conflicts,
+        # 1 == 1.0 does not. The device equality_key encoding must agree.
+        ra = mk("renameSymbol", "s", {"newName": 1}, op_id="1" * 32)
+        rb = mk("renameSymbol", "s", {"newName": "1"}, op_id="2" * 32)
+        assert len(compose_oplogs([ra], [rb])[1]) == len(compose_oplogs_device([ra], [rb])[1]) == 1
+        rc = mk("renameSymbol", "s", {"newName": 1.0}, op_id="3" * 32)
+        assert len(compose_oplogs([ra], [rc])[1]) == len(compose_oplogs_device([ra], [rc])[1]) == 0
+
+    def test_empty_newfile_falls_back_to_file(self):
+        # Host move-chain uses truthiness: newFile="" falls back to file.
+        m = mk("moveDecl", "s", {"newAddress": "A2", "newFile": "", "file": "x.ts"},
+               op_id="3" * 32)
+        later = mk("editStmtBlock", "s", {}, op_id="4" * 32)
+        h = compose_oplogs([m, later], [])
+        d = compose_oplogs_device([m, later], [])
+        assert dicts(h[0]) == dicts(d[0])
+        assert h[0][0].params["newFile"] == "x.ts"
+
+    def test_fuzz_parity(self):
+        rng = random.Random(7)
+        types = ["renameSymbol", "moveDecl", "addDecl", "deleteDecl",
+                 "editStmtBlock", "modifyImport"]
+
+        def rand_op(i, side):
+            t = rng.choice(types)
+            sym = f"sym-{rng.randint(0, 5)}"
+            params = {}
+            if t == "renameSymbol":
+                params = {"oldName": "o", "newName": rng.choice(["p", "q", "r"]),
+                          "file": f"f{rng.randint(0, 3)}.ts"}
+            elif t == "moveDecl":
+                if rng.random() < 0.8:
+                    params["newAddress"] = f"addr-{rng.randint(0, 9)}"
+                if rng.random() < 0.5:
+                    params["newFile"] = f"g{rng.randint(0, 3)}.ts"
+                elif rng.random() < 0.5:
+                    params["file"] = f"h{rng.randint(0, 3)}.ts"
+            ts = rng.choice(["2024-01-01T00:00:00Z", "2024-06-01T00:00:00Z"])
+            return mk(t, sym, params, ts=ts, op_id=f"{side}{i:03d}" + "0" * 28,
+                      addr=f"base-addr-{i}")
+
+        for trial in range(20):
+            A = [rand_op(i, "a") for i in range(rng.randint(0, 12))]
+            B = [rand_op(i, "b") for i in range(rng.randint(0, 12))]
+            h = compose_oplogs(A, B)
+            d = compose_oplogs_device(A, B)
+            assert dicts(h[0]) == dicts(d[0]), f"trial {trial}"
+            assert [c.to_dict() for c in h[1]] == [c.to_dict() for c in d[1]], f"trial {trial}"

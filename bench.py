@@ -1,0 +1,152 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Metric (per ``BASELINE.json``): files merged/sec/chip on a synthetic
+multi-file TypeScript 3-way merge. The workload mirrors the reference's
+measurement ladder rung 2-3 (100s of files, independent renames on side
+A, cross-file moves on side B, a few adds/deletes). Baseline is the
+pure-Python host path — the stand-in for the reference's per-file Node
+worker (`workers/ts/src/{sast,diff,lift}.ts` + `semmerge/compose.py`),
+which cannot run here (no Node in the image). ``vs_baseline`` is the
+TPU-path speedup over that host path on the identical workload.
+
+Usage: ``python bench.py [--files N] [--decls N] [--json-only]``
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/semmerge_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from semantic_merge_tpu.frontend.snapshot import Snapshot  # noqa: E402
+
+
+_SIG_TYPES = ("string", "number", "boolean", "bigint", "symbol", "object",
+              "unknown", "never", "void", "undefined", "null")
+
+
+def _unique_params(idx: int) -> str:
+    """Param list whose *types* encode ``idx`` in base-11, so every decl
+    gets a unique name-free structural signature (symbolId is computed
+    from param/return types only — same-shape decls collide, a
+    reference quirk the workload must avoid to stay per-file)."""
+    digits = []
+    for _ in range(4):
+        digits.append(_SIG_TYPES[idx % len(_SIG_TYPES)])
+        idx //= len(_SIG_TYPES)
+    return ", ".join(f"p{k}: {t}" for k, t in enumerate(digits))
+
+
+def synth_repo(n_files: int, decls_per_file: int):
+    """Three snapshots of an ``n_files`` TS repo.
+
+    Side A renames one function per even-indexed file; side B moves
+    every odd-indexed file into ``lib/`` (a cross-file decl move, the
+    flagship scenario of the reference's ``tests/e2e_basic.sh``); a few
+    files gain or lose a declaration so every diff kind appears.
+    """
+    base, left, right = [], [], []
+    for i in range(n_files):
+        path = f"src/mod{i:05d}.ts"
+        decls = []
+        for d in range(decls_per_file):
+            params = _unique_params(i * decls_per_file + d)
+            decls.append(f"export function fn{i}_{d}({params}): number {{ return {d}; }}")
+        content = "\n".join(decls) + "\n"
+        base.append({"path": path, "content": content})
+
+        if i % 2 == 0:
+            left.append({"path": path,
+                         "content": content.replace(f"function fn{i}_0(",
+                                                    f"function renamed{i}_0(")})
+        elif i % 17 == 0:
+            left.append({"path": path, "content": content +
+                         f"export function added{i}(x: string): string {{ return x; }}\n"})
+        else:
+            left.append({"path": path, "content": content})
+
+        if i % 2 == 1:
+            right.append({"path": f"lib/mod{i:05d}.ts", "content": content})
+        elif i % 23 == 0:
+            lines = content.splitlines(keepends=True)
+            right.append({"path": path, "content": "".join(lines[1:])})
+        else:
+            right.append({"path": path, "content": content})
+    return Snapshot(files=base), Snapshot(files=left), Snapshot(files=right)
+
+
+def run_merge(backend, base, left, right):
+    result = backend.build_and_diff(base, left, right, base_rev="bench",
+                                    seed="bench", timestamp="2026-01-01T00:00:00Z")
+    composed, conflicts = backend.compose(result.op_log_left, result.op_log_right)
+    return result, composed, conflicts
+
+
+def time_merge(backend, base, left, right, *, repeats: int = 3) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run_merge(backend, base, left, right)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--files", type=int, default=512)
+    parser.add_argument("--decls", type=int, default=6)
+    parser.add_argument("--json-only", action="store_true")
+    args = parser.parse_args()
+
+    from semantic_merge_tpu.backends.base import get_backend
+
+    base, left, right = synth_repo(args.files, args.decls)
+
+    tpu = get_backend("tpu")
+    host = get_backend("host")
+
+    # Parity gate: the bench number is meaningless if the device path
+    # diverges from the oracle.
+    res_t, comp_t, conf_t = run_merge(tpu, base, left, right)
+    res_h, comp_h, conf_h = run_merge(host, base, left, right)
+    parity = (
+        [o.to_dict() for o in res_t.op_log_left] == [o.to_dict() for o in res_h.op_log_left]
+        and [o.to_dict() for o in res_t.op_log_right] == [o.to_dict() for o in res_h.op_log_right]
+        and [o.to_dict() for o in comp_t] == [o.to_dict() for o in comp_h]
+    )
+
+    tpu_s = time_merge(tpu, base, left, right)
+    host_s = time_merge(host, base, left, right)
+
+    import jax
+    platform = jax.devices()[0].platform
+
+    files_per_sec = args.files / tpu_s
+    vs_baseline = (args.files / tpu_s) / (args.files / host_s)
+    record = {
+        "metric": "files merged/sec/chip (synthetic 3-way TS merge, "
+                  f"{args.files} files x {args.decls} decls, parity="
+                  f"{'ok' if parity else 'FAIL'}, platform={platform})",
+        "value": round(files_per_sec, 2),
+        "unit": "files/sec",
+        "vs_baseline": round(vs_baseline, 3),
+    }
+    if not args.json_only:
+        print(f"# tpu path:  {tpu_s*1e3:8.1f} ms  ({args.files/tpu_s:9.1f} files/s)",
+              file=sys.stderr)
+        print(f"# host path: {host_s*1e3:8.1f} ms  ({args.files/host_s:9.1f} files/s)",
+              file=sys.stderr)
+        print(f"# composed ops: {len(comp_t)}  conflicts: {len(conf_t)}  parity: {parity}",
+              file=sys.stderr)
+    print(json.dumps(record))
+    return 0 if parity else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,12 +19,20 @@ from ..core.ops import Op, Target
 from ..frontend.scanner import scan_snapshot
 from ..frontend.snapshot import Snapshot
 from ..ops.diff import (KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME,
-                        DiffOpsTensor, diff_lift_device)
+                        DiffOpsTensor, diff_lift_device, diff_lift_device_pair)
 from .base import BuildAndDiffResult, register_backend, symbol_map
 
 
 class TpuTSBackend:
     name = "tpu"
+
+    def __init__(self) -> None:
+        # Probe JAX init at construction so the CLI's host-fallback path
+        # (cli._resolve_backend) catches a broken plugin/runtime here
+        # instead of deep inside the first merge. XLA-on-CPU (no
+        # accelerator present) is a supported degraded mode, not an error.
+        import jax
+        jax.devices()
 
     def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
                        *, base_rev: str = "base", seed: str = "0",
@@ -37,10 +45,9 @@ class TpuTSBackend:
         base_t = encode_decls(base_nodes, interner)
         left_t = encode_decls(left_nodes, interner)
         right_t = encode_decls(right_nodes, interner)
-        ops_l = decode_diff_ops(diff_lift_device(base_t, left_t), interner,
-                                base_rev, seed + "/L", ts)
-        ops_r = decode_diff_ops(diff_lift_device(base_t, right_t), interner,
-                                base_rev, seed + "/R", ts)
+        t_l, t_r = diff_lift_device_pair(base_t, left_t, right_t)
+        ops_l = decode_diff_ops(t_l, interner, base_rev, seed + "/L", ts)
+        ops_r = decode_diff_ops(t_r, interner, base_rev, seed + "/R", ts)
         return BuildAndDiffResult(
             op_log_left=ops_l,
             op_log_right=ops_r,

@@ -221,10 +221,24 @@ def _compose_kernel(a_cols, b_cols, n_a, n_b, na: int, nb: int):
     out_chain_file = out_chain_file.at[pos].set(chain_file[merged_order], mode="drop")
     out_chain_name = out_chain_name.at[pos].set(chain_name[merged_order], mode="drop")
 
+    # Stack everything into one int32 matrix so the host fetches the
+    # result in a single device→host transfer (per-fetch latency on a
+    # remote tunnel dwarfs per-byte cost). Short rows pad with NULL_ID;
+    # scalars broadcast across their row.
     a_op_index = a["op_index"]
     b_op_index = b["op_index"]
-    return (out_side, out_row, out_chain_addr, out_chain_file, out_chain_name,
-            n_out, conf_a, conf_b, n_conf, a_op_index, b_op_index)
+
+    def row(arr):
+        return jnp.pad(arr.astype(jnp.int32), (0, total - arr.shape[0]),
+                       constant_values=NULL_ID)
+
+    return jnp.stack([
+        out_side, out_row, out_chain_addr, out_chain_file, out_chain_name,
+        jnp.full((total,), n_out, jnp.int32),
+        row(conf_a), row(conf_b),
+        jnp.full((total,), n_conf, jnp.int32),
+        row(a_op_index), row(b_op_index),
+    ])
 
 
 def compose_oplogs_device(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List[Conflict]]:
@@ -237,13 +251,15 @@ def compose_oplogs_device(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op
     tb = encode_oplog(delta_b, interner, ts_table, id_table)
     na = bucket_size(max(ta.n, 1))
     nb = bucket_size(max(tb.n, 1))
-    out = _compose_kernel(_pad_op_tensor(ta, na), _pad_op_tensor(tb, nb),
-                          np.int32(ta.n), np.int32(tb.n), na, nb)
+    out = np.asarray(_compose_kernel(
+        _pad_op_tensor(ta, na), _pad_op_tensor(tb, nb),
+        np.int32(ta.n), np.int32(tb.n), na, nb))
     (out_side, out_row, chain_addr, chain_file, chain_name,
-     n_out, conf_a, conf_b, n_conf, a_op_index, b_op_index) = map(np.asarray, out)
+     n_out_row, conf_a, conf_b, n_conf_row, a_op_index, b_op_index) = out
+    n_out, n_conf = n_out_row[0], n_conf_row[0]
 
-    sorted_a = [delta_a[i] for i in a_op_index if i != NULL_ID]
-    sorted_b = [delta_b[i] for i in b_op_index if i != NULL_ID]
+    sorted_a = [delta_a[i] for i in a_op_index[:na] if i != NULL_ID]
+    sorted_b = [delta_b[i] for i in b_op_index[:nb] if i != NULL_ID]
 
     composed: List[Op] = []
     for k in range(int(n_out)):

@@ -70,10 +70,9 @@ def _occurrence_bounds(sym, order, sorted_sym, n_pad):
     return first_idx, last_idx
 
 
-@partial(jax.jit, static_argnames=("nb", "ns"))
-def _diff_lift_kernel(b_sym, b_addr, b_name, b_file,
-                      s_sym, s_addr, s_name, s_file,
-                      nb: int, ns: int):
+def _diff_lift_core(b_sym, b_addr, b_name, b_file,
+                    s_sym, s_addr, s_name, s_file,
+                    nb: int, ns: int):
     idx_b = jnp.arange(nb, dtype=jnp.int32)
     idx_s = jnp.arange(ns, dtype=jnp.int32)
     b_valid = b_sym != PAD_ID
@@ -162,22 +161,69 @@ def _diff_lift_kernel(b_sym, b_addr, b_name, b_file,
                     jnp.full((ns,), neg), jnp.full((ns,), neg), jnp.full((ns,), neg),
                     s_addr, s_name, s_file])
 
-    return (*cols, n_ops)
+    # One stacked int32 matrix so the host retrieves the whole op stream
+    # in a single device→host transfer (remote-tunnel latency is per
+    # fetch, not per byte): rows 0-7 = columns, row 8 = n_ops broadcast.
+    return jnp.concatenate(
+        [jnp.stack(cols), jnp.full((1, m), n_ops, jnp.int32)], axis=0)
+
+
+@partial(jax.jit, static_argnames=("nb", "ns"))
+def _diff_lift_kernel(b_sym, b_addr, b_name, b_file,
+                      s_sym, s_addr, s_name, s_file,
+                      nb: int, ns: int):
+    return _diff_lift_core(b_sym, b_addr, b_name, b_file,
+                           s_sym, s_addr, s_name, s_file, nb, ns)
+
+
+@partial(jax.jit, static_argnames=("nb", "nl", "nr"))
+def _diff_lift_pair_kernel(b_sym, b_addr, b_name, b_file,
+                           l_sym, l_addr, l_name, l_file,
+                           r_sym, r_addr, r_name, r_file,
+                           nb: int, nl: int, nr: int):
+    """Both sides of a 3-way merge in one program → one output fetch."""
+    out_l = _diff_lift_core(b_sym, b_addr, b_name, b_file,
+                            l_sym, l_addr, l_name, l_file, nb, nl)
+    out_r = _diff_lift_core(b_sym, b_addr, b_name, b_file,
+                            r_sym, r_addr, r_name, r_file, nb, nr)
+    m = max(out_l.shape[1], out_r.shape[1])
+
+    def pad(a):
+        return jnp.pad(a, ((0, 0), (0, m - a.shape[1])),
+                       constant_values=NULL_ID)
+
+    return jnp.stack([pad(out_l), pad(out_r)])
+
+
+def _decode_stacked(out: np.ndarray) -> DiffOpsTensor:
+    (kind, sym, a_addr, a_name, a_file, b_addr, b_name, b_file) = out[:8]
+    return DiffOpsTensor(
+        kind=kind, sym=sym, a_addr=a_addr, a_name=a_name, a_file=a_file,
+        b_addr=b_addr, b_name=b_name, b_file=b_file, n_ops=int(out[8, 0]),
+    )
+
+
+def _padded_cols(t: DeclTensor, size: int):
+    return [pad_to(t.sym, size, PAD_ID), pad_to(t.addr, size, NULL_ID),
+            pad_to(t.name, size, NULL_ID), pad_to(t.file, size, NULL_ID)]
 
 
 def diff_lift_device(base: DeclTensor, side: DeclTensor) -> DiffOpsTensor:
     """Run the fused diff+lift program for one (base, side) pair."""
     nb = bucket_size(max(base.n, 1))
     ns = bucket_size(max(side.n, 1))
-    args = []
-    for t, size in ((base, nb), (side, ns)):
-        args += [pad_to(t.sym, size, PAD_ID), pad_to(t.addr, size, NULL_ID),
-                 pad_to(t.name, size, NULL_ID), pad_to(t.file, size, NULL_ID)]
-    out = _diff_lift_kernel(*args, nb=nb, ns=ns)
-    (kind, sym, a_addr, a_name, a_file, b_addr, b_name, b_file, n_ops) = out
-    return DiffOpsTensor(
-        kind=np.asarray(kind), sym=np.asarray(sym),
-        a_addr=np.asarray(a_addr), a_name=np.asarray(a_name), a_file=np.asarray(a_file),
-        b_addr=np.asarray(b_addr), b_name=np.asarray(b_name), b_file=np.asarray(b_file),
-        n_ops=int(n_ops),
-    )
+    out = _diff_lift_kernel(*_padded_cols(base, nb), *_padded_cols(side, ns),
+                            nb=nb, ns=ns)
+    return _decode_stacked(np.asarray(out))
+
+
+def diff_lift_device_pair(base: DeclTensor, left: DeclTensor,
+                          right: DeclTensor) -> tuple[DiffOpsTensor, DiffOpsTensor]:
+    """Diff both sides against base in one device call (one fetch)."""
+    nb = bucket_size(max(base.n, 1))
+    nl = bucket_size(max(left.n, 1))
+    nr = bucket_size(max(right.n, 1))
+    out = np.asarray(_diff_lift_pair_kernel(
+        *_padded_cols(base, nb), *_padded_cols(left, nl),
+        *_padded_cols(right, nr), nb=nb, nl=nl, nr=nr))
+    return _decode_stacked(out[0]), _decode_stacked(out[1])

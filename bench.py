@@ -31,15 +31,17 @@ _SIG_TYPES = ("string", "number", "boolean", "bigint", "symbol", "object",
               "unknown", "never", "void", "undefined", "null")
 
 
-def _unique_params(idx: int) -> str:
+def _unique_params(idx: int, n_digits: int) -> str:
     """Param list whose *types* encode ``idx`` in base-11, so every decl
     gets a unique name-free structural signature (symbolId is computed
     from param/return types only — same-shape decls collide, a
-    reference quirk the workload must avoid to stay per-file)."""
+    reference quirk the workload must avoid to stay per-file).
+    ``n_digits`` must cover the largest index used."""
     digits = []
-    for _ in range(4):
+    for _ in range(n_digits):
         digits.append(_SIG_TYPES[idx % len(_SIG_TYPES)])
         idx //= len(_SIG_TYPES)
+    assert idx == 0, "index exceeds signature capacity"
     return ", ".join(f"p{k}: {t}" for k, t in enumerate(digits))
 
 
@@ -51,12 +53,16 @@ def synth_repo(n_files: int, decls_per_file: int):
     flagship scenario of the reference's ``tests/e2e_basic.sh``); a few
     files gain or lose a declaration so every diff kind appears.
     """
+    total = n_files * decls_per_file
+    n_digits = 1
+    while len(_SIG_TYPES) ** n_digits < total:
+        n_digits += 1
     base, left, right = [], [], []
     for i in range(n_files):
         path = f"src/mod{i:05d}.ts"
         decls = []
         for d in range(decls_per_file):
-            params = _unique_params(i * decls_per_file + d)
+            params = _unique_params(i * decls_per_file + d, n_digits)
             decls.append(f"export function fn{i}_{d}({params}): number {{ return {d}; }}")
         content = "\n".join(decls) + "\n"
         base.append({"path": path, "content": content})
@@ -119,6 +125,7 @@ def main() -> int:
         [o.to_dict() for o in res_t.op_log_left] == [o.to_dict() for o in res_h.op_log_left]
         and [o.to_dict() for o in res_t.op_log_right] == [o.to_dict() for o in res_h.op_log_right]
         and [o.to_dict() for o in comp_t] == [o.to_dict() for o in comp_h]
+        and [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_h]
     )
 
     tpu_s = time_merge(tpu, base, left, right)

@@ -1,0 +1,16 @@
+"""Neural models for similarity matching.
+
+The reference's P1 design calls for a *similarity matcher* that pairs
+declarations across revisions when exact structural signatures diverge
+(reference ``architecture.md:145-153``: "similarity matching on
+normalized bodies"; the live differ's TODO at
+``implementation.md:902`` — ``changeSig`` is never emitted because
+there is no matcher). This package is the TPU-native answer: a
+sequence encoder over declaration token streams producing embeddings
+whose cosine similarity drives rename/changeSignature matching at
+repo scale, trained and served across a device mesh (DP/TP/PP/SP/EP —
+see :mod:`semantic_merge_tpu.parallel.mesh`).
+"""
+from .encoder import EncoderConfig, init_encoder, encoder_forward  # noqa: F401
+from .matcher import (MatcherConfig, init_matcher, make_scorer,  # noqa: F401
+                      make_sharded_train_step, train_step)

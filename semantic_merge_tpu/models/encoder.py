@@ -1,0 +1,127 @@
+"""Declaration-token sequence encoder (pure JAX, mesh-sharded).
+
+A compact pre-norm transformer encoder designed TPU-first:
+
+- all matmuls in bfloat16 with float32 accumulation (MXU-shaped);
+- attention is :func:`semantic_merge_tpu.parallel.ring.ring_attention`
+  — sequence-parallel over the ``sp`` mesh axis, so files longer than
+  one device's token budget shard block-wise instead of OOMing;
+- the FFN is a soft-merged mixture of experts whose expert axis shards
+  over ``ep`` (XLA inserts the psum);
+- layers are stacked on a leading axis sharded over ``pp`` and driven
+  by ``lax.scan`` — stage-parallel execution without Python loops;
+- heads/hidden features shard over ``tp``; batch over ``dp``.
+
+Sharding specs for every parameter live in :func:`param_specs`, so
+training and inference jit with identical layouts.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import MergeMesh
+from ..parallel.ring import ring_attention
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    vocab: int = 4096
+    d_model: int = 256
+    n_heads: int = 8
+    d_head: int = 32
+    n_layers: int = 4
+    d_ff: int = 512
+    n_experts: int = 4
+    dtype: Any = jnp.bfloat16
+
+
+def init_encoder(rng: jax.Array, cfg: EncoderConfig) -> Dict[str, jax.Array]:
+    """Parameter pytree. Layer params carry a leading ``n_layers`` axis
+    (the ``pp`` shard axis)."""
+    k_emb, k_q, k_k, k_v, k_o, k_g, k_w1, k_w2 = jax.random.split(rng, 8)
+    L, D, H, Dh, F, E = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                         cfg.d_head, cfg.d_ff, cfg.n_experts)
+
+    def dense(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)
+
+    return {
+        "embed": dense(k_emb, (cfg.vocab, D), D),
+        "wq": dense(k_q, (L, D, H, Dh), D),
+        "wk": dense(k_k, (L, D, H, Dh), D),
+        "wv": dense(k_v, (L, D, H, Dh), D),
+        "wo": dense(k_o, (L, H, Dh, D), H * Dh),
+        "gate": dense(k_g, (L, D, E), D),
+        "w1": dense(k_w1, (L, E, D, F), D),
+        "w2": dense(k_w2, (L, E, F, D), F),
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "ln2": jnp.ones((L, D), jnp.float32),
+        "ln_out": jnp.ones((D,), jnp.float32),
+    }
+
+
+def param_specs(cfg: EncoderConfig) -> Dict[str, P]:
+    """PartitionSpec per parameter — the single source of truth for the
+    model's mesh layout."""
+    return {
+        "embed": P(None, "tp"),
+        "wq": P("pp", None, "tp", None),
+        "wk": P("pp", None, "tp", None),
+        "wv": P("pp", None, "tp", None),
+        "wo": P("pp", "tp", None, None),
+        "gate": P("pp", None, "ep"),
+        "w1": P("pp", "ep", None, "tp"),
+        "w2": P("pp", "ep", "tp", None),
+        "ln1": P("pp", None),
+        "ln2": P("pp", None),
+        "ln_out": P(None),
+    }
+
+
+ACT_SPEC = P("dp", "sp", None)      # activations (B, L, D)
+TOK_SPEC = P("dp", "sp")            # token ids / mask (B, L)
+
+
+def _rms_norm(x, scale):
+    x32 = x.astype(jnp.float32)
+    rms = jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (x32 / rms * scale).astype(x.dtype)
+
+
+def encoder_forward(params: Dict[str, jax.Array], tokens: jax.Array,
+                    mask: jax.Array, cfg: EncoderConfig,
+                    mesh: MergeMesh) -> jax.Array:
+    """tokens (B, L) int32, mask (B, L) bool → hidden states (B, L, D)."""
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = x * mask[..., None].astype(cfg.dtype)
+
+    def layer(x, lp):
+        h = _rms_norm(x, lp["ln1"])
+        q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(cfg.dtype))
+        k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(cfg.dtype))
+        v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(cfg.dtype))
+        attn = ring_attention(q, k, v, mask, mesh.mesh)
+        x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(cfg.dtype))
+
+        h = _rms_norm(x, lp["ln2"])
+        # Soft-merged MoE: every expert computes, outputs blend by the
+        # gate distribution. Dense on purpose — static shapes, no
+        # data-dependent routing, expert axis shards over `ep`.
+        gate = jax.nn.softmax(
+            jnp.einsum("bld,de->ble", h, lp["gate"].astype(cfg.dtype))
+            .astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        up = jax.nn.gelu(jnp.einsum("bld,edf->blef", h, lp["w1"].astype(cfg.dtype)))
+        down = jnp.einsum("blef,efd->bled", up, lp["w2"].astype(cfg.dtype))
+        x = x + jnp.einsum("bled,ble->bld", down, gate)
+        return x, None
+
+    layer_params = {k: params[k] for k in
+                    ("wq", "wk", "wv", "wo", "gate", "w1", "w2", "ln1", "ln2")}
+    x, _ = lax.scan(lambda carry, lp: layer(carry, lp), x, layer_params)
+    return _rms_norm(x, params["ln_out"])

@@ -1,0 +1,109 @@
+"""Similarity matcher: embeddings, contrastive training, pair scoring.
+
+The model half of changeSignature detection (reference design
+``architecture.md:145-153``; the live differ reports a changed
+signature as delete+add — SURVEY.md §3.4). Declarations embed via the
+encoder; matched pairs (rename/edit survivors) train with a symmetric
+InfoNCE loss so that edited-but-same declarations land close and
+unrelated ones far. Inference scores candidate (deleted, added) pairs
+by cosine similarity; the differ accepts matches above a threshold.
+
+Everything jits against the shardings in
+:func:`semantic_merge_tpu.models.encoder.param_specs` — the same code
+runs single-chip or across a dp/pp/sp/tp/ep mesh.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..parallel.mesh import MergeMesh
+from .encoder import (ACT_SPEC, TOK_SPEC, EncoderConfig, encoder_forward,
+                      init_encoder, param_specs)
+
+
+@dataclass(frozen=True)
+class MatcherConfig:
+    encoder: EncoderConfig = EncoderConfig()
+    temperature: float = 0.07
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.01
+
+
+def init_matcher(rng: jax.Array, cfg: MatcherConfig):
+    params = init_encoder(rng, cfg.encoder)
+    tx = optimizer(cfg)
+    return params, tx.init(params)
+
+
+def optimizer(cfg: MatcherConfig) -> optax.GradientTransformation:
+    return optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
+
+
+def embed(params, tokens, mask, cfg: EncoderConfig, mesh: MergeMesh) -> jax.Array:
+    """(B, L) tokens → (B, D) L2-normalized embeddings (masked mean pool)."""
+    h = encoder_forward(params, tokens, mask, cfg, mesh).astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(axis=-1, keepdims=True), 1).astype(jnp.float32)
+    pooled = (h * mask[..., None]).sum(axis=1) / denom
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-6)
+
+
+def info_nce(za: jax.Array, zb: jax.Array, temperature: float) -> jax.Array:
+    """Symmetric InfoNCE: row i of ``za`` matches row i of ``zb``."""
+    logits = za @ zb.T / temperature
+    labels = jnp.arange(za.shape[0])
+    loss_ab = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    loss_ba = optax.softmax_cross_entropy_with_integer_labels(logits.T, labels)
+    return (loss_ab.mean() + loss_ba.mean()) / 2
+
+
+def loss_fn(params, batch, cfg: MatcherConfig, mesh: MergeMesh) -> jax.Array:
+    za = embed(params, batch["tokens_a"], batch["mask_a"], cfg.encoder, mesh)
+    zb = embed(params, batch["tokens_b"], batch["mask_b"], cfg.encoder, mesh)
+    return info_nce(za, zb, cfg.temperature)
+
+
+def train_step(params, opt_state, batch, cfg: MatcherConfig, mesh: MergeMesh):
+    """One full training step: forward, backward, AdamW update."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+    updates, opt_state = optimizer(cfg).update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def make_sharded_train_step(cfg: MatcherConfig, mesh: MergeMesh):
+    """Jit ``train_step`` with the canonical mesh shardings."""
+    specs = param_specs(cfg.encoder)
+    p_shard = {k: mesh.sharding(*spec) for k, spec in specs.items()}
+    batch_shard = {
+        "tokens_a": mesh.sharding(*TOK_SPEC), "mask_a": mesh.sharding(*TOK_SPEC),
+        "tokens_b": mesh.sharding(*TOK_SPEC), "mask_b": mesh.sharding(*TOK_SPEC),
+    }
+    step = partial(train_step, cfg=cfg, mesh=mesh)
+    return jax.jit(
+        step,
+        in_shardings=(p_shard, None, batch_shard),
+        out_shardings=(p_shard, None, None),
+        donate_argnums=(0, 1),
+    )
+
+
+def make_scorer(cfg: MatcherConfig, mesh: MergeMesh):
+    """Jitted cosine-similarity scorer for candidate decl pairs."""
+    specs = param_specs(cfg.encoder)
+    p_shard = {k: mesh.sharding(*spec) for k, spec in specs.items()}
+    tok = mesh.sharding(*TOK_SPEC)
+
+    @partial(jax.jit, in_shardings=(p_shard, tok, tok, tok, tok),
+             out_shardings=None)
+    def score(params, tokens_a, mask_a, tokens_b, mask_b):
+        za = embed(params, tokens_a, mask_a, cfg.encoder, mesh)
+        zb = embed(params, tokens_b, mask_b, cfg.encoder, mesh)
+        return jnp.sum(za * zb, axis=-1)
+
+    return score

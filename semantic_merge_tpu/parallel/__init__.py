@@ -1,0 +1,11 @@
+"""Device-mesh parallelism: mesh construction, shardings, and the
+sequence-parallel ring-attention collective.
+
+The reference has no parallelism at all (SURVEY.md §2.3; its only
+concurrency control is a merge-driver lock file, reference
+``scripts/semmerge-driver.py:32-44``). This package is where the TPU
+framework gets its first-class scale-out: every strategy in the
+DP/TP/PP/SP/EP map of SURVEY.md §2.3 has a concrete implementation
+here or in :mod:`semantic_merge_tpu.models`.
+"""
+from .mesh import MergeMesh, build_mesh  # noqa: F401

@@ -1,0 +1,108 @@
+"""Mesh construction and sharding helpers.
+
+Five named axes cover the parallelism strategies (SURVEY.md §2.3):
+
+- ``dp``  — data parallel: the file/decl-batch axis of merge kernels and
+  the example-batch axis of matcher training.
+- ``pp``  — pipeline parallel: the stacked-layer axis of the encoder
+  (stage sharding; XLA moves activations between stages).
+- ``sp``  — sequence parallel: the token axis; attention runs as a ring
+  collective over this axis (:mod:`semantic_merge_tpu.parallel.ring`).
+- ``tp``  — tensor parallel: attention heads and FFN hidden features.
+- ``ep``  — expert parallel: the expert axis of the MoE FFN.
+
+Axes of size 1 are kept in the mesh so sharding specs are uniform
+regardless of how many devices participate.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclass
+class MergeMesh:
+    """A mesh plus canonical sharding constructors."""
+
+    mesh: Mesh
+
+    def spec(self, *axes: str | None) -> P:
+        return P(*axes)
+
+    def sharding(self, *axes: str | None) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*axes))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def axis_sizes(self) -> Dict[str, int]:
+        return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+
+    def __enter__(self):
+        self._ctx = self.mesh
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+def _factor(n: int, weights: Sequence[int]) -> list[int]:
+    """Greedily factor ``n`` devices over the axes, preferring axes with
+    higher weight. Sizes multiply to exactly ``n`` (n must be 2^k)."""
+    sizes = [1] * len(weights)
+    remaining = n
+    order = sorted(range(len(weights)), key=lambda i: -weights[i])
+    while remaining > 1:
+        progressed = False
+        for i in order:
+            if remaining <= 1:
+                break
+            if weights[i] > 0:
+                sizes[i] *= 2
+                remaining //= 2
+                progressed = True
+        if not progressed:
+            sizes[order[0]] *= remaining
+            remaining = 1
+    return sizes
+
+
+def build_mesh(devices: Sequence[jax.Device] | None = None,
+               *, dp: int | None = None, pp: int | None = None,
+               sp: int | None = None, tp: int | None = None,
+               ep: int | None = None) -> MergeMesh:
+    """Build a 5-axis mesh over ``devices``.
+
+    Unspecified axis sizes are inferred: fully-specified axes are
+    honored, the remainder goes to ``dp`` first, then ``sp``, then
+    ``tp``. For a v4-8 (4 chips / 8 cores) the default is
+    ``dp=4, sp=2`` — merge batches shard over chips, long token
+    sequences over cores, ICI carries the ring.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    requested = {"dp": dp, "pp": pp, "sp": sp, "tp": tp, "ep": ep}
+    fixed = math.prod(v for v in requested.values() if v)
+    if fixed and n % fixed != 0:
+        raise ValueError(f"requested axis sizes {requested} do not divide {n} devices")
+    free = n // fixed if fixed else n
+    auto = _factor(free, [3 if requested["dp"] is None else 0,
+                          0,
+                          2 if requested["sp"] is None else 0,
+                          1 if requested["tp"] is None else 0,
+                          0])
+    sizes = []
+    for i, name in enumerate(MESH_AXES):
+        sizes.append(requested[name] if requested[name] else auto[i])
+    arr = np.asarray(devices).reshape(sizes)
+    return MergeMesh(mesh=Mesh(arr, MESH_AXES))

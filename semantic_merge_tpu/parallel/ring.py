@@ -1,0 +1,81 @@
+"""Ring attention: sequence-parallel attention over the ``sp`` mesh axis.
+
+Long-context support for the encoder (SURVEY.md §5.7: the framework's
+sequence dimensions must scale past a single device). Keys/values live
+sharded along the sequence; instead of all-gathering them (O(L) memory
+per device), each device computes flash-style blockwise attention
+against its resident K/V chunk while the chunks rotate around the ring
+via ``lax.ppermute`` — ICI traffic overlaps with compute, per-device
+memory stays O(L/n). Online-softmax running max/sum accumulators make
+the result exactly equal (up to float assoc.) to full attention.
+
+Non-causal (the matcher encoder is bidirectional), with a key padding
+mask that travels the ring alongside its K/V chunk.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, kmask, *, axis_name: str):
+    """Per-shard body under shard_map.
+
+    q, k, v: (B, Lq_local, H, Dh) / (B, Lk_local, H, Dh); kmask:
+    (B, Lk_local) True on real tokens. Accumulates attention of the
+    local queries over every K/V chunk in the ring.
+    """
+    axis_size = lax.psum(1, axis_name)
+    scale = q.shape[-1] ** -0.5
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def step(carry, _):
+        o, m, l, k_cur, v_cur, mask_cur = carry
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(mask_cur[:, None, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur,
+                        preferred_element_type=jnp.float32)
+        o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        mask_next = lax.ppermute(mask_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next, mask_next), None
+
+    b, lq, h, dh = q.shape
+    init = (
+        jnp.zeros((b, lq, h, dh), jnp.float32),
+        jnp.full((b, h, lq), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, lq), jnp.float32),
+        k, v, kmask,
+    )
+    (o, m, l, *_), _ = lax.scan(step, init, None, length=axis_size)
+    l = l.transpose(0, 2, 1)[..., None]  # (B, Lq, H, 1)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, kmask, mesh: Mesh, *, axis_name: str = "sp"):
+    """Sequence-parallel attention over ``axis_name`` of ``mesh``.
+
+    Inputs are global arrays (B, L, H, Dh) with the L axis sharded over
+    ``axis_name``; heads may be sharded over ``tp``; batch over ``dp``.
+    """
+    qkv_spec = P("dp", axis_name, "tp", None)
+    mask_spec = P("dp", axis_name)
+    return jax.shard_map(
+        partial(_ring_attention_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )(q, k, v, kmask)

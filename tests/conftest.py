@@ -21,6 +21,15 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 # accelerator — backend discovery would otherwise block on the relay.
 try:
     import jax
+    # chex (via optax) imports jax.experimental.checkify, whose import-time
+    # MLIR lowering registration inspects the live platform registry —
+    # import it BEFORE the factory surgery below or it raises on the
+    # half-removed 'tpu' plugin platform. Failure must not skip the
+    # surgery: without it CPU-only tests dial the accelerator relay.
+    try:
+        import optax  # noqa: F401
+    except ImportError:
+        pass
     import jax._src.xla_bridge as _xb
 
     # jax may already be imported (a sitecustomize hook importing the

@@ -1,0 +1,955 @@
+// semmerge native frontend — C++ port of the host declaration scanner.
+//
+// This is the TPU framework's native hot-path component, playing the
+// role the Node.js TypeScript worker plays in the reference
+// (reference workers/ts/src/{sast}.ts: parse + index): tokenize
+// TypeScript/JavaScript source and index the five declaration kinds
+// with the exact (symbolId, addressId) scheme of
+// semantic_merge_tpu/frontend/{tokenizer,scanner}.py. The Python
+// scanner is the semantic oracle; this library must match it
+// bit-for-bit on ASCII sources (non-ASCII snapshots fall back to
+// Python host-side — offsets are code-point based there, byte based
+// here).
+//
+// C ABI (consumed via ctypes from semantic_merge_tpu/frontend/native.py):
+//   char* smn_scan_snapshot(const char** paths, const char** contents,
+//                           int n_files)
+//     → malloc'd JSON array of decl-node records; caller frees with
+//       smn_free. Two-pass semantics identical to scan_snapshot():
+//       pass 1 collects declared type names across ALL files, pass 2
+//       scans each file against that set.
+//   void smn_free(char*)
+//   int  smn_abi_version()
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cstdio>
+#include <string>
+#include <string_view>
+#include <vector>
+#include <unordered_set>
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4), enough for symbolId = first 16 hex chars.
+
+namespace sha256 {
+
+static const uint32_t K[64] = {
+    0x428a2f98,0x71374491,0xb5c0fbcf,0xe9b5dba5,0x3956c25b,0x59f111f1,
+    0x923f82a4,0xab1c5ed5,0xd807aa98,0x12835b01,0x243185be,0x550c7dc3,
+    0x72be5d74,0x80deb1fe,0x9bdc06a7,0xc19bf174,0xe49b69c1,0xefbe4786,
+    0x0fc19dc6,0x240ca1cc,0x2de92c6f,0x4a7484aa,0x5cb0a9dc,0x76f988da,
+    0x983e5152,0xa831c66d,0xb00327c8,0xbf597fc7,0xc6e00bf3,0xd5a79147,
+    0x06ca6351,0x14292967,0x27b70a85,0x2e1b2138,0x4d2c6dfc,0x53380d13,
+    0x650a7354,0x766a0abb,0x81c2c92e,0x92722c85,0xa2bfe8a1,0xa81a664b,
+    0xc24b8b70,0xc76c51a3,0xd192e819,0xd6990624,0xf40e3585,0x106aa070,
+    0x19a4c116,0x1e376c08,0x2748774c,0x34b0bcb5,0x391c0cb3,0x4ed8aa4a,
+    0x5b9cca4f,0x682e6ff3,0x748f82ee,0x78a5636f,0x84c87814,0x8cc70208,
+    0x90befffa,0xa4506ceb,0xbef9a3f7,0xc67178f2};
+
+static inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+struct Ctx {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buflen = 0;
+  Ctx() {
+    h[0]=0x6a09e667; h[1]=0xbb67ae85; h[2]=0x3c6ef372; h[3]=0xa54ff53a;
+    h[4]=0x510e527f; h[5]=0x9b05688c; h[6]=0x1f83d9ab; h[7]=0x5be0cd19;
+  }
+  void block(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[i*4]) << 24) | (uint32_t(p[i*4+1]) << 16) |
+             (uint32_t(p[i*4+2]) << 8) | uint32_t(p[i*4+3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i-15],7) ^ rotr(w[i-15],18) ^ (w[i-15] >> 3);
+      uint32_t s1 = rotr(w[i-2],17) ^ rotr(w[i-2],19) ^ (w[i-2] >> 10);
+      w[i] = w[i-16] + s0 + w[i-7] + s1;
+    }
+    uint32_t a=h[0],b=h[1],c=h[2],d=h[3],e=h[4],f=h[5],g=h[6],hh=h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e,6) ^ rotr(e,11) ^ rotr(e,25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a,2) ^ rotr(a,13) ^ rotr(a,22);
+      uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + mj;
+      hh=g; g=f; f=e; e=d+t1; d=c; c=b; b=a; a=t1+t2;
+    }
+    h[0]+=a; h[1]+=b; h[2]+=c; h[3]+=d; h[4]+=e; h[5]+=f; h[6]+=g; h[7]+=hh;
+  }
+  void update(const uint8_t* p, size_t n) {
+    len += n;
+    while (n) {
+      size_t take = 64 - buflen; if (take > n) take = n;
+      memcpy(buf + buflen, p, take);
+      buflen += take; p += take; n -= take;
+      if (buflen == 64) { block(buf); buflen = 0; }
+    }
+  }
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t z = 0;
+    while (buflen != 56) update(&z, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8*i));
+    update(lenb, 8);
+    for (int i = 0; i < 8; i++) {
+      out[i*4]   = uint8_t(h[i] >> 24);
+      out[i*4+1] = uint8_t(h[i] >> 16);
+      out[i*4+2] = uint8_t(h[i] >> 8);
+      out[i*4+3] = uint8_t(h[i]);
+    }
+  }
+};
+
+// First n_hex hex chars of sha256(data).
+static std::string hex16(std::string_view data) {
+  Ctx c;
+  c.update(reinterpret_cast<const uint8_t*>(data.data()), data.size());
+  uint8_t out[32];
+  c.final(out);
+  static const char* digits = "0123456789abcdef";
+  std::string s;
+  s.reserve(16);
+  for (int i = 0; i < 8; i++) {  // 8 bytes → 16 hex chars
+    s.push_back(digits[out[i] >> 4]);
+    s.push_back(digits[out[i] & 0xf]);
+  }
+  return s;
+}
+
+}  // namespace sha256
+
+// ---------------------------------------------------------------------------
+// Tokenizer — port of semantic_merge_tpu/frontend/tokenizer.py.
+
+enum TokType : uint8_t { T_IDENT, T_NUMBER, T_STRING, T_TEMPLATE, T_REGEX, T_PUNCT };
+
+struct Token {
+  TokType type;
+  std::string_view text;
+  int start;
+  int end;
+  int prev_end;
+  bool nl_before;
+};
+
+// Longest-match-first operator table — EXACT order of tokenizer.py.
+static const char* OPERATORS[] = {
+    ">>>=", "...", "===", "!==", "**=", "<<=", ">>=", ">>>", "&&=", "||=", "?\?=",
+    "=>", "==", "!=", "<=", ">=", "&&", "||", "??", "?.", "++", "--", "+=", "-=",
+    "*=", "/=", "%=", "&=", "|=", "^=", "<<", ">>", "**",
+    "{", "}", "(", ")", "[", "]", ";", ",", "<", ">", "+", "-", "*", "/", "%",
+    "&", "|", "^", "!", "~", "?", ":", "=", ".", "@", "#",
+};
+static const int N_OPERATORS = sizeof(OPERATORS) / sizeof(OPERATORS[0]);
+
+static inline bool is_ident_start(char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || c == '_' || c == '$';
+}
+static inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
+static inline bool is_ident_part(char c) { return is_ident_start(c) || is_digit(c); }
+static inline bool is_alnum(char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || is_digit(c);
+}
+
+static const std::unordered_set<std::string_view> REGEX_ALLOWED_KEYWORDS = {
+    "return", "typeof", "instanceof", "in", "of", "new", "delete", "void",
+    "throw", "case", "do", "else", "yield", "await",
+};
+
+static bool regex_allowed(const std::vector<Token>& toks) {
+  if (toks.empty()) return true;
+  const Token& prev = toks.back();
+  if (prev.type == T_NUMBER || prev.type == T_STRING || prev.type == T_TEMPLATE ||
+      prev.type == T_REGEX)
+    return false;
+  if (prev.type == T_IDENT) return REGEX_ALLOWED_KEYWORDS.count(prev.text) != 0;
+  return !(prev.text == ")" || prev.text == "]" || prev.text == "}" ||
+           prev.text == "++" || prev.text == "--");
+}
+
+static int scan_string(std::string_view t, int i, char quote) {
+  int n = int(t.size());
+  i += 1;
+  while (i < n) {
+    char c = t[i];
+    if (c == '\\') { i += 2; continue; }
+    if (c == quote || c == '\n') return i + 1;
+    i += 1;
+  }
+  return n;
+}
+
+static int scan_regex(std::string_view t, int i) {
+  int n = int(t.size());
+  i += 1;
+  bool in_class = false;
+  while (i < n) {
+    char c = t[i];
+    if (c == '\\') { i += 2; continue; }
+    if (c == '[') in_class = true;
+    else if (c == ']') in_class = false;
+    else if (c == '/' && !in_class) {
+      i += 1;
+      while (i < n && is_ident_part(t[i])) i += 1;
+      return i;
+    } else if (c == '\n') return i;
+    i += 1;
+  }
+  return n;
+}
+
+static int scan_template(std::string_view t, int i);
+
+static int scan_substitution(std::string_view t, int i) {
+  int n = int(t.size());
+  int depth = 1;
+  while (i < n) {
+    char c = t[i];
+    if (c == '\\') { i += 2; continue; }
+    if (c == '\'' || c == '"') { i = scan_string(t, i, c); continue; }
+    if (c == '`') { i = scan_template(t, i); continue; }
+    if (c == '{') depth += 1;
+    else if (c == '}') {
+      depth -= 1;
+      if (depth == 0) return i + 1;
+    }
+    i += 1;
+  }
+  return n;
+}
+
+static int scan_template(std::string_view t, int i) {
+  int n = int(t.size());
+  i += 1;
+  while (i < n) {
+    char c = t[i];
+    if (c == '\\') { i += 2; continue; }
+    if (c == '`') return i + 1;
+    if (c == '$' && i + 1 < n && t[i + 1] == '{') {
+      i = scan_substitution(t, i + 2);
+      continue;
+    }
+    i += 1;
+  }
+  return n;
+}
+
+static const char* match_operator(std::string_view t, int i) {
+  for (int k = 0; k < N_OPERATORS; k++) {
+    const char* op = OPERATORS[k];
+    size_t len = strlen(op);
+    if (t.size() - size_t(i) >= len && memcmp(t.data() + i, op, len) == 0) return op;
+  }
+  return nullptr;
+}
+
+static std::vector<Token> tokenize(std::string_view text) {
+  std::vector<Token> toks;
+  toks.reserve(text.size() / 6 + 8);
+  int i = 0;
+  int n = int(text.size());
+  int prev_end = 0;
+  bool nl_before = false;
+  while (i < n) {
+    char c = text[i];
+    if (c == ' ' || c == '\t' || c == '\r' || c == '\f' || c == '\v') { i += 1; continue; }
+    if (c == '\n') { nl_before = true; i += 1; continue; }
+    if (c == '/' && i + 1 < n) {
+      if (text[i + 1] == '/') {
+        size_t j = text.find('\n', i);
+        i = (j == std::string_view::npos) ? n : int(j);
+        continue;
+      }
+      if (text[i + 1] == '*') {
+        size_t j = text.find("*/", i + 2);
+        if (j == std::string_view::npos) { i = n; continue; }
+        if (text.substr(i, j - i).find('\n') != std::string_view::npos) nl_before = true;
+        i = int(j) + 2;
+        continue;
+      }
+    }
+    int start = i;
+    Token tok;
+    if (is_ident_start(c)) {
+      while (i < n && is_ident_part(text[i])) i += 1;
+      tok = {T_IDENT, text.substr(start, i - start), start, i, prev_end, nl_before};
+    } else if (is_digit(c) || (c == '.' && i + 1 < n && is_digit(text[i + 1]))) {
+      while (i < n && (is_alnum(text[i]) || text[i] == '.' || text[i] == '_')) i += 1;
+      tok = {T_NUMBER, text.substr(start, i - start), start, i, prev_end, nl_before};
+    } else if (c == '\'' || c == '"') {
+      i = scan_string(text, i, c);
+      tok = {T_STRING, text.substr(start, i - start), start, i, prev_end, nl_before};
+    } else if (c == '`') {
+      i = scan_template(text, i);
+      tok = {T_TEMPLATE, text.substr(start, i - start), start, i, prev_end, nl_before};
+    } else if (c == '/' && regex_allowed(toks)) {
+      i = scan_regex(text, i);
+      tok = {T_REGEX, text.substr(start, i - start), start, i, prev_end, nl_before};
+    } else {
+      const char* op = match_operator(text, i);
+      if (op == nullptr) { i += 1; continue; }  // stray byte: skip
+      i += int(strlen(op));
+      tok = {T_PUNCT, text.substr(start, i - start), start, i, prev_end, nl_before};
+    }
+    toks.push_back(tok);
+    prev_end = tok.end;
+    nl_before = false;
+  }
+  return toks;
+}
+
+// ---------------------------------------------------------------------------
+// Scanner — port of semantic_merge_tpu/frontend/scanner.py.
+
+static const char* KIND_FUNCTION = "FunctionDeclaration";
+static const char* KIND_CLASS = "ClassDeclaration";
+static const char* KIND_INTERFACE = "InterfaceDeclaration";
+static const char* KIND_ENUM = "EnumDeclaration";
+static const char* KIND_VARS = "VariableStatement";
+
+static const std::unordered_set<std::string_view> EXPRESSION_PREV = {
+    "=", "(", "[", ",", ":", "?", "!", "&", "|", "+", "-", "*", "/", "%",
+    "<", ">", "=>", "==", "===", "!=", "!==", "&&", "||", "??", "...",
+    "+=", "-=", "*=", "/=", "?\?=", "&&=", "||=", ".", "?.",
+};
+static const std::unordered_set<std::string_view> EXPRESSION_PREV_IDENTS = {
+    "return", "typeof", "new", "delete", "void", "in", "of", "instanceof",
+    "yield", "await", "case", "do", "throw", "extends", "default",
+};
+static const std::unordered_set<std::string_view> DECL_MODIFIERS = {
+    "export", "default", "declare", "async", "abstract", "public", "private",
+    "protected",
+};
+static const std::unordered_set<std::string_view> PRIMITIVE_TYPES = {
+    "string", "number", "boolean", "any", "unknown", "never", "void", "object",
+    "undefined", "null", "bigint", "symbol", "this", "true", "false",
+};
+
+struct DeclNode {
+  std::string symbolId;
+  std::string addressId;
+  const char* kind;
+  std::string name;   // empty + has_name=false → null
+  bool has_name;
+  std::string file;
+  int pos;
+  int end;
+  std::string signature;
+};
+
+using TokVec = std::vector<Token>;
+using StrSet = std::unordered_set<std::string>;
+
+static std::string normalize_path(std::string p) {
+  for (auto& ch : p)
+    if (ch == '\\') ch = '/';
+  if (p.rfind("./", 0) == 0) p = p.substr(2);
+  if (!p.empty() && p[0] == '/') p = p.substr(1);
+  return p;
+}
+
+static bool is_expression_position(const TokVec& toks, int i) {
+  int j = i - 1;
+  while (j >= 0 && toks[j].type == T_IDENT && DECL_MODIFIERS.count(toks[j].text)) j -= 1;
+  if (j < 0) return false;
+  const Token& prev = toks[j];
+  if (prev.type == T_PUNCT) return EXPRESSION_PREV.count(prev.text) != 0;
+  if (prev.type == T_IDENT) return EXPRESSION_PREV_IDENTS.count(prev.text) != 0;
+  return true;
+}
+
+static StrSet collect_type_names(const TokVec& toks) {
+  StrSet names;
+  int n = int(toks.size());
+  for (int i = 0; i < n; i++) {
+    const Token& t = toks[i];
+    if (t.type != T_IDENT || i + 1 >= n) continue;
+    const Token& nxt = toks[i + 1];
+    bool head = (t.text == "class" || t.text == "interface" || t.text == "enum" ||
+                 t.text == "type");
+    if (head && nxt.type == T_IDENT) {
+      if (t.text == "type" &&
+          (i + 2 >= n || !(toks[i + 2].text == "=" || toks[i + 2].text == "<")))
+        continue;
+      if (t.text == "class" && is_expression_position(toks, i)) continue;
+      names.insert(std::string(nxt.text));
+    }
+  }
+  return names;
+}
+
+static int full_start(const TokVec& toks, int i) {
+  int j = i;
+  while (j - 1 >= 0 && toks[j - 1].type == T_IDENT &&
+         DECL_MODIFIERS.count(toks[j - 1].text))
+    j -= 1;
+  return toks[j].prev_end;
+}
+
+static int skip_type_params(const TokVec& toks, int i) {
+  int n = int(toks.size());
+  if (i < n && toks[i].text == "<") {
+    int depth = 0;
+    while (i < n) {
+      if (toks[i].text == "<") depth += 1;
+      else if (toks[i].text == ">" || toks[i].text == ">>" || toks[i].text == ">>>") {
+        depth -= int(toks[i].text.size());  // count of '>' chars
+        if (depth <= 0) return i + 1;
+      }
+      i += 1;
+    }
+  }
+  return i;
+}
+
+static int matching_brace(const TokVec& toks, int i) {
+  int depth = 0;
+  int n = int(toks.size());
+  while (i < n) {
+    if (toks[i].text == "{") depth += 1;
+    else if (toks[i].text == "}") {
+      depth -= 1;
+      if (depth == 0) return i;
+    }
+    i += 1;
+  }
+  return n - 1;
+}
+
+static int matching_paren(const TokVec& toks, int i) {
+  int depth = 0;
+  int n = int(toks.size());
+  while (i < n) {
+    if (toks[i].text == "(") depth += 1;
+    else if (toks[i].text == ")") {
+      depth -= 1;
+      if (depth == 0) return i;
+    }
+    i += 1;
+  }
+  return n - 1;
+}
+
+static bool has_default_modifier(const TokVec& toks, int i) {
+  int j = i - 1;
+  while (j >= 0 && toks[j].type == T_IDENT && DECL_MODIFIERS.count(toks[j].text)) {
+    if (toks[j].text == "default") return true;
+    j -= 1;
+  }
+  return false;
+}
+
+// --- type display (typeToString emulation) ---------------------------------
+
+static std::string render_type_text(const std::vector<std::string_view>& parts,
+                                    const StrSet& declared);
+
+static std::vector<std::vector<std::string_view>> split_top(
+    const std::vector<std::string_view>& parts, std::string_view sep) {
+  std::vector<std::vector<std::string_view>> out;
+  out.emplace_back();
+  int depth = 0;
+  for (const auto& p : parts) {
+    if (p == "(" || p == "[" || p == "{" || p == "<") depth += 1;
+    else if (p == ")" || p == "]" || p == "}" || p == ">") depth -= 1;
+    if (p == sep && depth == 0) out.emplace_back();
+    else out.back().push_back(p);
+  }
+  return out;
+}
+
+static bool is_numeric_literal(std::string_view name) {
+  size_t k = 0;
+  while (k < name.size() && name[k] == '-') k += 1;  // lstrip("-")
+  if (k == name.size()) return false;
+  for (; k < name.size(); k++)
+    if (!is_digit(name[k])) return false;
+  return true;
+}
+
+static std::string join(const std::vector<std::string_view>& parts,
+                        const char* sep) {
+  std::string out;
+  for (size_t k = 0; k < parts.size(); k++) {
+    if (k) out += sep;
+    out.append(parts[k].data(), parts[k].size());
+  }
+  return out;
+}
+
+static std::string render_type_text(const std::vector<std::string_view>& parts,
+                                    const StrSet& declared) {
+  // Union / intersection at top level.
+  for (const char* op : {"|", "&"}) {
+    auto pieces = split_top(parts, op);
+    if (pieces.size() > 1) {
+      std::string out;
+      for (size_t k = 0; k < pieces.size(); k++) {
+        if (k) { out += " "; out += op; out += " "; }
+        out += render_type_text(pieces[k], declared);
+      }
+      return out;
+    }
+  }
+  // Trailing [] — array type.
+  if (parts.size() >= 2 && parts[parts.size() - 1] == "]" &&
+      parts[parts.size() - 2] == "[") {
+    std::vector<std::string_view> inner(parts.begin(), parts.end() - 2);
+    std::string elem = render_type_text(inner, declared);
+    if (elem.find(" | ") != std::string::npos || elem.find(" & ") != std::string::npos)
+      return "(" + elem + ")[]";
+    return elem + "[]";
+  }
+  // Parenthesized. (After the union check, no depth-0 "|" remains, so the
+  // Python `_split_top(parts, "|") == [parts]` guard is always true here.)
+  if (!parts.empty() && parts[0] == "(") {
+    if (parts.back() == ")") {
+      std::vector<std::string_view> inner(parts.begin() + 1, parts.end() - 1);
+      return render_type_text(inner, declared);
+    }
+  }
+  if (parts.size() == 1) {
+    std::string_view name = parts[0];
+    if (PRIMITIVE_TYPES.count(name) || is_numeric_literal(name) ||
+        (!name.empty() && (name[0] == '\'' || name[0] == '"' || name[0] == '`')))
+      return std::string(name);
+    return declared.count(std::string(name)) ? std::string(name) : "any";
+  }
+  // Generic reference ``Name<...>`` — unresolved without a default lib.
+  if (!parts.empty() && !PRIMITIVE_TYPES.count(parts[0]) && parts.size() >= 2 &&
+      parts[1] == "<")
+    return declared.count(std::string(parts[0])) ? std::string(parts[0]) : "any";
+  return join(parts, " ");
+}
+
+static std::string render_type(const std::vector<const Token*>& type_toks,
+                               const StrSet& declared) {
+  if (type_toks.empty()) return "any";
+  std::vector<std::string_view> parts;
+  parts.reserve(type_toks.size());
+  for (const Token* t : type_toks) parts.push_back(t->text);
+  return render_type_text(parts, declared);
+}
+
+// --- parameter / annotation parsing ----------------------------------------
+
+static std::vector<const Token*> annotation_of(const std::vector<const Token*>& ptoks) {
+  int depth = 0;
+  int start = -1;
+  for (size_t idx = 0; idx < ptoks.size(); idx++) {
+    std::string_view t = ptoks[idx]->text;
+    if (t == "(" || t == "[" || t == "{" || t == "<") depth += 1;
+    else if (t == ")" || t == "]" || t == "}" || t == ">") depth -= 1;
+    else if (depth == 0 && t == ":" && start < 0) start = int(idx) + 1;
+    else if (depth == 0 && t == "=" && start >= 0)
+      return {ptoks.begin() + start, ptoks.begin() + idx};
+    else if (depth == 0 && t == "=" && start < 0)
+      return {};
+  }
+  if (start >= 0) return {ptoks.begin() + start, ptoks.end()};
+  return {};
+}
+
+static std::vector<std::string> parse_param_types(
+    const std::vector<const Token*>& param_toks, const StrSet& declared) {
+  std::vector<std::string> types;
+  if (param_toks.empty()) return types;
+  std::vector<std::vector<const Token*>> params;
+  params.emplace_back();
+  int depth = 0;
+  for (const Token* t : param_toks) {
+    std::string_view x = t->text;
+    if (x == "(" || x == "[" || x == "{" || x == "<") depth += 1;
+    else if (x == ")" || x == "]" || x == "}" || x == ">") depth -= 1;
+    if (x == "," && depth == 0) params.emplace_back();
+    else params.back().push_back(t);
+  }
+  for (const auto& ptoks : params) {
+    if (ptoks.empty()) continue;
+    auto ann = annotation_of(ptoks);
+    types.push_back(ann.empty() ? "any" : render_type(ann, declared));
+  }
+  return types;
+}
+
+static std::pair<std::vector<const Token*>, int> collect_type_tokens(
+    const TokVec& toks, int i, const StrSet& stop) {
+  std::vector<const Token*> out;
+  int depth = 0;
+  int n = int(toks.size());
+  while (i < n) {
+    const Token& t = toks[i];
+    std::string txt(t.text);
+    if (depth == 0 && stop.count(txt)) break;
+    if (t.text == "(" || t.text == "[" || t.text == "<" || t.text == "{") depth += 1;
+    else if (t.text == ")" || t.text == "]" || t.text == ">" || t.text == "}") {
+      if (depth == 0) break;
+      depth -= 1;
+    }
+    out.push_back(&t);
+    i += 1;
+  }
+  return {out, i};
+}
+
+// --- node construction ------------------------------------------------------
+
+static DeclNode mk_node(const std::string& path, const TokVec& toks, int start_i,
+                        int end_i, const char* kind, const std::string& name,
+                        bool has_name, const std::string& sig) {
+  int pos = full_start(toks, start_i);
+  int end = toks[std::min(end_i, int(toks.size()) - 1)].end;
+  std::string address = path + "::" + (has_name ? name : std::string("anon")) +
+                        "::" + std::to_string(pos);
+  DeclNode node;
+  node.symbolId = sha256::hex16(sig);
+  node.addressId = address;
+  node.kind = kind;
+  node.name = name;
+  node.has_name = has_name;
+  node.file = path;
+  node.pos = pos;
+  node.end = end;
+  node.signature = sig;
+  return node;
+}
+
+// --- function declarations --------------------------------------------------
+
+static bool scan_function(const std::string& path, const TokVec& toks, int i,
+                          const StrSet& declared, DeclNode* out) {
+  if (is_expression_position(toks, i)) return false;
+  int n = int(toks.size());
+  int j = i + 1;
+  if (j < n && toks[j].text == "*") j += 1;  // generator
+  std::string name;
+  bool has_name = false;
+  if (j < n && toks[j].type == T_IDENT) {
+    name = std::string(toks[j].text);
+    has_name = true;
+    j += 1;
+  }
+  j = skip_type_params(toks, j);
+  if (j >= n || toks[j].text != "(") return false;
+  if (!has_name && !has_default_modifier(toks, i)) return false;
+  int params_start = j;
+  int params_end = matching_paren(toks, params_start);
+  std::vector<const Token*> ptoks;
+  for (int k = params_start + 1; k < params_end; k++) ptoks.push_back(&toks[k]);
+  auto param_types = parse_param_types(ptoks, declared);
+  int k = params_end + 1;
+  std::string ret_type = "any";
+  if (k < n && toks[k].text == ":") {
+    static const StrSet stop = {"{", ";"};
+    auto [type_toks, k2] = collect_type_tokens(toks, k + 1, stop);
+    ret_type = render_type(type_toks, declared);
+    k = k2;
+  }
+  int end_idx;
+  if (k < n && toks[k].text == "{") end_idx = matching_brace(toks, k);
+  else if (k < n && toks[k].text == ";") end_idx = k;
+  else end_idx = params_end;
+  std::string sig = "fn(";
+  for (size_t q = 0; q < param_types.size(); q++) {
+    if (q) sig += ",";
+    sig += param_types[q];
+  }
+  sig += ")->" + ret_type;
+  *out = mk_node(path, toks, i, end_idx, KIND_FUNCTION, name, has_name, sig);
+  return true;
+}
+
+// --- class / interface / enum -----------------------------------------------
+
+static bool asi_break(const Token& prev, const Token& cur) {
+  if (prev.type == T_PUNCT &&
+      !(prev.text == ")" || prev.text == "]" || prev.text == "}"))
+    return false;
+  if (cur.type == T_PUNCT && !(cur.text == "[" || cur.text == "@" || cur.text == "#"))
+    return false;
+  static const std::unordered_set<std::string_view> member_heads = {
+      "get", "set", "static", "readonly", "public", "private", "protected",
+      "abstract", "async", "new"};
+  if (prev.type == T_IDENT && member_heads.count(prev.text)) return false;
+  return true;
+}
+
+static int member_end(const TokVec& toks, int i, int body_end, bool allow_method_body) {
+  int depth = 0;
+  bool seen_eq = false;
+  int n = body_end;
+  int start = i;  // the ASI check must not fire on the member's own first token
+  while (i < n) {
+    const Token& t = toks[i];
+    if (t.text == "(" || t.text == "[") depth += 1;
+    else if (t.text == ")" || t.text == "]") depth -= 1;
+    else if (t.text == "{") {
+      if (depth == 0 && !seen_eq && allow_method_body)
+        return matching_brace(toks, i) + 1;
+      depth += 1;
+    } else if (t.text == "}") depth -= 1;
+    else if (depth == 0) {
+      if (t.text == "=") seen_eq = true;
+      else if (t.text == ";" || t.text == ",") return i + 1;
+      else if (t.nl_before && i > start && asi_break(toks[i - 1], t)) return i;
+    }
+    i += 1;
+  }
+  return n;
+}
+
+static int count_class_members(const TokVec& toks, int body_start, int body_end) {
+  int count = 0;
+  int i = body_start + 1;
+  while (i < body_end) {
+    if (toks[i].text == ";") { count += 1; i += 1; continue; }
+    count += 1;
+    i = member_end(toks, i, body_end, /*allow_method_body=*/true);
+  }
+  return count;
+}
+
+static int count_interface_members(const TokVec& toks, int body_start, int body_end) {
+  int count = 0;
+  int i = body_start + 1;
+  while (i < body_end) {
+    if (toks[i].text == ";" || toks[i].text == ",") { i += 1; continue; }
+    count += 1;
+    i = member_end(toks, i, body_end, /*allow_method_body=*/false);
+  }
+  return count;
+}
+
+static int count_enum_members(const TokVec& toks, int body_start, int body_end) {
+  int count = 0;
+  int depth = 0;
+  bool has_content = false;
+  for (int i = body_start + 1; i < body_end; i++) {
+    const Token& t = toks[i];
+    if (t.text == "(" || t.text == "[" || t.text == "{") depth += 1;
+    else if (t.text == ")" || t.text == "]" || t.text == "}") depth -= 1;
+    else if (t.text == "," && depth == 0) {
+      if (has_content) count += 1;
+      has_content = false;
+      continue;
+    }
+    if (depth == 0 && t.text != ",") has_content = true;
+  }
+  if (has_content) count += 1;
+  return count;
+}
+
+static bool scan_braced_decl(const std::string& path, const TokVec& toks, int i,
+                             const char* kind, DeclNode* out) {
+  if (is_expression_position(toks, i)) return false;
+  int n = int(toks.size());
+  int j = i + 1;
+  std::string name;
+  bool has_name = false;
+  if (j < n && toks[j].type == T_IDENT && toks[j].text != "extends" &&
+      toks[j].text != "implements") {
+    name = std::string(toks[j].text);
+    has_name = true;
+    j += 1;
+  }
+  if (!has_name && (kind == KIND_INTERFACE || kind == KIND_ENUM)) return false;
+  j = skip_type_params(toks, j);
+  while (j < n && toks[j].text != "{") {
+    if (toks[j].text == ";" || toks[j].text == ")") return false;
+    j += 1;
+  }
+  if (j >= n) return false;
+  int body_start = j;
+  int body_end = matching_brace(toks, body_start);
+  std::string sig;
+  if (kind == KIND_CLASS)
+    sig = "class{" + std::to_string(count_class_members(toks, body_start, body_end)) + "}";
+  else if (kind == KIND_INTERFACE)
+    sig = "iface{" + std::to_string(count_interface_members(toks, body_start, body_end)) + "}";
+  else
+    sig = "enum{" + std::to_string(count_enum_members(toks, body_start, body_end)) + "}";
+  int start_i = i;
+  if (kind == KIND_ENUM && i - 1 >= 0 && toks[i - 1].text == "const") start_i = i - 1;
+  *out = mk_node(path, toks, start_i, body_end, kind, name, has_name, sig);
+  return true;
+}
+
+// --- variable statements -----------------------------------------------------
+
+static bool var_asi_break(const Token& prev, const Token& cur) {
+  if (prev.type == T_PUNCT &&
+      !(prev.text == ")" || prev.text == "]" || prev.text == "}"))
+    return false;
+  if (cur.type == T_PUNCT &&
+      (cur.text == "+" || cur.text == "-" || cur.text == "*" || cur.text == "/" ||
+       cur.text == "." || cur.text == "?." || cur.text == "=" || cur.text == "(" ||
+       cur.text == "[" || cur.text == "`"))
+    return false;
+  if (cur.type == T_IDENT &&
+      (cur.text == "instanceof" || cur.text == "in" || cur.text == "of" ||
+       cur.text == "as"))
+    return false;
+  return true;
+}
+
+static bool scan_var_statement(const std::string& path, const TokVec& toks, int i,
+                               DeclNode* out) {
+  int n = int(toks.size());
+  if (i + 1 < n && toks[i + 1].text == "enum") return false;  // const enum
+  if (i + 1 >= n ||
+      !(toks[i + 1].type == T_IDENT || toks[i + 1].text == "[" || toks[i + 1].text == "{"))
+    return false;
+  if (toks[i + 1].type == T_IDENT &&
+      (toks[i + 1].text == "in" || toks[i + 1].text == "of" ||
+       toks[i + 1].text == "instanceof"))
+    return false;
+  int j = i - 1;
+  if (j >= 0 && toks[j].text == "(" && j - 1 >= 0 && toks[j - 1].type == T_IDENT &&
+      (toks[j - 1].text == "for" || toks[j - 1].text == "await"))
+    return false;
+  if (is_expression_position(toks, i)) return false;
+  int depth = 0;
+  int declarators = 1;
+  int k = i + 1;
+  int end_idx = i;
+  while (k < n) {
+    const Token& t2 = toks[k];
+    if (t2.text == "(" || t2.text == "[" || t2.text == "{") depth += 1;
+    else if (t2.text == ")" || t2.text == "]") {
+      depth -= 1;
+      if (depth < 0) break;
+    } else if (t2.text == "}") {
+      depth -= 1;
+      if (depth < 0) break;
+    } else if (depth == 0) {
+      if (t2.text == ";") { end_idx = k; break; }
+      if (t2.text == ",") declarators += 1;
+      else if (t2.nl_before && var_asi_break(toks[k - 1], t2)) break;
+      else if (t2.type == T_IDENT && (t2.text == "of" || t2.text == "in") &&
+               toks[k - 1].type == T_IDENT)
+        return false;
+    }
+    end_idx = k;
+    k += 1;
+  }
+  std::string sig = "vars{" + std::to_string(declarators) + "}";
+  *out = mk_node(path, toks, i, end_idx, KIND_VARS, "", /*has_name=*/false, sig);
+  return true;
+}
+
+// --- file scan ---------------------------------------------------------------
+
+static void scan_tokens(const std::string& path, const TokVec& toks,
+                        const StrSet& declared, std::vector<DeclNode>* nodes) {
+  int n = int(toks.size());
+  for (int i = 0; i < n; i++) {
+    const Token& t = toks[i];
+    if (t.type != T_IDENT) continue;
+    std::string_view word = t.text;
+    DeclNode node;
+    bool ok = false;
+    if (word == "function") ok = scan_function(path, toks, i, declared, &node);
+    else if (word == "class") ok = scan_braced_decl(path, toks, i, KIND_CLASS, &node);
+    else if (word == "interface") ok = scan_braced_decl(path, toks, i, KIND_INTERFACE, &node);
+    else if (word == "enum") ok = scan_braced_decl(path, toks, i, KIND_ENUM, &node);
+    else if (word == "var" || word == "let" || word == "const")
+      ok = scan_var_statement(path, toks, i, &node);
+    if (ok) nodes->push_back(std::move(node));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// JSON output.
+
+static void json_escape(const std::string& s, std::string* out) {
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      case '\r': *out += "\\r"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(char(c));
+        }
+    }
+  }
+}
+
+static void append_node_json(const DeclNode& n, std::string* out) {
+  *out += "{\"symbolId\":\"";
+  json_escape(n.symbolId, out);
+  *out += "\",\"addressId\":\"";
+  json_escape(n.addressId, out);
+  *out += "\",\"kind\":\"";
+  *out += n.kind;
+  *out += "\",\"name\":";
+  if (n.has_name) {
+    *out += "\"";
+    json_escape(n.name, out);
+    *out += "\"";
+  } else {
+    *out += "null";
+  }
+  *out += ",\"file\":\"";
+  json_escape(n.file, out);
+  *out += "\",\"pos\":" + std::to_string(n.pos);
+  *out += ",\"end\":" + std::to_string(n.end);
+  *out += ",\"signature\":\"";
+  json_escape(n.signature, out);
+  *out += "\"}";
+}
+
+// ---------------------------------------------------------------------------
+// C ABI.
+
+extern "C" {
+
+int smn_abi_version() { return 1; }
+
+// Scan a snapshot: two passes exactly like scan_snapshot() — collect
+// declared type names across all files, then scan each file in snapshot
+// order. Returns a malloc'd JSON array; free with smn_free.
+char* smn_scan_snapshot(const char** paths, const char** contents, int n_files) {
+  std::vector<std::pair<std::string, TokVec>> tokens_by_file;
+  std::vector<std::string> sources;  // keep source buffers alive for string_views
+  tokens_by_file.reserve(n_files);
+  sources.reserve(n_files);
+  StrSet declared;
+  for (int f = 0; f < n_files; f++) {
+    sources.emplace_back(contents[f]);
+    std::string path = normalize_path(paths[f]);
+    TokVec toks = tokenize(sources.back());
+    for (auto& name : collect_type_names(toks)) declared.insert(name);
+    tokens_by_file.emplace_back(std::move(path), std::move(toks));
+  }
+  std::vector<DeclNode> nodes;
+  for (auto& [path, toks] : tokens_by_file) scan_tokens(path, toks, declared, &nodes);
+  std::string out = "[";
+  for (size_t k = 0; k < nodes.size(); k++) {
+    if (k) out += ",";
+    append_node_json(nodes[k], &out);
+  }
+  out += "]";
+  char* buf = static_cast<char*>(malloc(out.size() + 1));
+  memcpy(buf, out.data(), out.size() + 1);
+  return buf;
+}
+
+void smn_free(char* p) { free(p); }
+
+}  // extern "C"

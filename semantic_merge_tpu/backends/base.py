@@ -37,11 +37,13 @@ class Backend(Protocol):
 
     def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
                        *, base_rev: str = "base", seed: str = "0",
-                       timestamp: str | None = None) -> BuildAndDiffResult: ...
+                       timestamp: str | None = None,
+                       change_signature: bool = False) -> BuildAndDiffResult: ...
 
     def diff(self, base: Snapshot, right: Snapshot,
              *, base_rev: str = "base", seed: str = "0",
-             timestamp: str | None = None) -> List[Op]: ...
+             timestamp: str | None = None,
+             change_signature: bool = False) -> List[Op]: ...
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         """Compose two op logs; backends override to run composition on

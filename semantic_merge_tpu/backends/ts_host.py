@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import List
 
-from ..core.difflift import diff_nodes, lift
+from ..core.difflift import diff_nodes, lift, refine_signature_changes
 from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
 from ..frontend.scanner import scan_snapshot
@@ -25,13 +25,17 @@ class HostTSBackend:
 
     def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
                        *, base_rev: str = "base", seed: str = "0",
-                       timestamp: str | None = None) -> BuildAndDiffResult:
+                       timestamp: str | None = None,
+                       change_signature: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(base.files)
         left_nodes = scan_snapshot(left.files)
         right_nodes = scan_snapshot(right.files)
         diffs_l = diff_nodes(base_nodes, left_nodes)
         diffs_r = diff_nodes(base_nodes, right_nodes)
+        if change_signature:
+            diffs_l = refine_signature_changes(diffs_l)
+            diffs_r = refine_signature_changes(diffs_r)
         return BuildAndDiffResult(
             op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts),
             op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts),
@@ -44,12 +48,15 @@ class HostTSBackend:
 
     def diff(self, base: Snapshot, right: Snapshot,
              *, base_rev: str = "base", seed: str = "0",
-             timestamp: str | None = None) -> List[Op]:
+             timestamp: str | None = None,
+             change_signature: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(base.files)
         right_nodes = scan_snapshot(right.files)
-        return lift(base_rev, diff_nodes(base_nodes, right_nodes),
-                    seed=seed + "/R", timestamp=ts)
+        diffs = diff_nodes(base_nodes, right_nodes)
+        if change_signature:
+            diffs = refine_signature_changes(diffs)
+        return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts)
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         return host_compose(delta_a, delta_b)

@@ -1,22 +1,27 @@
 """TPU TypeScript backend — the device execution path.
 
 Same contract as :mod:`semantic_merge_tpu.backends.ts_host`, but the
-diff join and op lifting run as fused XLA programs over interned int32
-tensors (:mod:`semantic_merge_tpu.ops.diff`). Host work is reduced to
-scanning (parsing) and string interning; the per-symbol join — the
-reference worker's per-file hot path (reference
+diff join and op-stream enumeration run as fused XLA programs over
+interned int32 tensors (:mod:`semantic_merge_tpu.ops.diff`). Host work
+is reduced to scanning (parsing) and string interning; the per-symbol
+join — the reference worker's per-file hot path (reference
 ``workers/ts/src/diff.ts``, ``workers/ts/src/lift.ts``) — happens on
-the accelerator. Output op logs are bit-identical to the host backend
-(same deterministic ids, same enumeration order).
+the accelerator. The device op stream is decoded back into the same
+``Diff`` records the host backend produces and lifted by the shared
+:func:`semantic_merge_tpu.core.difflift.lift`, so op logs are
+bit-identical by construction (same deterministic ids, same enumeration
+order) and every lift-level feature (e.g. changeSignature refinement)
+applies to both backends identically.
 """
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List
 
+from ..core.difflift import Diff, lift, refine_signature_changes
 from ..core.encode import NULL_ID, Interner, encode_decls
-from ..core.ids import EPOCH_ISO, deterministic_op_id
-from ..core.ops import Op, Target
-from ..frontend.scanner import scan_snapshot
+from ..core.ids import EPOCH_ISO
+from ..core.ops import Op
+from ..frontend.scanner import DeclNode, scan_snapshot
 from ..frontend.snapshot import Snapshot
 from ..ops.diff import (KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME,
                         DiffOpsTensor, diff_lift_device, diff_lift_device_pair)
@@ -36,7 +41,8 @@ class TpuTSBackend:
 
     def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
                        *, base_rev: str = "base", seed: str = "0",
-                       timestamp: str | None = None) -> BuildAndDiffResult:
+                       timestamp: str | None = None,
+                       change_signature: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(base.files)
         left_nodes = scan_snapshot(left.files)
@@ -46,11 +52,14 @@ class TpuTSBackend:
         left_t = encode_decls(left_nodes, interner)
         right_t = encode_decls(right_nodes, interner)
         t_l, t_r = diff_lift_device_pair(base_t, left_t, right_t)
-        ops_l = decode_diff_ops(t_l, interner, base_rev, seed + "/L", ts)
-        ops_r = decode_diff_ops(t_r, interner, base_rev, seed + "/R", ts)
+        diffs_l = decode_diffs(t_l, interner, base_nodes, left_nodes)
+        diffs_r = decode_diffs(t_r, interner, base_nodes, right_nodes)
+        if change_signature:
+            diffs_l = refine_signature_changes(diffs_l)
+            diffs_r = refine_signature_changes(diffs_r)
         return BuildAndDiffResult(
-            op_log_left=ops_l,
-            op_log_right=ops_r,
+            op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts),
+            op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts),
             symbol_maps={
                 "base": symbol_map(base_nodes),
                 "left": symbol_map(left_nodes),
@@ -60,15 +69,19 @@ class TpuTSBackend:
 
     def diff(self, base: Snapshot, right: Snapshot,
              *, base_rev: str = "base", seed: str = "0",
-             timestamp: str | None = None) -> List[Op]:
+             timestamp: str | None = None,
+             change_signature: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(base.files)
         right_nodes = scan_snapshot(right.files)
         interner = Interner()
         base_t = encode_decls(base_nodes, interner)
         right_t = encode_decls(right_nodes, interner)
-        return decode_diff_ops(diff_lift_device(base_t, right_t), interner,
-                               base_rev, seed + "/R", ts)
+        t = diff_lift_device(base_t, right_t)
+        diffs = decode_diffs(t, interner, base_nodes, right_nodes)
+        if change_signature:
+            diffs = refine_signature_changes(diffs)
+        return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts)
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         from ..ops.compose import compose_oplogs_device
@@ -78,67 +91,33 @@ class TpuTSBackend:
         pass
 
 
-def decode_diff_ops(t: DiffOpsTensor, interner: Interner, base_rev: str,
-                    seed: str, timestamp: str) -> List[Op]:
-    """Device op tensor → Op records, byte-identical to the host lift
-    (:func:`semantic_merge_tpu.core.difflift.lift`)."""
-    ops: List[Op] = []
-    prov = {"rev": base_rev, "timestamp": timestamp}
+def decode_diffs(t: DiffOpsTensor, interner: Interner,
+                 base_nodes: List[DeclNode],
+                 side_nodes: List[DeclNode]) -> List[Diff]:
+    """Device op stream → the host backend's ``Diff`` records.
+
+    Rows carry interned addressIds; the full node data (kind, signature
+    — needed by lift and by changeSignature refinement) is recovered by
+    addressId lookup. addressIds embed ``file::name::pos`` so they are
+    unique per node within a snapshot (reference
+    ``workers/ts/src/sast.ts:65-67``); under Map last-wins collisions
+    the device join already selected the surviving occurrence's address.
+    """
+    base_by_addr: Dict[str, DeclNode] = {n.addressId: n for n in base_nodes}
+    side_by_addr: Dict[str, DeclNode] = {n.addressId: n for n in side_nodes}
 
     def s(idx: int) -> str | None:
         return interner.lookup(int(idx)) if idx != NULL_ID else None
 
+    kinds = {KIND_RENAME: "rename", KIND_MOVE: "move",
+             KIND_ADD: "add", KIND_DELETE: "delete"}
+    diffs: List[Diff] = []
     for i in range(t.n_ops):
-        kind = int(t.kind[i])
-        sym = s(t.sym[i])
-        a_addr = s(t.a_addr[i]) or ""
-        b_addr = s(t.b_addr[i]) or ""
-        if kind == KIND_RENAME:
-            op_type = "renameSymbol"
-            op = Op.new(
-                op_type, Target(symbolId=sym, addressId=a_addr),
-                params={"oldName": s(t.a_name[i]), "newName": s(t.b_name[i]),
-                        "file": s(t.b_file[i])},
-                guards={"exists": True, "addressMatch": a_addr},
-                effects={"summary": f"rename {s(t.a_name[i])}→{s(t.b_name[i])}"},
-                provenance=dict(prov),
-                op_id=deterministic_op_id(seed, base_rev, i, op_type, sym, a_addr, b_addr),
-            )
-        elif kind == KIND_MOVE:
-            op_type = "moveDecl"
-            op = Op.new(
-                op_type, Target(symbolId=sym, addressId=a_addr),
-                params={"oldAddress": a_addr, "newAddress": b_addr,
-                        "oldFile": s(t.a_file[i]), "newFile": s(t.b_file[i])},
-                guards={"exists": True, "addressMatch": a_addr},
-                effects={"summary": f"move {a_addr}→{b_addr}"},
-                provenance=dict(prov),
-                op_id=deterministic_op_id(seed, base_rev, i, op_type, sym, a_addr, b_addr),
-            )
-        elif kind == KIND_ADD:
-            op_type = "addDecl"
-            op = Op.new(
-                op_type, Target(symbolId=sym, addressId=b_addr),
-                params={"file": s(t.b_file[i])},
-                guards={},
-                effects={"summary": "add decl"},
-                provenance=dict(prov),
-                op_id=deterministic_op_id(seed, base_rev, i, op_type, sym, "", b_addr),
-            )
-        elif kind == KIND_DELETE:
-            op_type = "deleteDecl"
-            op = Op.new(
-                op_type, Target(symbolId=sym, addressId=a_addr),
-                params={"file": s(t.a_file[i])},
-                guards={},
-                effects={"summary": "delete decl"},
-                provenance=dict(prov),
-                op_id=deterministic_op_id(seed, base_rev, i, op_type, sym, a_addr, ""),
-            )
-        else:  # padding rows should never appear below n_ops
-            raise AssertionError(f"bad kind {kind} at row {i}")
-        ops.append(op)
-    return ops
+        kind = kinds[int(t.kind[i])]
+        a = base_by_addr.get(s(t.a_addr[i]) or "")
+        b = side_by_addr.get(s(t.b_addr[i]) or "")
+        diffs.append(Diff(kind, a=a, b=b))
+    return diffs
 
 
 register_backend("tpu", TpuTSBackend)

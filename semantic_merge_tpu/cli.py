@@ -49,6 +49,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_diff.add_argument("--json-out", action="store_true", help="Emit JSON instead of a pretty listing")
     p_diff.add_argument("--backend", default=None, help="Language backend (host|tpu)")
     p_diff.add_argument("--trace", action="store_true", help="Write .semmerge-trace.json")
+    p_diff.add_argument("--change-signature", action="store_true",
+                        help="Detect changeSignature ops instead of delete+add "
+                             "(also [engine].change_signature in .semmerge.toml)")
 
     p_merge = sub.add_parser("semmerge", help="Semantic merge base A B into working tree")
     p_merge.add_argument("base")
@@ -61,6 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_merge.add_argument("--backend", default=None, help="Language backend (host|tpu)")
     p_merge.add_argument("--trace", action="store_true", help="Write .semmerge-trace.json")
     p_merge.add_argument("--seed", default=None, help="Deterministic id seed override")
+    p_merge.add_argument("--change-signature", action="store_true",
+                         help="Detect changeSignature ops instead of delete+add "
+                              "(also [engine].change_signature in .semmerge.toml)")
 
     p_rebase = sub.add_parser("semrebase", help="Replay a commit's stored op log onto a revision")
     p_rebase.add_argument("commit", help="Commit whose semmerge note holds the op log")
@@ -99,7 +105,8 @@ def _resolve_backend(name_flag: str | None):
 
 def cmd_semdiff(args: argparse.Namespace) -> int:
     tracer = Tracer(enabled=args.trace)
-    backend, _config = _resolve_backend(args.backend)
+    backend, config = _resolve_backend(args.backend)
+    change_sig = args.change_signature or config.engine.change_signature
     try:
         with tracer.phase("snapshot"):
             base_snap = snapshot_rev(args.rev1)
@@ -107,7 +114,8 @@ def cmd_semdiff(args: argparse.Namespace) -> int:
         with tracer.phase("diff"):
             ops = backend.diff(base_snap, right_snap,
                                base_rev=resolve_rev(args.rev1),
-                               timestamp=commit_timestamp_iso(args.rev2))
+                               timestamp=commit_timestamp_iso(args.rev2),
+                               change_signature=change_sig)
     finally:
         backend.close()
     if args.json_out:
@@ -141,6 +149,8 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
             result = backend.build_and_diff(
                 base_snap, left_snap, right_snap,
                 base_rev=base_rev, seed=seed, timestamp=timestamp,
+                change_signature=(args.change_signature
+                                  or config.engine.change_signature),
             )
         tracer.count("ops_left", len(result.op_log_left))
         tracer.count("ops_right", len(result.op_log_right))

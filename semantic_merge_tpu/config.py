@@ -18,6 +18,8 @@ Schema (superset of the reference's documented schema at reference
     [engine]                       # new: TPU execution knobs
     backend = "tpu"                # "tpu" | "host"
     parity_mode = true             # reproduce reference quirks bit-for-bit
+    change_signature = false       # detect changeSignature ops (off in parity mode:
+                                   # the reference emits delete+add instead)
     max_nodes_per_bucket = 2048    # padding bucket sizes, powers of two
     mesh_shape = "auto"            # or e.g. "dp=4,tp=2"
 
@@ -49,6 +51,7 @@ class CoreConfig:
 class EngineConfig:
     backend: str = "tpu"
     parity_mode: bool = True
+    change_signature: bool = False
     max_nodes_per_bucket: int = 2048
     mesh_shape: str = "auto"
 
@@ -106,6 +109,8 @@ def load_config(start: pathlib.Path | None = None) -> Config:
     config.engine = EngineConfig(
         backend=str(engine.get("backend", config.engine.backend)),
         parity_mode=bool(engine.get("parity_mode", config.engine.parity_mode)),
+        change_signature=bool(
+            engine.get("change_signature", config.engine.change_signature)),
         max_nodes_per_bucket=int(
             engine.get("max_nodes_per_bucket", config.engine.max_nodes_per_bucket)
         ),

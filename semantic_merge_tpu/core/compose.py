@@ -6,8 +6,18 @@ Observable semantics are bit-for-bit those of the reference composer
 implementation (:mod:`semantic_merge_tpu.ops.compose`) must match:
 
 - Each log is sorted by ``(type precedence, provenance.timestamp, id)``
-  and the two sorted streams are merged two-pointer style, ties taken
-  from A.
+  and the two sorted streams are merged two-pointer style. The
+  cross-stream pick compares ``(precedence, timestamp)`` only, ties
+  taken from A. Rationale: the reference's key includes the op id
+  (reference ``semmerge/compose.py:16-18``), but its ids are random
+  uuids and its timestamps wall-clock — in practice the left log is
+  lifted before the right one, so left ops carry earlier timestamps
+  and surface first. With deterministic ids and a shared per-merge
+  timestamp, comparing ids across streams would turn that into a hash
+  coin-flip — e.g. whether branch B's real ``moveDecl`` or branch A's
+  spurious rename-induced ``moveDecl`` (addressId embeds the name)
+  lands last in the move chain, flipping the merge result. A-before-B
+  on ties reproduces the reference's observed ordering, always.
 - A *DivergentRename* conflict is detected **only head-vs-head**: when
   the current heads of both streams are ``renameSymbol`` ops on the same
   symbol with different new names, a conflict is emitted and *both* ops
@@ -47,7 +57,7 @@ def compose_oplogs(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List
         a_head = ops_a[ia] if ia < len(ops_a) else None
         b_head = ops_b[ib] if ib < len(ops_b) else None
         take_a = a_head is not None and (
-            b_head is None or a_head.sort_key() <= b_head.sort_key()
+            b_head is None or a_head.sort_key()[:2] <= b_head.sort_key()[:2]
         )
         op = a_head if take_a else b_head
         other = b_head if take_a else a_head

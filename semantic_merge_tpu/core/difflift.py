@@ -60,6 +60,50 @@ def diff_nodes(base: List[DeclNode], side: List[DeclNode]) -> List[Diff]:
     return diffs
 
 
+def refine_signature_changes(diffs: List[Diff]) -> List[Diff]:
+    """Fold residual ``delete``+``add`` pairs into ``changeSig`` diffs.
+
+    The reference declares a ``changeSig`` diff kind but never produces
+    it (reference ``workers/ts/src/diff.ts:3``; TODO at reference
+    ``implementation.md:902``): editing a function's parameter or return
+    types changes its structural symbolId, so the join reports the decl
+    as deleted-and-re-added. This pass implements the designed behavior:
+    a deleted base decl and an added side decl that share
+    ``(file, name, kind)`` (names non-null) are the same declaration
+    with a changed signature.
+
+    Deterministic pairing: the k-th delete with a given key pairs with
+    the k-th add with that key. The ``changeSig`` takes the delete's
+    position in the stream; the paired add is dropped (later op ids
+    re-index, which is why this pass must run identically in every
+    backend — it is opt-in precisely because parity-with-reference mode
+    must keep the delete+add shape).
+    """
+    # Pass 1: pair each eligible delete (stream order) with the next
+    # unconsumed eligible add sharing its key.
+    pending_adds: Dict[tuple, List[int]] = {}
+    for idx, d in enumerate(diffs):
+        if d.kind == "add" and d.b is not None and d.b.name:
+            pending_adds.setdefault((d.b.file, d.b.name, d.b.kind), []).append(idx)
+    paired: Dict[int, int] = {}  # delete idx -> add idx
+    consumed: set = set()
+    for idx, d in enumerate(diffs):
+        if d.kind == "delete" and d.a is not None and d.a.name:
+            queue = pending_adds.get((d.a.file, d.a.name, d.a.kind))
+            if queue:
+                add_idx = queue.pop(0)
+                paired[idx] = add_idx
+                consumed.add(add_idx)
+    # Pass 2: rebuild the stream.
+    out: List[Diff] = []
+    for idx, d in enumerate(diffs):
+        if idx in paired:
+            out.append(Diff("changeSig", a=d.a, b=diffs[paired[idx]].b))
+        elif idx not in consumed:
+            out.append(d)
+    return out
+
+
 def lift(base_rev: str, diffs: List[Diff], *, seed: str = "0",
          timestamp: str = EPOCH_ISO) -> List[Op]:
     """Diff records → Op records.
@@ -95,6 +139,25 @@ def lift(base_rev: str, diffs: List[Diff], *, seed: str = "0",
                 effects={"summary": f"move {d.a.addressId}→{d.b.addressId}"},
                 provenance=prov,
                 op_id=_op_id(seed, base_rev, idx, "moveDecl", d),
+            ))
+        elif d.kind == "changeSig" and d.a and d.b:
+            ops.append(Op.new(
+                "changeSignature",
+                Target(symbolId=d.a.symbolId, addressId=d.a.addressId),
+                params={
+                    "name": d.a.name,
+                    "file": d.b.file,
+                    "oldSignature": d.a.signature,
+                    "newSignature": d.b.signature,
+                    "oldAddress": d.a.addressId,
+                    "newAddress": d.b.addressId,
+                    "newSymbolId": d.b.symbolId,
+                },
+                guards={"exists": True, "addressMatch": d.a.addressId},
+                effects={"summary":
+                         f"changeSignature {d.a.name}: {d.a.signature}→{d.b.signature}"},
+                provenance=prov,
+                op_id=_op_id(seed, base_rev, idx, "changeSignature", d),
             ))
         elif d.kind == "add" and d.b:
             ops.append(Op.new(

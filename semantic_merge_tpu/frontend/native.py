@@ -1,0 +1,142 @@
+"""ctypes bindings for the C++ native scanner (``native/semmerge_native.cpp``).
+
+The native library is the TPU framework's equivalent of the reference's
+native Node.js worker (reference ``workers/ts/src/sast.ts``): it owns
+the host-side hot path — tokenize + declaration indexing — and feeds
+the device encoders. The Python scanner
+(:mod:`semantic_merge_tpu.frontend.scanner`) remains the semantic
+oracle; this module returns identical ``DeclNode`` lists on ASCII
+sources and *refuses* non-ASCII snapshots (the Python scanner indexes
+by code point, the C++ one by byte — falling back keeps offsets
+bit-identical).
+
+Selection is controlled by ``SEMMERGE_NATIVE``:
+
+- ``auto`` (default): use the library if present or buildable.
+- ``1``: require it (raise if unavailable).
+- ``0``: never use it.
+
+The shared library is built on demand with ``make -C native`` the first
+time it is needed; build failures degrade to the Python path (matching
+the reference's graceful-degradation posture for optional tooling,
+reference ``semmerge/verify.py:28-30``).
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import pathlib
+import subprocess
+from typing import List, Optional, Sequence
+
+from ..utils.loggingx import logger
+from .scanner import DeclNode
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "libsemmerge_native.so"
+_ABI_VERSION = 1
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _mode() -> str:
+    return os.environ.get("SEMMERGE_NATIVE", "auto").strip().lower()
+
+
+def _build() -> bool:
+    src = _NATIVE_DIR / "semmerge_native.cpp"
+    if not src.exists():
+        return False
+    try:
+        proc = subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR), "libsemmerge_native.so"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=300,
+        )
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        logger.debug("native build unavailable: %s", exc)
+        return False
+    if proc.returncode != 0:
+        logger.warning("native frontend build failed:\n%s", proc.stdout[-2000:])
+        return False
+    return _LIB_PATH.exists()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if _mode() == "0":
+        return None
+    src = _NATIVE_DIR / "semmerge_native.cpp"
+    stale = (_LIB_PATH.exists() and src.exists()
+             and src.stat().st_mtime > _LIB_PATH.stat().st_mtime)
+    if (not _LIB_PATH.exists() or stale) and not _build():
+        if _mode() == "1":
+            raise RuntimeError(
+                f"SEMMERGE_NATIVE=1 but {_LIB_PATH} is missing and could not be built")
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError as exc:
+        if _mode() == "1":
+            raise
+        logger.warning("native frontend load failed: %s", exc)
+        return None
+    lib.smn_abi_version.restype = ctypes.c_int
+    if lib.smn_abi_version() != _ABI_VERSION:
+        logger.warning("native frontend ABI %d != expected %d; ignoring",
+                       lib.smn_abi_version(), _ABI_VERSION)
+        return None
+    lib.smn_scan_snapshot.restype = ctypes.c_void_p
+    lib.smn_scan_snapshot.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int,
+    ]
+    lib.smn_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def try_scan_snapshot(files: Sequence[dict]) -> Optional[List[DeclNode]]:
+    """Scan with the native library; ``None`` → caller should use the
+    Python path (library unavailable or snapshot not ASCII-safe)."""
+    lib = _load()
+    if lib is None:
+        return None
+    paths: List[bytes] = []
+    contents: List[bytes] = []
+    for f in files:
+        content = f["content"]
+        if not content.isascii() or not f["path"].isascii():
+            return None  # code-point vs byte offsets would diverge
+        if "\x00" in content or "\x00" in f["path"]:
+            return None  # c_char_p is NUL-terminated; C would see a prefix
+        paths.append(f["path"].encode("ascii"))
+        contents.append(content.encode("ascii"))
+    n = len(files)
+    path_arr = (ctypes.c_char_p * n)(*paths)
+    content_arr = (ctypes.c_char_p * n)(*contents)
+    ptr = lib.smn_scan_snapshot(path_arr, content_arr, n)
+    if not ptr:
+        return None
+    try:
+        raw = ctypes.string_at(ptr)
+    finally:
+        lib.smn_free(ptr)
+    records = json.loads(raw)
+    return [
+        DeclNode(
+            symbolId=r["symbolId"], addressId=r["addressId"], kind=r["kind"],
+            name=r["name"], file=r["file"], pos=r["pos"], end=r["end"],
+            signature=r["signature"],
+        )
+        for r in records
+    ]

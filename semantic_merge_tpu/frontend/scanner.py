@@ -113,7 +113,21 @@ def scan_snapshot(files: Sequence[dict]) -> List[DeclNode]:
     then scan each file, resolving annotations against that set. Files
     are processed in snapshot order, matching the program's source-file
     iteration in the reference (reference ``workers/ts/src/sast.ts:42``).
+
+    When the C++ native frontend is available (``native/``) and the
+    snapshot is ASCII, the scan runs there (same results, ~order of
+    magnitude faster host path); this Python implementation is the
+    semantic oracle and the fallback.
     """
+    from . import native  # local import: native binds against this module
+    nodes = native.try_scan_snapshot(files)
+    if nodes is not None:
+        return nodes
+    return scan_snapshot_py(files)
+
+
+def scan_snapshot_py(files: Sequence[dict]) -> List[DeclNode]:
+    """The pure-Python snapshot scan (oracle path)."""
     declared = set()
     tokens_by_file: List[tuple[str, List[Token]]] = []
     for f in files:
@@ -504,6 +518,7 @@ def _member_end(toks: List[Token], i: int, body_end: int, allow_method_body: boo
     depth = 0
     seen_eq = False
     n = body_end
+    start = i  # the ASI check must not fire on the member's own first token
     while i < n:
         t = toks[i]
         if t.text in ("(", "["):
@@ -521,7 +536,7 @@ def _member_end(toks: List[Token], i: int, body_end: int, allow_method_body: boo
                 seen_eq = True
             elif t.text in (";", ","):
                 return i + 1
-            elif t.nl_before and i > 0 and _asi_break(toks[i - 1], t):
+            elif t.nl_before and i > start and _asi_break(toks[i - 1], t):
                 return i
         i += 1
     return n

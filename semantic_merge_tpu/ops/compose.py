@@ -5,8 +5,10 @@ Lifts the reference's sequential two-pointer composer (reference
 
 1. **Canonical order** — each encoded log sorts by ``(precedence,
    timestamp rank, id rank)``; the merged order is one stable lexsort
-   of the concatenation with the side tag as final key (A wins ties),
-   which is exactly the reference's two-pointer merge order.
+   of the concatenation by ``(precedence, timestamp, side, id rank)``
+   — cross-stream order compares ``(precedence, timestamp)`` only with
+   A before B on ties, matching the host composer's two-pointer pick
+   (see the rationale in :mod:`semantic_merge_tpu.core.compose`).
 2. **Conflict detection** — DivergentRename pairs. A fully parallel
    sorted self-join finds whether any *candidate* exists (same symbol
    renamed to different names on both sides). If none — the common
@@ -56,13 +58,10 @@ def _pad_op_tensor(t: OpTensor, size: int) -> Dict[str, np.ndarray]:
     return cols
 
 
-def _key_leq(pa, ta, ia, pb, tb, ib):
-    """Lexicographic (prec, ts, id) <= comparison."""
-    return (
-        (pa < pb)
-        | ((pa == pb) & (ta < tb))
-        | ((pa == pb) & (ta == tb) & (ia <= ib))
-    )
+def _key_leq(pa, ta, pb, tb):
+    """Cross-stream (prec, ts) <= comparison — A wins ties; the op id
+    never decides cross-stream order (see module docstring)."""
+    return (pa < pb) | ((pa == pb) & (ta <= tb))
 
 
 @partial(jax.jit, static_argnames=("na", "nb"))
@@ -118,8 +117,7 @@ def _compose_kernel(a_cols, b_cols, n_a, n_b, na: int, nb: int):
             a_ok = ia < n_a
             b_ok = ib < n_b
             take_a = a_ok & (~b_ok | _key_leq(a["prec"][ia_c], a["ts_rank"][ia_c],
-                                              a["id_rank"][ia_c], b["prec"][ib_c],
-                                              b["ts_rank"][ib_c], b["id_rank"][ib_c]))
+                                              b["prec"][ib_c], b["ts_rank"][ib_c]))
             conflict = (
                 a_ok & b_ok
                 & (a["is_rename"][ia_c] == 1) & (b["is_rename"][ib_c] == 1)
@@ -163,7 +161,9 @@ def _compose_kernel(a_cols, b_cols, n_a, n_b, na: int, nb: int):
     live = valid & ~dropped
 
     prec, ts, idr = cat("prec"), cat("ts_rank"), cat("id_rank")
-    merged_order = jnp.lexsort((side, idr, ts, prec))
+    # (prec, ts, side, id): id orders rows only *within* a stream, side
+    # breaks cross-stream ties — the merged order of the two-pointer walk.
+    merged_order = jnp.lexsort((idr, side, ts, prec))
     inv = jnp.argsort(merged_order)  # row → merged position
     merged_pos = inv.astype(jnp.int32)
 

@@ -104,5 +104,11 @@ def build_mesh(devices: Sequence[jax.Device] | None = None,
     sizes = []
     for i, name in enumerate(MESH_AXES):
         sizes.append(requested[name] if requested[name] else auto[i])
+    if math.prod(sizes) != n:
+        # E.g. every axis explicitly given but their product < n: the
+        # remainder has no auto slot to land in.
+        raise ValueError(
+            f"axis sizes {dict(zip(MESH_AXES, sizes))} use "
+            f"{math.prod(sizes)} of {n} devices")
     arr = np.asarray(devices).reshape(sizes)
     return MergeMesh(mesh=Mesh(arr, MESH_AXES))

@@ -1,0 +1,143 @@
+"""changeSignature detection (the reference's declared-but-unimplemented
+diff kind, reference ``workers/ts/src/diff.ts:3``, TODO at reference
+``implementation.md:902``).
+
+Off by default (parity mode keeps the reference's delete+add shape);
+enabled via backend kwarg / ``[engine].change_signature`` /
+``--change-signature``. Host and TPU backends must agree bit-for-bit.
+"""
+from __future__ import annotations
+
+import pytest
+
+from semantic_merge_tpu.backends.ts_host import HostTSBackend
+from semantic_merge_tpu.core.difflift import (Diff, diff_nodes, lift,
+                                              refine_signature_changes)
+from semantic_merge_tpu.frontend.scanner import scan_snapshot
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+
+
+def snap(files):
+    return Snapshot(files=[{"path": p, "content": c} for p, c in files.items()])
+
+
+BASE = {"a.ts": "export function f(x: number): number { return x; }\n"
+                "export function g(y: string): string { return y; }\n"}
+# f's parameter type changes → new symbolId → delete+add in parity mode.
+SIDE = {"a.ts": "export function f(x: string): number { return 0; }\n"
+                "export function g(y: string): string { return y; }\n"}
+
+
+def _diffs(base, side):
+    return diff_nodes(scan_snapshot(snap(base).files),
+                      scan_snapshot(snap(side).files))
+
+
+class TestRefine:
+    def test_delete_add_pair_becomes_change_sig(self):
+        diffs = _diffs(BASE, SIDE)
+        kinds = sorted(d.kind for d in diffs)
+        assert kinds == ["add", "delete"]
+        refined = refine_signature_changes(diffs)
+        assert [d.kind for d in refined] == ["changeSig"]
+        d = refined[0]
+        assert d.a.name == "f" and d.b.name == "f"
+        assert d.a.signature == "fn(number)->number"
+        assert d.b.signature == "fn(string)->number"
+
+    def test_unrelated_delete_add_not_paired(self):
+        base = {"a.ts": "export function f(x: number): number { return x; }\n"}
+        side = {"a.ts": "export function h(q: boolean): boolean { return q; }\n"}
+        refined = refine_signature_changes(_diffs(base, side))
+        assert sorted(d.kind for d in refined) == ["add", "delete"]
+
+    def test_cross_file_same_name_not_paired(self):
+        base = {"a.ts": "export function f(x: number): number { return x; }\n"}
+        side = {"b.ts": "export function f(x: string): number { return 0; }\n"}
+        refined = refine_signature_changes(_diffs(base, side))
+        assert sorted(d.kind for d in refined) == ["add", "delete"]
+
+    def test_nameless_decls_never_paired(self):
+        base = {"a.ts": "const a = 1;\n"}
+        side = {"a.ts": "const a = 1, b = 2;\n"}  # vars{1} -> vars{2}
+        refined = refine_signature_changes(_diffs(base, side))
+        assert sorted(d.kind for d in refined) == ["add", "delete"]
+
+    def test_fifo_pairing_is_deterministic(self):
+        # Two same-named overload-style decls changing together: the k-th
+        # delete pairs with the k-th add.
+        base = {"a.ts": "function f(x: number): void;\n"
+                        "function f(x: number, y: number): void;\n"}
+        side = {"a.ts": "function f(x: string): void;\n"
+                        "function f(x: string, y: string): void;\n"}
+        refined = refine_signature_changes(_diffs(base, side))
+        assert [d.kind for d in refined] == ["changeSig", "changeSig"]
+        assert refined[0].a.signature == "fn(number)->void"
+        assert refined[0].b.signature == "fn(string)->void"
+        assert refined[1].a.signature == "fn(number,number)->void"
+        assert refined[1].b.signature == "fn(string,string)->void"
+
+    def test_positions_and_reindexing(self):
+        # The changeSig occupies the delete's stream position; the add is
+        # dropped so later ops re-index.
+        base = {"a.ts": "export function f(x: number): number { return x; }\n",
+                "b.ts": "export function keep(k: boolean): boolean { return k; }\n"}
+        side = {"a.ts": "export function f(x: string): number { return 0; }\n",
+                "b.ts": "export function keep(k: boolean): boolean { return k; }\n",
+                "c.ts": "export function brandNew(z: bigint): bigint { return z; }\n"}
+        diffs = _diffs(base, side)
+        refined = refine_signature_changes(diffs)
+        kinds = [d.kind for d in refined]
+        assert kinds == ["changeSig", "add"]
+        assert refined[1].b.name == "brandNew"
+
+
+class TestLift:
+    def test_change_signature_op_shape(self):
+        refined = refine_signature_changes(_diffs(BASE, SIDE))
+        ops = lift("baserev", refined, seed="s", timestamp="2024-01-01T00:00:00Z")
+        assert len(ops) == 1
+        op = ops[0]
+        assert op.type == "changeSignature"
+        assert op.params["name"] == "f"
+        assert op.params["oldSignature"] == "fn(number)->number"
+        assert op.params["newSignature"] == "fn(string)->number"
+        assert op.params["file"] == "a.ts"
+        assert op.target.symbolId and op.params["newSymbolId"]
+        assert op.target.symbolId != op.params["newSymbolId"]
+        assert op.guards["addressMatch"] == op.params["oldAddress"]
+
+    def test_deterministic_ids(self):
+        refined = refine_signature_changes(_diffs(BASE, SIDE))
+        a = lift("r", refined, seed="s", timestamp="t")
+        b = lift("r", refined, seed="s", timestamp="t")
+        assert [o.to_dict() for o in a] == [o.to_dict() for o in b]
+
+
+class TestBackends:
+    def test_host_backend_flag(self):
+        host = HostTSBackend()
+        result = host.build_and_diff(snap(BASE), snap(SIDE), snap(BASE),
+                                     change_signature=True)
+        assert [o.type for o in result.op_log_left] == ["changeSignature"]
+        assert result.op_log_right == []
+        # Default (parity mode) keeps delete+add.
+        parity = host.build_and_diff(snap(BASE), snap(SIDE), snap(BASE))
+        assert sorted(o.type for o in parity.op_log_left) == ["addDecl", "deleteDecl"]
+
+    def test_host_tpu_parity_with_change_signature(self):
+        from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+        host, tpu = HostTSBackend(), TpuTSBackend()
+        base, left = snap(BASE), snap(SIDE)
+        right = snap({"a.ts": BASE["a.ts"] + "export function h(n: never): void {}\n"})
+        kw = dict(base_rev="r", seed="s", timestamp="t", change_signature=True)
+        h = host.build_and_diff(base, left, right, **kw)
+        t = tpu.build_and_diff(base, left, right, **kw)
+        assert [o.to_dict() for o in h.op_log_left] == [o.to_dict() for o in t.op_log_left]
+        assert [o.to_dict() for o in h.op_log_right] == [o.to_dict() for o in t.op_log_right]
+        assert any(o.type == "changeSignature" for o in h.op_log_left)
+
+    def test_diff_entrypoint_flag(self):
+        host = HostTSBackend()
+        ops = host.diff(snap(BASE), snap(SIDE), change_signature=True)
+        assert [o.type for o in ops] == ["changeSignature"]

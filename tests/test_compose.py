@@ -81,12 +81,30 @@ def test_interleaved_op_masks_divergent_rename_reference_quirk():
     assert len(composed) == 3
 
 
-def test_adjacent_divergent_rename_still_detected_with_other_ops_around():
+def test_id_never_decides_cross_stream_order():
+    # Cross-stream ties compare (precedence, timestamp) only, A first —
+    # op ids are hashes here, and letting them interleave the streams
+    # would make merge results a coin flip (see core/compose.py
+    # docstring). B's smaller id must NOT promote early_b ahead of ra:
+    # ra is consumed against head early_b, so the divergent rename on
+    # "s" is masked — the same masking the reference exhibits when left
+    # ops carry earlier wall-clock timestamps than right ops.
     ra = mk("renameSymbol", "s", {"newName": "x"}, op_id="2" * 32)
     early_b = mk("renameSymbol", "unrelated", {"newName": "n"}, op_id="1" * 32)
     rb = mk("renameSymbol", "s", {"newName": "y"}, op_id="3" * 32)
     composed, conflicts = compose_oplogs([ra], [early_b, rb])
-    # early_b consumed first (smaller id); then heads are ra vs rb → conflict.
+    assert conflicts == []
+    assert len(composed) == 3
+
+
+def test_adjacent_divergent_rename_detected_with_earlier_timestamped_b_op():
+    # With a genuinely earlier timestamp, B's unrelated op is consumed
+    # first; then the heads are ra vs rb simultaneously → conflict.
+    ra = mk("renameSymbol", "s", {"newName": "x"}, op_id="2" * 32)
+    early_b = mk("renameSymbol", "unrelated", {"newName": "n"},
+                 ts="2023-01-01T00:00:00Z", op_id="1" * 32)
+    rb = mk("renameSymbol", "s", {"newName": "y"}, op_id="3" * 32)
+    composed, conflicts = compose_oplogs([ra], [early_b, rb])
     assert len(conflicts) == 1
     assert len(composed) == 1
 
@@ -130,3 +148,33 @@ def test_input_ops_not_mutated():
     move = mk("moveDecl", "s", {"newAddress": "new"}, addr="old")
     compose_oplogs([move], [])
     assert move.target.addressId == "old"
+
+
+class TestCrossStreamOrdering:
+    """Cross-stream ties order A before B — never by hash id.
+
+    Regression: side A's rename also emits a spurious moveDecl (the
+    addressId embeds the name), which collides with side B's genuine
+    file move in the move chain. Whichever materializes last wins, so
+    the pick must be deterministic and reference-shaped (left log
+    lifted first → B's move lands last) for EVERY seed.
+    """
+
+    def test_rename_plus_move_composes_to_moved_file_any_seed(self):
+        from semantic_merge_tpu.backends.ts_host import HostTSBackend
+        from semantic_merge_tpu.frontend.snapshot import Snapshot
+
+        base = Snapshot(files=[{"path": "src/util.ts",
+                                "content": "export function foo(n: number): number { return n; }\n"}])
+        left = Snapshot(files=[{"path": "src/util.ts",
+                                "content": "export function bar(n: number): number { return n; }\n"}])
+        right = Snapshot(files=[{"path": "lib/util.ts",
+                                 "content": "export function foo(n: number): number { return n; }\n"}])
+        host = HostTSBackend()
+        for seed in ("a", "b", "xyz", "0", "deadbeef"):
+            res = host.build_and_diff(base, left, right, seed=seed, timestamp="t")
+            composed, conflicts = compose_oplogs(res.op_log_left, res.op_log_right)
+            assert conflicts == []
+            renames = [o for o in composed if o.type == "renameSymbol"]
+            assert len(renames) == 1, seed
+            assert renames[0].params["file"] == "lib/util.ts", seed

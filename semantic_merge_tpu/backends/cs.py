@@ -1,21 +1,24 @@
-"""C# backend stub (reference ``semmerge/lang/cs/bridge.py:4-8``)."""
+"""C# language backend.
+
+A stub raising ``NotImplementedError`` in the reference (reference
+``semmerge/lang/cs/bridge.py:4-8``) — implemented for real here on the
+shared C-family frontend (:mod:`semantic_merge_tpu.frontend.cfamily`),
+including C#-specific constructs: namespaces (block and file-scoped),
+properties, structs, attributes, and expression-bodied members.
+"""
 from __future__ import annotations
 
 from .base import register_backend
+from .java import CFamilyBackend
 
 
-class CSBackend:
+class CSharpBackend(CFamilyBackend):
     name = "cs"
 
-    def build_and_diff(self, *args, **kwargs):
-        raise NotImplementedError("C# backend not implemented (P1)")
-
-    def diff(self, *args, **kwargs):
-        raise NotImplementedError("C# backend not implemented (P1)")
-
-    def close(self) -> None:
-        pass
+    def __init__(self) -> None:
+        from ..frontend.cfamily import CSHARP
+        self.spec = CSHARP
 
 
-register_backend("cs", CSBackend)
-register_backend("csharp", CSBackend)
+register_backend("cs", CSharpBackend)
+register_backend("csharp", CSharpBackend)

@@ -15,9 +15,16 @@ from ..core.difflift import diff_nodes, lift, refine_signature_changes
 from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
 from ..frontend.scanner import scan_snapshot
-from ..frontend.snapshot import Snapshot
+from ..frontend.snapshot import TS_EXTENSIONS, Snapshot, filter_files
 from .base import (BuildAndDiffResult, host_compose, register_backend,
                    symbol_map)
+
+
+def ts_files(snap: Snapshot):
+    """The TS/JS subset of a snapshot — the exact file set the reference
+    bridge snapshots (reference ``semmerge/lang/ts/bridge.py:75``);
+    snapshots may also carry other backends' languages."""
+    return filter_files(snap, TS_EXTENSIONS)
 
 
 class HostTSBackend:
@@ -28,9 +35,9 @@ class HostTSBackend:
                        timestamp: str | None = None,
                        change_signature: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
-        base_nodes = scan_snapshot(base.files)
-        left_nodes = scan_snapshot(left.files)
-        right_nodes = scan_snapshot(right.files)
+        base_nodes = scan_snapshot(ts_files(base))
+        left_nodes = scan_snapshot(ts_files(left))
+        right_nodes = scan_snapshot(ts_files(right))
         diffs_l = diff_nodes(base_nodes, left_nodes)
         diffs_r = diff_nodes(base_nodes, right_nodes)
         if change_signature:
@@ -51,8 +58,8 @@ class HostTSBackend:
              timestamp: str | None = None,
              change_signature: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
-        base_nodes = scan_snapshot(base.files)
-        right_nodes = scan_snapshot(right.files)
+        base_nodes = scan_snapshot(ts_files(base))
+        right_nodes = scan_snapshot(ts_files(right))
         diffs = diff_nodes(base_nodes, right_nodes)
         if change_signature:
             diffs = refine_signature_changes(diffs)

@@ -23,6 +23,7 @@ from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
 from ..frontend.scanner import DeclNode, scan_snapshot
 from ..frontend.snapshot import Snapshot
+from .ts_host import ts_files
 from ..ops.diff import (KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME,
                         DiffOpsTensor, diff_lift_device, diff_lift_device_pair)
 from .base import BuildAndDiffResult, register_backend, symbol_map
@@ -44,9 +45,9 @@ class TpuTSBackend:
                        timestamp: str | None = None,
                        change_signature: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
-        base_nodes = scan_snapshot(base.files)
-        left_nodes = scan_snapshot(left.files)
-        right_nodes = scan_snapshot(right.files)
+        base_nodes = scan_snapshot(ts_files(base))
+        left_nodes = scan_snapshot(ts_files(left))
+        right_nodes = scan_snapshot(ts_files(right))
         interner = Interner()
         base_t = encode_decls(base_nodes, interner)
         left_t = encode_decls(left_nodes, interner)
@@ -72,8 +73,8 @@ class TpuTSBackend:
              timestamp: str | None = None,
              change_signature: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
-        base_nodes = scan_snapshot(base.files)
-        right_nodes = scan_snapshot(right.files)
+        base_nodes = scan_snapshot(ts_files(base))
+        right_nodes = scan_snapshot(ts_files(right))
         interner = Interner()
         base_t = encode_decls(base_nodes, interner)
         right_t = encode_decls(right_nodes, interner)

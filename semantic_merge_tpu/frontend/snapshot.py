@@ -14,6 +14,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List
 
 TS_EXTENSIONS = {".ts", ".tsx", ".js", ".jsx"}
+# Everything any registered language backend can index. Snapshots carry
+# the union; each backend filters to its own extensions (the TS backends
+# keep reference-parity by seeing exactly the TS/JS set).
+SOURCE_EXTENSIONS = TS_EXTENSIONS | {".java", ".cs"}
 
 
 @dataclass
@@ -25,11 +29,17 @@ class Snapshot:
         return {"files": self.files, "project": self.project}
 
 
+def filter_files(snap: Snapshot, extensions) -> List[Dict[str, str]]:
+    """The subset of a snapshot's files a backend can index."""
+    return [f for f in snap.files
+            if any(f["path"].endswith(ext) for ext in extensions)]
+
+
 def snapshot_tree(root: pathlib.Path) -> Snapshot:
     root = pathlib.Path(root)
     files = []
     for path in sorted(root.rglob("*")):
-        if path.is_file() and path.suffix in TS_EXTENSIONS:
+        if path.is_file() and path.suffix in SOURCE_EXTENSIONS:
             files.append({
                 "path": path.relative_to(root).as_posix(),
                 "content": path.read_text(encoding="utf-8"),

@@ -1,0 +1,160 @@
+"""Pallas flash-attention kernel for the per-chunk attention step.
+
+The MXU hot op of the encoder (SURVEY.md §7: "pallas kernels for the
+hot ops"). Ring attention (:mod:`semantic_merge_tpu.parallel.ring`)
+rotates K/V chunks around the ``sp`` ring; for each resident chunk every
+device computes blockwise attention of its local queries over that
+chunk. This module runs that chunk computation as a fused Pallas TPU
+kernel — QKᵀ, masking, online softmax and PV accumulation never leave
+VMEM — instead of materialising the (B, H, Lq, Lk) score tensor in HBM
+the way the reference-shaped einsum path does.
+
+The kernel returns *partial* softmax statistics ``(pv, m, l)`` — the
+unnormalised weighted values, the running row max and the running row
+sum — so the caller can merge chunks across ring steps with the
+standard online-softmax combination. This is exactly the quantity the
+einsum path in ``ring.py`` carries, so the two paths are
+interchangeable (and parity-tested in interpret mode on CPU).
+
+Grid layout: ``(B, H, Lq/block_q, Lk/block_k)`` with the k axis
+innermost ("arbitrary" semantics — sequential accumulation into VMEM
+scratch); float32 accumulation, bfloat16-friendly inputs; the key
+padding mask rides a ``(B, Lk)`` block spec broadcast over heads.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# Lane width of the VPU; scratch row-stat tiles replicate across it.
+_LANES = 128
+
+
+def _chunk_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref,
+                  acc_scr, m_scr, l_scr, *, scale: float, n_k_blocks: int):
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)            # (bq, dh)
+    k = k_ref[0, 0].astype(jnp.float32)            # (bk, dh)
+    v = v_ref[0, 0].astype(jnp.float32)            # (bk, dh)
+    mask = mask_ref[0, 0] != 0                     # (bk,)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask[None, :], s, NEG_INF)       # (bq, bk)
+
+    m_prev = m_scr[:, 0]                           # (bq,)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    correction = jnp.exp(m_prev - m_new)
+    l_new = l_scr[:, 0] * correction + jnp.sum(p, axis=-1)
+    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    acc_scr[:] = acc_scr[:] * correction[:, None] + pv
+    m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(ki == n_k_blocks - 1)
+    def _emit():
+        o_ref[0, 0] = acc_scr[:]
+        m_ref[0, 0] = m_scr[:]
+        l_ref[0, 0] = l_scr[:]
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def flash_chunk_attention(q, k, v, kmask, *, block_q: int = 128,
+                          block_k: int = 128, interpret: bool = False):
+    """Partial-softmax attention of ``q`` over one resident K/V chunk.
+
+    q: (B, Lq, H, Dh); k, v: (B, Lk, H, Dh); kmask: (B, Lk) bool.
+    Returns ``(pv, m, l)`` with pv (B, Lq, H, Dh) float32 unnormalised,
+    m/l (B, H, Lq) float32 — the same partial statistics as one ring
+    step of the einsum path in :mod:`semantic_merge_tpu.parallel.ring`.
+    """
+    b, lq, h, dh = q.shape
+    lk = k.shape[1]
+    scale = dh ** -0.5
+
+    block_q = min(block_q, _round_up(lq, 8))
+    block_k = min(block_k, _round_up(lk, 8))
+    lq_p = _round_up(lq, block_q)
+    lk_p = _round_up(lk, block_k)
+
+    # (B, H, L, Dh) layout: heads become a grid axis, rows are the
+    # sublane axis of each tile.
+    qt = jnp.pad(q.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, lq_p - lq), (0, 0)))
+    kt = jnp.pad(k.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, lk_p - lk), (0, 0)))
+    vt = jnp.pad(v.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, lk_p - lk), (0, 0)))
+    # (B, 1, Lk) int32 — a singleton sublane axis satisfies the Mosaic
+    # block-shape rule (block dim == array dim) for the mask operand.
+    maskp = jnp.pad(kmask, ((0, 0), (0, lk_p - lk)))[:, None, :].astype(jnp.int32)
+
+    n_q = lq_p // block_q
+    n_k = lk_p // block_k
+    grid = (b, h, n_q, n_k)
+
+    out = pl.pallas_call(
+        functools.partial(_chunk_kernel, scale=scale, n_k_blocks=n_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, dh), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, dh), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, dh), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, dh), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            # Row stats come back lane-replicated (bq, 128) tiles — the
+            # lane axis cannot be narrower than a tile on TPU.
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, lq_p, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, lq_p, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, lq_p, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, dh), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, maskp)
+
+    pv, m, l = out
+    pv = pv[:, :, :lq].transpose(0, 2, 1, 3)  # (B, Lq, H, Dh)
+    return pv, m[:, :, :lq, 0], l[:, :, :lq, 0]
+
+
+def pallas_mode() -> str | None:
+    """How the chunk kernel should run here: ``"compiled"`` on TPU,
+    ``"interpret"`` when forced via ``SEMMERGE_PALLAS=interpret`` (CPU
+    testing), ``None`` → use the einsum path."""
+    env = os.environ.get("SEMMERGE_PALLAS", "auto").lower()
+    if env in ("0", "off", "none"):
+        return None
+    if env == "interpret":
+        return "interpret"
+    if env in ("1", "on", "compiled"):
+        return "compiled"
+    return "compiled" if jax.default_backend() == "tpu" else None

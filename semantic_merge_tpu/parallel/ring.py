@@ -24,12 +24,35 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _ring_attention_local(q, k, v, kmask, *, axis_name: str):
+def _chunk_stats_einsum(q, k_cur, v_cur, mask_cur, scale):
+    """Partial softmax stats of q over one K/V chunk — XLA einsum path.
+
+    Returns ``(pv, m_c, l_c)``: unnormalised weighted values
+    (B, Lq, H, Dh) f32 and running max/sum (B, H, Lq) relative to
+    ``m_c`` — the same contract as the Pallas kernel
+    (:func:`semantic_merge_tpu.parallel.flash.flash_chunk_attention`).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask_cur[:, None, None, :], s, NEG_INF)
+    m_c = s.max(axis=-1)
+    p = jnp.exp(s - m_c[..., None])
+    l_c = p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur,
+                    preferred_element_type=jnp.float32)
+    return pv, m_c, l_c
+
+
+def _ring_attention_local(q, k, v, kmask, *, axis_name: str,
+                          pallas: str | None = None):
     """Per-shard body under shard_map.
 
     q, k, v: (B, Lq_local, H, Dh) / (B, Lk_local, H, Dh); kmask:
     (B, Lk_local) True on real tokens. Accumulates attention of the
-    local queries over every K/V chunk in the ring.
+    local queries over every K/V chunk in the ring. The per-chunk
+    QKᵀ/softmax/PV block runs as a fused Pallas kernel on TPU
+    (``pallas="compiled"``; ``"interpret"`` for CPU testing) or as the
+    einsum path otherwise.
     """
     axis_size = lax.psum(1, axis_name)
     scale = q.shape[-1] ** -0.5
@@ -37,16 +60,18 @@ def _ring_attention_local(q, k, v, kmask, *, axis_name: str):
 
     def step(carry, _):
         o, m, l, k_cur, v_cur, mask_cur = carry
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur,
-                       preferred_element_type=jnp.float32) * scale
-        s = jnp.where(mask_cur[:, None, None, :], s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur,
-                        preferred_element_type=jnp.float32)
-        o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+        if pallas is not None:
+            from .flash import flash_chunk_attention
+            pv, m_c, l_c = flash_chunk_attention(
+                q, k_cur, v_cur, mask_cur, interpret=(pallas == "interpret"))
+        else:
+            pv, m_c, l_c = _chunk_stats_einsum(q, k_cur, v_cur, mask_cur, scale)
+        m_new = jnp.maximum(m, m_c)
+        corr = jnp.exp(m - m_new)
+        corr_c = jnp.exp(m_c - m_new)
+        l_new = l * corr + l_c * corr_c
+        o_new = (o * corr.transpose(0, 2, 1)[..., None]
+                 + pv * corr_c.transpose(0, 2, 1)[..., None])
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
         mask_next = lax.ppermute(mask_cur, axis_name, perm)
@@ -64,16 +89,23 @@ def _ring_attention_local(q, k, v, kmask, *, axis_name: str):
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def ring_attention(q, k, v, kmask, mesh: Mesh, *, axis_name: str = "sp"):
+def ring_attention(q, k, v, kmask, mesh: Mesh, *, axis_name: str = "sp",
+                   pallas: str | None = "auto"):
     """Sequence-parallel attention over ``axis_name`` of ``mesh``.
 
     Inputs are global arrays (B, L, H, Dh) with the L axis sharded over
     ``axis_name``; heads may be sharded over ``tp``; batch over ``dp``.
+    ``pallas``: ``"auto"`` (kernel on TPU, einsum elsewhere),
+    ``"compiled"`` / ``"interpret"`` to force the Pallas chunk kernel,
+    ``None`` for the einsum path.
     """
+    if pallas == "auto":
+        from .flash import pallas_mode
+        pallas = pallas_mode()
     qkv_spec = P("dp", axis_name, "tp", None)
     mask_spec = P("dp", axis_name)
     return jax.shard_map(
-        partial(_ring_attention_local, axis_name=axis_name),
+        partial(_ring_attention_local, axis_name=axis_name, pallas=pallas),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,

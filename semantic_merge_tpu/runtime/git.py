@@ -17,7 +17,7 @@ import tarfile
 import tempfile
 from typing import Iterable, List
 
-from ..frontend.snapshot import TS_EXTENSIONS, Snapshot
+from ..frontend.snapshot import SOURCE_EXTENSIONS, Snapshot
 
 
 def run_git(args: Iterable[str], cwd: pathlib.Path | None = None) -> str:
@@ -73,7 +73,7 @@ def snapshot_from_bytes(tar_bytes: bytes) -> Snapshot:
             if not member.isfile():
                 continue
             suffix = pathlib.PurePosixPath(member.name).suffix
-            if suffix not in TS_EXTENSIONS:
+            if suffix not in SOURCE_EXTENSIONS:
                 continue
             fh = tar.extractfile(member)
             if fh is None:
@@ -84,8 +84,8 @@ def snapshot_from_bytes(tar_bytes: bytes) -> Snapshot:
 
 
 def snapshot_rev(rev: str, cwd: pathlib.Path | None = None) -> Snapshot:
-    """Read a revision's TS/JS files straight into a Snapshot without
-    touching the filesystem."""
+    """Read a revision's source files straight into a Snapshot without
+    touching the filesystem (all supported languages; backends filter)."""
     return snapshot_from_bytes(archive_bytes(rev, cwd=cwd))
 
 

@@ -30,6 +30,14 @@ try:
         import optax  # noqa: F401
     except ImportError:
         pass
+    # Pallas registers a 'tpu' MLIR lowering at import time and raises
+    # once the platform registry has been stripped — import it first too
+    # (the kernels themselves run in interpret mode on CPU).
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        import jax.experimental.pallas.tpu  # noqa: F401
+    except Exception:
+        pass
     import jax._src.xla_bridge as _xb
 
     # jax may already be imported (a sitecustomize hook importing the

@@ -22,6 +22,7 @@
 //   int  smn_abi_version()
 #include <cstdint>
 #include <cstring>
+#include <algorithm>
 #include <cstdlib>
 #include <cstdio>
 #include <string>
@@ -919,7 +920,7 @@ static void append_node_json(const DeclNode& n, std::string* out) {
 
 extern "C" {
 
-int smn_abi_version() { return 1; }
+int smn_abi_version() { return 2; }
 
 // Scan a snapshot: two passes exactly like scan_snapshot() — collect
 // declared type names across all files, then scan each file in snapshot
@@ -943,6 +944,33 @@ char* smn_scan_snapshot(const char** paths, const char** contents, int n_files) 
   for (size_t k = 0; k < nodes.size(); k++) {
     if (k) out += ",";
     append_node_json(nodes[k], &out);
+  }
+  out += "]";
+  char* buf = static_cast<char*>(malloc(out.size() + 1));
+  memcpy(buf, out.data(), out.size() + 1);
+  return buf;
+}
+
+// Pass 1 only: per-file declared type names as a JSON array of sorted
+// string arrays. Lets the host-side decl cache compute the snapshot's
+// declared-set hash without falling back to the Python tokenizer.
+char* smn_type_names(const char** contents, int n_files) {
+  std::string out = "[";
+  for (int f = 0; f < n_files; f++) {
+    std::string src(contents[f]);
+    TokVec toks = tokenize(src);
+    std::vector<std::string> names;
+    for (auto& name : collect_type_names(toks)) names.push_back(name);
+    std::sort(names.begin(), names.end());
+    if (f) out += ",";
+    out += "[";
+    for (size_t k = 0; k < names.size(); k++) {
+      if (k) out += ",";
+      out += "\"";
+      json_escape(names[k], &out);
+      out += "\"";
+    }
+    out += "]";
   }
   out += "]";
   char* buf = static_cast<char*>(malloc(out.size() + 1));

@@ -1,0 +1,67 @@
+"""Shared backend body for the C-family language frontends.
+
+Java and C# differ only in their :class:`LanguageSpec`; everything from
+snapshot filtering through diff/lift/compose is identical and lives
+here, parallel to the shared scanner in
+:mod:`semantic_merge_tpu.frontend.cfamily`.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..core.difflift import diff_nodes, lift, refine_signature_changes
+from ..core.ids import EPOCH_ISO
+from ..core.ops import Op
+from ..frontend.cfamily import LanguageSpec, scan_snapshot_cfamily
+from ..frontend.snapshot import Snapshot, filter_files
+from .base import BuildAndDiffResult, host_compose, symbol_map
+
+
+class CFamilyBackend:
+    """Backend over the C-family scanner; subclasses set ``spec``."""
+
+    spec: LanguageSpec
+
+    def _filter(self, snap: Snapshot):
+        return filter_files(snap, self.spec.extensions)
+
+    def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
+                       *, base_rev: str = "base", seed: str = "0",
+                       timestamp: str | None = None,
+                       change_signature: bool = False) -> BuildAndDiffResult:
+        ts = timestamp or EPOCH_ISO
+        base_nodes = scan_snapshot_cfamily(self._filter(base), self.spec)
+        left_nodes = scan_snapshot_cfamily(self._filter(left), self.spec)
+        right_nodes = scan_snapshot_cfamily(self._filter(right), self.spec)
+        diffs_l = diff_nodes(base_nodes, left_nodes)
+        diffs_r = diff_nodes(base_nodes, right_nodes)
+        if change_signature:
+            diffs_l = refine_signature_changes(diffs_l)
+            diffs_r = refine_signature_changes(diffs_r)
+        return BuildAndDiffResult(
+            op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts),
+            op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts),
+            symbol_maps={
+                "base": symbol_map(base_nodes),
+                "left": symbol_map(left_nodes),
+                "right": symbol_map(right_nodes),
+            },
+        )
+
+    def diff(self, base: Snapshot, right: Snapshot,
+             *, base_rev: str = "base", seed: str = "0",
+             timestamp: str | None = None,
+             change_signature: bool = False) -> List[Op]:
+        ts = timestamp or EPOCH_ISO
+        base_nodes = scan_snapshot_cfamily(self._filter(base), self.spec)
+        right_nodes = scan_snapshot_cfamily(self._filter(right), self.spec)
+        diffs = diff_nodes(base_nodes, right_nodes)
+        if change_signature:
+            diffs = refine_signature_changes(diffs)
+        return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts)
+
+    def compose(self, delta_a: List[Op], delta_b: List[Op]):
+        return host_compose(delta_a, delta_b)
+
+    def close(self) -> None:
+        pass

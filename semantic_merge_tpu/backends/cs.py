@@ -9,7 +9,7 @@ properties, structs, attributes, and expression-bodied members.
 from __future__ import annotations
 
 from .base import register_backend
-from .java import CFamilyBackend
+from .cfamily import CFamilyBackend
 
 
 class CSharpBackend(CFamilyBackend):

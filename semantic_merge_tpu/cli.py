@@ -93,6 +93,8 @@ def main(argv: Sequence[str] | None = None) -> int:
 
 def _resolve_backend(name_flag: str | None):
     config = load_config()
+    from .frontend.declcache import configure as configure_cache
+    configure_cache(config.core.memory_cap_mb)
     name = name_flag or config.engine.backend
     try:
         return get_backend(name), config
@@ -154,6 +156,11 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
             )
         tracer.count("ops_left", len(result.op_log_left))
         tracer.count("ops_right", len(result.op_log_right))
+        from .frontend.declcache import global_cache
+        cache = global_cache()
+        if cache is not None:  # cache hit rate (reference architecture.md:248)
+            tracer.count("decl_cache_hits", cache.hits)
+            tracer.count("decl_cache_misses", cache.misses)
 
         with tracer.phase("compose"):
             compose_fn = getattr(backend, "compose", None) or compose_oplogs

@@ -187,7 +187,8 @@ def _scan_region(path: str, toks: List[Token], lo: int, hi: int,
             members += 1
             seg_start = i
             continue
-        if text == "@" and i + 1 < hi and toks[i + 1].text == "interface":
+        if (text == "@" and i + 1 < hi and toks[i + 1].text == "interface"
+                and i + 2 < hi and toks[i + 2].type == IDENT):
             # Java annotation type — indexed as an interface.
             i = _scan_type_decl(path, toks, seg_start, i + 1, hi, spec, nodes,
                                 kind_override="InterfaceDeclaration")
@@ -463,8 +464,13 @@ def _scan_member(path: str, toks: List[Token], seg_start: int, i: int, hi: int,
 
     if decisive in ("=", ";"):
         # Field declaration: `<type> a = ..., b;` — count declarators.
-        name_tok = toks[k - 1] if k - 1 >= head_start else None
-        if name_tok is None or name_tok.type != IDENT or k - 1 == head_start:
+        # Legacy array suffix (`int a[];`) puts brackets between the
+        # name and the decisive token.
+        name_at = k - 1
+        while name_at - 1 >= head_start and toks[name_at].text in ("[", "]"):
+            name_at -= 1
+        name_tok = toks[name_at] if name_at >= head_start else None
+        if name_tok is None or name_tok.type != IDENT or name_at == head_start:
             # No type+name pair — a bare statement; skip it.
             m = k
             while m < hi and toks[m].text != ";":

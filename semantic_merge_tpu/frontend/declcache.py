@@ -1,0 +1,134 @@
+"""Incremental declaration-index cache.
+
+The reference *designs* a warm-cache story it never implements: parse
+caches with memory caps and adaptive eviction (reference
+``architecture.md:206-208, 313-314``; ``requirements.md:171``
+[NFR-PERF-004]; ``semmerge/config.py:23`` ``memory_cap_mb`` — dead
+code there). This module implements it: scan results are cached per
+``(path, content-hash, declared-set-hash)`` with LRU eviction bounded
+by ``memory_cap_mb``.
+
+Why the declared-set hash is part of the key: the scanner resolves type
+annotations against the set of type names declared anywhere in the
+snapshot (the stand-in for the reference worker's no-default-lib
+``ts.TypeChecker``, reference ``workers/ts/src/sast.ts:19-22``), so an
+*unchanged* file's signatures can legitimately change when another file
+adds or removes a type declaration. Keying on the global declared-set
+hash keeps the cache exact, never heuristic: any snapshot that would
+produce different decl nodes misses.
+
+Within a single three-way merge the base/left/right snapshots share
+almost every file (a 10k-file repo with 200 changed files re-scans 200
+files, not 30k), and repeated merges in one process (watch mode, the
+bench harness, the merge driver's repo-level run) hit across calls —
+the reference's "warm cache e2e merge ≤ 10 s" budget
+(reference ``architecture.md:313``).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+from collections import OrderedDict
+from typing import Any, Hashable, Optional
+
+DEFAULT_CAP_MB = 512
+
+
+class DeclCache:
+    """LRU cache bounded by an approximate byte budget."""
+
+    def __init__(self, cap_mb: int = DEFAULT_CAP_MB) -> None:
+        self.cap_bytes = cap_mb * 1024 * 1024
+        self._store: "OrderedDict[Hashable, tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        entry = self._store.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._store.move_to_end(key)
+        self.hits += 1
+        return entry[0]
+
+    def put(self, key: Hashable, value: Any, size: int | None = None) -> None:
+        size = size if size is not None else approx_size(value)
+        old = self._store.pop(key, None)
+        if old is not None:
+            self._bytes -= old[1]
+        self._store[key] = (value, size)
+        self._bytes += size
+        while self._bytes > self.cap_bytes and len(self._store) > 1:
+            _, (_, evicted_size) = self._store.popitem(last=False)
+            self._bytes -= evicted_size
+            self.evictions += 1
+
+    def set_cap_mb(self, cap_mb: int) -> None:
+        self.cap_bytes = cap_mb * 1024 * 1024
+
+    def clear(self) -> None:
+        self._store.clear()
+        self._bytes = 0
+
+    @property
+    def n_entries(self) -> int:
+        return len(self._store)
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "entries": len(self._store),
+                "bytes": self._bytes}
+
+
+def approx_size(value: Any) -> int:
+    """Rough byte estimate for cap accounting — strings dominate."""
+    if isinstance(value, (list, tuple, frozenset, set)):
+        return 64 + sum(approx_size(v) for v in value)
+    if isinstance(value, str):
+        return 49 + len(value)
+    if hasattr(value, "__dict__"):
+        return 64 + sum(approx_size(v) for v in vars(value).values())
+    return max(sys.getsizeof(value, 64), 16)
+
+
+def content_hash(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()[:32]
+
+
+def declared_hash(declared) -> str:
+    return hashlib.sha256("\n".join(sorted(declared)).encode("utf-8")).hexdigest()[:32]
+
+
+_GLOBAL: Optional[DeclCache] = None
+
+
+def enabled() -> bool:
+    return os.environ.get("SEMMERGE_CACHE", "1").strip().lower() not in ("0", "off")
+
+
+def global_cache() -> Optional[DeclCache]:
+    """The process-wide cache, or ``None`` when disabled
+    (``SEMMERGE_CACHE=0``)."""
+    global _GLOBAL
+    if not enabled():
+        return None
+    if _GLOBAL is None:
+        _GLOBAL = DeclCache()
+    return _GLOBAL
+
+
+def configure(memory_cap_mb: int) -> None:
+    """Apply the ``[core] memory_cap_mb`` budget (the CLI calls this
+    once config is loaded). Half the budget goes to the decl cache; the
+    rest stays headroom for snapshots and device buffers."""
+    cache = global_cache()
+    if cache is not None:
+        cache.set_cap_mb(max(1, memory_cap_mb // 2))

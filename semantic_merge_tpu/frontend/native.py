@@ -35,7 +35,7 @@ from .scanner import DeclNode
 
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
 _LIB_PATH = _NATIVE_DIR / "libsemmerge_native.so"
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
@@ -96,6 +96,8 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
         ctypes.c_int,
     ]
+    lib.smn_type_names.restype = ctypes.c_void_p
+    lib.smn_type_names.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
     lib.smn_free.argtypes = [ctypes.c_void_p]
     _lib = lib
     return _lib
@@ -103,6 +105,30 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def try_type_names(files: Sequence[dict]) -> Optional[List[frozenset]]:
+    """Per-file declared type names via the native tokenizer (pass 1 of
+    the scan); ``None`` → caller should tokenize in Python."""
+    lib = _load()
+    if lib is None:
+        return None
+    contents: List[bytes] = []
+    for f in files:
+        content = f["content"]
+        if not content.isascii() or "\x00" in content:
+            return None
+        contents.append(content.encode("ascii"))
+    n = len(files)
+    content_arr = (ctypes.c_char_p * n)(*contents)
+    ptr = lib.smn_type_names(content_arr, n)
+    if not ptr:
+        return None
+    try:
+        raw = ctypes.string_at(ptr)
+    finally:
+        lib.smn_free(ptr)
+    return [frozenset(names) for names in json.loads(raw)]
 
 
 def try_scan_snapshot(files: Sequence[dict]) -> Optional[List[DeclNode]]:

@@ -118,12 +118,115 @@ def scan_snapshot(files: Sequence[dict]) -> List[DeclNode]:
     snapshot is ASCII, the scan runs there (same results, ~order of
     magnitude faster host path); this Python implementation is the
     semantic oracle and the fallback.
+
+    Per-file results are memoized in the process-wide decl cache
+    (:mod:`semantic_merge_tpu.frontend.declcache`): within one 3-way
+    merge the base/left/right snapshots share almost every file, so only
+    changed files re-scan.
     """
+    from .declcache import global_cache
+    cache = global_cache()
+    if cache is not None:
+        return _scan_snapshot_cached(files, cache)
     from . import native  # local import: native binds against this module
     nodes = native.try_scan_snapshot(files)
     if nodes is not None:
         return nodes
     return scan_snapshot_py(files)
+
+
+# A file path that cannot collide with real snapshot paths carries the
+# synthetic type declarations when a cache-miss subset scans natively
+# (its nodes are filtered out of the result).
+_SYNTH_PATH = "__semmerge_synthetic_decls__.d.ts"
+
+
+def _scan_snapshot_cached(files: Sequence[dict], cache) -> List[DeclNode]:
+    from .declcache import content_hash, declared_hash
+
+    # Pass 1 — the global declared-type-name set, from per-file cached
+    # name sets (cache key: content hash alone; names don't depend on
+    # other files). Misses batch through the native tokenizer when
+    # available so a cold scan stays native-speed.
+    from . import native
+    hashes: List[str] = []
+    toks_for: Dict[int, List[Token]] = {}
+    name_sets: List[frozenset | None] = []
+    type_miss: List[int] = []
+    for idx, f in enumerate(files):
+        h = content_hash(f["content"])
+        hashes.append(h)
+        names = cache.get(("types", h))
+        if names is None:
+            type_miss.append(idx)
+        name_sets.append(names)
+    if type_miss:
+        native_names = native.try_type_names([files[i] for i in type_miss])
+        for j, idx in enumerate(type_miss):
+            if native_names is not None:
+                names = native_names[j]
+            else:
+                toks = tokenize(files[idx]["content"])
+                toks_for[idx] = toks
+                names = frozenset(_collect_type_names(toks))
+            cache.put(("types", hashes[idx]), names)
+            name_sets[idx] = names
+    declared: set[str] = set().union(*name_sets) if name_sets else set()
+    dh = declared_hash(declared)
+
+    # Pass 2 — per-file decl nodes keyed by (path, content, declared set).
+    out_slots: List[List[DeclNode] | None] = [None] * len(files)
+    miss_idx: List[int] = []
+    for idx, f in enumerate(files):
+        key = ("decls", normalize_path(f["path"]), hashes[idx], dh)
+        hit = cache.get(key)
+        if hit is not None:
+            out_slots[idx] = hit
+        else:
+            miss_idx.append(idx)
+
+    if miss_idx:
+        scanned = _scan_subset([files[i] for i in miss_idx], declared,
+                               [toks_for.get(i) for i in miss_idx])
+        for slot, nodes in zip(miss_idx, scanned):
+            out_slots[slot] = nodes
+            cache.put(("decls", normalize_path(files[slot]["path"]),
+                       hashes[slot], dh), nodes)
+
+    result: List[DeclNode] = []
+    for nodes in out_slots:
+        result.extend(nodes or [])
+    return result
+
+
+def _scan_subset(files: Sequence[dict], declared: set[str],
+                 toks: Sequence[List[Token] | None]) -> List[List[DeclNode]]:
+    """Scan a subset of a snapshot against a *global* declared set;
+    returns per-file node lists in input order."""
+    from . import native
+
+    # Native path: prepend a synthetic file declaring every global type
+    # name, so the library's internally-computed declared set equals the
+    # full snapshot's; its nodes are dropped from the result.
+    if not any(normalize_path(f["path"]) == _SYNTH_PATH for f in files):
+        synth_names = sorted(n for n in declared if n.isascii())
+        if len(synth_names) == len(declared):
+            synth = {"path": _SYNTH_PATH,
+                     "content": "".join(f"interface {n} {{}}\n" for n in synth_names)}
+            nodes = native.try_scan_snapshot([synth, *files])
+            if nodes is not None:
+                by_file: Dict[str, List[DeclNode]] = {}
+                for n in nodes:
+                    if n.file != _SYNTH_PATH:
+                        by_file.setdefault(n.file, []).append(n)
+                return [by_file.get(normalize_path(f["path"]), []) for f in files]
+
+    out: List[List[DeclNode]] = []
+    for f, t in zip(files, toks):
+        if t is None:
+            t = tokenize(f["content"])
+        out.append(_scan_tokens(normalize_path(f["path"]), t, declared))
+    return out
 
 
 def scan_snapshot_py(files: Sequence[dict]) -> List[DeclNode]:

@@ -243,3 +243,13 @@ def test_field_declarator_count_ignores_generic_commas():
     by_name = {n.name: n for n in nodes}
     assert by_name["m"].signature == "vars{1}"
     assert by_name["a"].signature == "vars{2}"
+
+
+def test_java_legacy_array_field_and_truncated_annotation():
+    nodes = scan_file_cfamily("A.java", "class A { int a[]; int b; }", JAVA)
+    by_name = {n.name: n for n in nodes}
+    assert by_name["a"].signature == "vars{1}"
+    assert by_name["A"].signature == "class{2}"
+    # Truncated file must not raise.
+    nodes = scan_file_cfamily("X.java", "class A {}\n@interface", JAVA)
+    assert [n.name for n in nodes] == ["A"]

@@ -1,0 +1,111 @@
+"""Decl-index cache: exactness, cross-file invalidation, eviction.
+
+The cache implements the reference's designed-but-unbuilt warm-cache
+story (reference ``architecture.md:206-208,313``; [NFR-PERF-004]) and
+must never change scan results — every test compares against a
+cache-disabled oracle scan.
+"""
+import numpy as np
+import pytest
+
+from semantic_merge_tpu.frontend import scanner
+from semantic_merge_tpu.frontend.declcache import DeclCache
+from semantic_merge_tpu.frontend.scanner import scan_snapshot_py
+
+
+def _scan_cached(files, cache):
+    return scanner._scan_snapshot_cached(files, cache)
+
+
+def _as_dicts(nodes):
+    return [n.to_dict() | {"signature": n.signature} for n in nodes]
+
+
+FILES = [
+    {"path": "src/a.ts", "content":
+     "export interface Foo { x: number }\nexport function mk(): Foo { return {x: 1}; }\n"},
+    {"path": "src/b.ts", "content":
+     "export function use(f: Foo): number { return f.x; }\n"},
+]
+
+
+def test_cached_scan_matches_oracle():
+    cache = DeclCache()
+    assert _as_dicts(_scan_cached(FILES, cache)) == _as_dicts(scan_snapshot_py(FILES))
+    # Second scan is all hits and still identical.
+    h0 = cache.hits
+    assert _as_dicts(_scan_cached(FILES, cache)) == _as_dicts(scan_snapshot_py(FILES))
+    assert cache.hits > h0
+
+
+def test_cross_file_type_dependency_invalidates():
+    """Removing a.ts's interface changes b.ts's signature (Foo resolves
+    to any) even though b.ts itself is unchanged — the declared-set hash
+    must force a rescan, not serve the stale node."""
+    cache = DeclCache()
+    full = _scan_cached(FILES, cache)
+    use_full = next(n for n in full if n.name == "use")
+    assert "Foo" in use_full.signature
+
+    only_b = [FILES[1]]
+    partial = _scan_cached(only_b, cache)
+    use_partial = next(n for n in partial if n.name == "use")
+    assert _as_dicts(partial) == _as_dicts(scan_snapshot_py(only_b))
+    assert "Foo" not in use_partial.signature
+    assert "any" in use_partial.signature
+
+
+def test_three_way_sharing_hits():
+    """base/left/right share unchanged files — the second and third
+    snapshot scans should mostly hit."""
+    base = [{"path": f"src/m{i}.ts",
+             "content": f"export function f{i}(x: number): number {{ return {i}; }}\n"}
+            for i in range(20)]
+    left = [dict(f) for f in base]
+    left[3] = {"path": "src/m3.ts",
+               "content": "export function renamed3(x: number): number { return 3; }\n"}
+    cache = DeclCache()
+    _scan_cached(base, cache)
+    misses_after_base = cache.misses
+    out_left = _scan_cached(left, cache)
+    # Only the changed file misses the decl layer (plus its type-name entry).
+    assert cache.misses - misses_after_base <= 2
+    assert _as_dicts(out_left) == _as_dicts(scan_snapshot_py(left))
+
+
+def test_eviction_respects_cap_and_stays_correct():
+    cache = DeclCache(cap_mb=1)
+    cache.cap_bytes = 20_000  # force pressure with a small workload
+    rng = np.random.RandomState(0)
+    for round_ in range(3):
+        files = [{"path": f"f{i}.ts",
+                  "content": f"export function g{i}_{round_}(x: number): number "
+                             f"{{ return {int(rng.randint(100))}; }}\n" + "// pad" * 200}
+                 for i in range(50)]
+        out = _scan_cached(files, cache)
+        assert _as_dicts(out) == _as_dicts(scan_snapshot_py(files))
+    assert cache.bytes_used <= cache.cap_bytes
+    assert cache.evictions > 0
+
+
+def test_native_subset_scan_uses_global_declared_set():
+    """A cache-miss subset scanned natively must still resolve type
+    names declared in files outside the subset (the synthetic-decls
+    mechanism)."""
+    from semantic_merge_tpu.frontend import native
+    if not native.available():
+        pytest.skip("native frontend unavailable")
+    cache = DeclCache()
+    # Prime the cache with a.ts only; b.ts then misses while Foo comes
+    # from the already-cached a.ts.
+    _scan_cached([FILES[0]], cache)
+    out = _scan_cached(FILES, cache)
+    use = next(n for n in out if n.name == "use")
+    assert "Foo" in use.signature
+    assert _as_dicts(out) == _as_dicts(scan_snapshot_py(FILES))
+
+
+def test_cache_disabled_env(monkeypatch):
+    from semantic_merge_tpu.frontend import declcache
+    monkeypatch.setenv("SEMMERGE_CACHE", "0")
+    assert declcache.global_cache() is None

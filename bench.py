@@ -45,13 +45,16 @@ def _unique_params(idx: int, n_digits: int) -> str:
     return ", ".join(f"p{k}: {t}" for k, t in enumerate(digits))
 
 
-def synth_repo(n_files: int, decls_per_file: int):
+def synth_repo(n_files: int, decls_per_file: int, divergent: bool = False):
     """Three snapshots of an ``n_files`` TS repo.
 
     Side A renames one function per even-indexed file; side B moves
     every odd-indexed file into ``lib/`` (a cross-file decl move, the
     flagship scenario of the reference's ``tests/e2e_basic.sh``); a few
-    files gain or lose a declaration so every diff kind appears.
+    files gain or lose a declaration so every diff kind appears. With
+    ``divergent``, side B renames a sprinkling of the functions side A
+    also renamed — to a *different* name — the DivergentRename conflict
+    workload of measurement-ladder rung 5.
     """
     total = n_files * decls_per_file
     n_digits = 1
@@ -77,7 +80,11 @@ def synth_repo(n_files: int, decls_per_file: int):
         else:
             left.append({"path": path, "content": content})
 
-        if i % 2 == 1:
+        if divergent and i % 2 == 0 and i % 96 == 0:
+            right.append({"path": path,
+                          "content": content.replace(f"function fn{i}_0(",
+                                                     f"function other{i}_0(")})
+        elif i % 2 == 1:
             right.append({"path": f"lib/mod{i:05d}.ts", "content": content})
         elif i % 23 == 0:
             lines = content.splitlines(keepends=True)
@@ -103,16 +110,33 @@ def time_merge(backend, base, left, right, *, repeats: int = 3) -> float:
     return best
 
 
+# BASELINE.json measurement ladder (rung 1 is the e2e pytest scenario).
+PRESETS = {
+    "rung2": {"files": 100, "decls": 6},
+    "rung3": {"files": 1000, "decls": 6},
+    "rung4": {"files": 5000, "decls": 4},
+    "rung5": {"files": 10000, "decls": 4, "conflicts": True},
+}
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--files", type=int, default=512)
     parser.add_argument("--decls", type=int, default=6)
+    parser.add_argument("--preset", choices=sorted(PRESETS),
+                        help="BASELINE.json ladder rung (overrides --files/--decls)")
     parser.add_argument("--json-only", action="store_true")
     args = parser.parse_args()
+    conflicts_expected = False
+    if args.preset:
+        p = PRESETS[args.preset]
+        args.files, args.decls = p["files"], p["decls"]
+        conflicts_expected = p.get("conflicts", False)
 
     from semantic_merge_tpu.backends.base import get_backend
 
-    base, left, right = synth_repo(args.files, args.decls)
+    base, left, right = synth_repo(args.files, args.decls,
+                                   divergent=conflicts_expected)
 
     tpu = get_backend("tpu")
     host = get_backend("host")

@@ -23,6 +23,9 @@
 #include <cstdint>
 #include <cstring>
 #include <algorithm>
+#include <atomic>
+#include <functional>
+#include <thread>
 #include <cstdlib>
 #include <cstdio>
 #include <string>
@@ -918,32 +921,67 @@ static void append_node_json(const DeclNode& n, std::string* out) {
 // ---------------------------------------------------------------------------
 // C ABI.
 
+// ---------------------------------------------------------------------------
+// Parallel helper: run fn(i) for i in [0, n) across a small thread pool.
+// Per-file work (tokenize / scan) is independent; only the declared-set
+// merge and output concatenation are sequential — the work-stealing
+// parse/bind pool the reference designs but never builds (reference
+// architecture.md "parallelism model": parallel per file/package).
+
+static void parallel_for(int n, const std::function<void(int)>& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int n_threads = int(hw ? hw : 4);
+  if (n_threads > n) n_threads = n;
+  if (n_threads <= 1 || n < 32) {  // small snapshots: threads cost more
+    for (int i = 0; i < n; i++) fn(i);
+    return;
+  }
+  std::atomic<int> next{0};
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  for (int t = 0; t < n_threads; t++) {
+    pool.emplace_back([&] {
+      for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) fn(i);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
 extern "C" {
 
 int smn_abi_version() { return 2; }
 
 // Scan a snapshot: two passes exactly like scan_snapshot() — collect
 // declared type names across all files, then scan each file in snapshot
-// order. Returns a malloc'd JSON array; free with smn_free.
+// order. Per-file tokenize and scan run thread-parallel; node order
+// stays deterministic (concatenation in snapshot order). Returns a
+// malloc'd JSON array; free with smn_free.
 char* smn_scan_snapshot(const char** paths, const char** contents, int n_files) {
-  std::vector<std::pair<std::string, TokVec>> tokens_by_file;
-  std::vector<std::string> sources;  // keep source buffers alive for string_views
-  tokens_by_file.reserve(n_files);
-  sources.reserve(n_files);
+  std::vector<std::string> sources(n_files);
+  std::vector<std::string> norm_paths(n_files);
+  std::vector<TokVec> toks(n_files);
+  std::vector<StrSet> names(n_files);
+  parallel_for(n_files, [&](int f) {
+    sources[f] = contents[f];
+    norm_paths[f] = normalize_path(paths[f]);
+    toks[f] = tokenize(sources[f]);
+    names[f] = collect_type_names(toks[f]);
+  });
   StrSet declared;
-  for (int f = 0; f < n_files; f++) {
-    sources.emplace_back(contents[f]);
-    std::string path = normalize_path(paths[f]);
-    TokVec toks = tokenize(sources.back());
-    for (auto& name : collect_type_names(toks)) declared.insert(name);
-    tokens_by_file.emplace_back(std::move(path), std::move(toks));
-  }
-  std::vector<DeclNode> nodes;
-  for (auto& [path, toks] : tokens_by_file) scan_tokens(path, toks, declared, &nodes);
+  for (int f = 0; f < n_files; f++)
+    for (auto& name : names[f]) declared.insert(name);
+  std::vector<std::vector<DeclNode>> per_file(n_files);
+  parallel_for(n_files, [&](int f) {
+    scan_tokens(norm_paths[f], toks[f], declared, &per_file[f]);
+  });
   std::string out = "[";
-  for (size_t k = 0; k < nodes.size(); k++) {
-    if (k) out += ",";
-    append_node_json(nodes[k], &out);
+  bool first = true;
+  for (int f = 0; f < n_files; f++) {
+    for (auto& node : per_file[f]) {
+      if (!first) out += ",";
+      first = false;
+      append_node_json(node, &out);
+    }
   }
   out += "]";
   char* buf = static_cast<char*>(malloc(out.size() + 1));
@@ -955,24 +993,76 @@ char* smn_scan_snapshot(const char** paths, const char** contents, int n_files) 
 // string arrays. Lets the host-side decl cache compute the snapshot's
 // declared-set hash without falling back to the Python tokenizer.
 char* smn_type_names(const char** contents, int n_files) {
-  std::string out = "[";
-  for (int f = 0; f < n_files; f++) {
+  std::vector<std::vector<std::string>> per_file(n_files);
+  parallel_for(n_files, [&](int f) {
     std::string src(contents[f]);
     TokVec toks = tokenize(src);
-    std::vector<std::string> names;
-    for (auto& name : collect_type_names(toks)) names.push_back(name);
-    std::sort(names.begin(), names.end());
+    for (auto& name : collect_type_names(toks)) per_file[f].push_back(name);
+    std::sort(per_file[f].begin(), per_file[f].end());
+  });
+  std::string out = "[";
+  for (int f = 0; f < n_files; f++) {
     if (f) out += ",";
     out += "[";
-    for (size_t k = 0; k < names.size(); k++) {
+    for (size_t k = 0; k < per_file[f].size(); k++) {
       if (k) out += ",";
       out += "\"";
-      json_escape(names[k], &out);
+      json_escape(per_file[f][k], &out);
       out += "\"";
     }
     out += "]";
   }
   out += "]";
+  char* buf = static_cast<char*>(malloc(out.size() + 1));
+  memcpy(buf, out.data(), out.size() + 1);
+  return buf;
+}
+
+// Combined cold-path entry: one tokenize pass yields BOTH the per-file
+// declared type names (for the host decl cache's keys) and the decl
+// nodes — a fully-cold cached scan costs exactly one native pass.
+// Returns {"names": [[...], ...], "nodes": [...]}.
+char* smn_scan_with_names(const char** paths, const char** contents, int n_files) {
+  std::vector<std::string> sources(n_files);
+  std::vector<std::string> norm_paths(n_files);
+  std::vector<TokVec> toks(n_files);
+  std::vector<std::vector<std::string>> names(n_files);
+  parallel_for(n_files, [&](int f) {
+    sources[f] = contents[f];
+    norm_paths[f] = normalize_path(paths[f]);
+    toks[f] = tokenize(sources[f]);
+    for (auto& name : collect_type_names(toks[f])) names[f].push_back(name);
+    std::sort(names[f].begin(), names[f].end());
+  });
+  StrSet declared;
+  for (int f = 0; f < n_files; f++)
+    for (auto& name : names[f]) declared.insert(name);
+  std::vector<std::vector<DeclNode>> per_file(n_files);
+  parallel_for(n_files, [&](int f) {
+    scan_tokens(norm_paths[f], toks[f], declared, &per_file[f]);
+  });
+  std::string out = "{\"names\":[";
+  for (int f = 0; f < n_files; f++) {
+    if (f) out += ",";
+    out += "[";
+    for (size_t k = 0; k < names[f].size(); k++) {
+      if (k) out += ",";
+      out += "\"";
+      json_escape(names[f][k], &out);
+      out += "\"";
+    }
+    out += "]";
+  }
+  out += "],\"nodes\":[";
+  bool first = true;
+  for (int f = 0; f < n_files; f++) {
+    for (auto& node : per_file[f]) {
+      if (!first) out += ",";
+      first = false;
+      append_node_json(node, &out);
+    }
+  }
+  out += "]}";
   char* buf = static_cast<char*>(malloc(out.size() + 1));
   memcpy(buf, out.data(), out.size() + 1);
   return buf;

@@ -96,6 +96,14 @@ def _resolve_backend(name_flag: str | None):
     from .frontend.declcache import configure as configure_cache
     configure_cache(config.core.memory_cap_mb)
     name = name_flag or config.engine.backend
+    if name in ("tpu", "ts_tpu"):
+        # No-op single-host; on pods every process joins the global
+        # mesh before any device code runs.
+        from .parallel.distributed import init_distributed
+        try:
+            init_distributed()
+        except Exception as exc:
+            logger.warning("distributed bring-up failed (%s); continuing single-host", exc)
     try:
         return get_backend(name), config
     except Exception as exc:  # TPU backend unavailable → host fallback

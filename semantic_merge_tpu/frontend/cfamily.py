@@ -465,10 +465,12 @@ def _scan_member(path: str, toks: List[Token], seg_start: int, i: int, hi: int,
     if decisive in ("=", ";"):
         # Field declaration: `<type> a = ..., b;` — count declarators.
         # Legacy array suffix (`int a[];`) puts brackets between the
-        # name and the decisive token.
+        # name and the decisive token — but only *empty* `[]` pairs walk
+        # back, so `arr[idx] = val;` stays a bare statement, not a field.
         name_at = k - 1
-        while name_at - 1 >= head_start and toks[name_at].text in ("[", "]"):
-            name_at -= 1
+        while (name_at - 1 >= head_start and toks[name_at].text == "]"
+               and toks[name_at - 1].text == "["):
+            name_at -= 2
         name_tok = toks[name_at] if name_at >= head_start else None
         if name_tok is None or name_tok.type != IDENT or name_at == head_start:
             # No type+name pair — a bare statement; skip it.

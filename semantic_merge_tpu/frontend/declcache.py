@@ -89,13 +89,34 @@ class DeclCache:
 
 
 def approx_size(value: Any) -> int:
-    """Rough byte estimate for cap accounting — strings dominate."""
-    if isinstance(value, (list, tuple, frozenset, set)):
-        return 64 + sum(approx_size(v) for v in value)
+    """Rough byte estimate for cap accounting — strings dominate.
+
+    Deliberately flat (two levels, no recursion): cap accounting runs on
+    every put and must not dominate a cold scan; the cached values are
+    string collections and DeclNode lists, both covered exactly by the
+    container → (string | attr-dict) shape."""
     if isinstance(value, str):
         return 49 + len(value)
-    if hasattr(value, "__dict__"):
-        return 64 + sum(approx_size(v) for v in vars(value).values())
+    if isinstance(value, (list, tuple, frozenset, set)):
+        total = 64
+        for v in value:
+            if isinstance(v, str):
+                total += 49 + len(v)
+                continue
+            d = getattr(v, "__dict__", None)
+            if d is not None:
+                total += 80
+                for a in d.values():
+                    total += (49 + len(a)) if isinstance(a, str) else 24
+            else:
+                total += 24
+        return total
+    d = getattr(value, "__dict__", None)
+    if d is not None:
+        total = 80
+        for a in d.values():
+            total += (49 + len(a)) if isinstance(a, str) else 24
+        return total
     return max(sys.getsizeof(value, 64), 16)
 
 

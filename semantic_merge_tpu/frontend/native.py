@@ -98,6 +98,11 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.smn_type_names.restype = ctypes.c_void_p
     lib.smn_type_names.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+    lib.smn_scan_with_names.restype = ctypes.c_void_p
+    lib.smn_scan_with_names.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int,
+    ]
     lib.smn_free.argtypes = [ctypes.c_void_p]
     _lib = lib
     return _lib
@@ -129,6 +134,51 @@ def try_type_names(files: Sequence[dict]) -> Optional[List[frozenset]]:
     finally:
         lib.smn_free(ptr)
     return [frozenset(names) for names in json.loads(raw)]
+
+
+def _ascii_arrays(files: Sequence[dict]):
+    paths: List[bytes] = []
+    contents: List[bytes] = []
+    for f in files:
+        content = f["content"]
+        if not content.isascii() or not f["path"].isascii():
+            return None
+        if "\x00" in content or "\x00" in f["path"]:
+            return None
+        paths.append(f["path"].encode("ascii"))
+        contents.append(content.encode("ascii"))
+    n = len(files)
+    return (ctypes.c_char_p * n)(*paths), (ctypes.c_char_p * n)(*contents), n
+
+
+def try_scan_with_names(files: Sequence[dict]):
+    """One native pass returning ``(per_file_name_sets, nodes)`` — the
+    cold path of the cached scan; ``None`` → Python fallback."""
+    lib = _load()
+    if lib is None:
+        return None
+    arrays = _ascii_arrays(files)
+    if arrays is None:
+        return None
+    path_arr, content_arr, n = arrays
+    ptr = lib.smn_scan_with_names(path_arr, content_arr, n)
+    if not ptr:
+        return None
+    try:
+        raw = ctypes.string_at(ptr)
+    finally:
+        lib.smn_free(ptr)
+    payload = json.loads(raw)
+    names = [frozenset(ns) for ns in payload["names"]]
+    nodes = [
+        DeclNode(
+            symbolId=r["symbolId"], addressId=r["addressId"], kind=r["kind"],
+            name=r["name"], file=r["file"], pos=r["pos"], end=r["end"],
+            signature=r["signature"],
+        )
+        for r in payload["nodes"]
+    ]
+    return names, nodes
 
 
 def try_scan_snapshot(files: Sequence[dict]) -> Optional[List[DeclNode]]:

@@ -160,6 +160,25 @@ def _scan_snapshot_cached(files: Sequence[dict], cache) -> List[DeclNode]:
         if names is None:
             type_miss.append(idx)
         name_sets.append(names)
+
+    if files and len(type_miss) == len(files):
+        # Fully cold (nothing cached for any content): one combined
+        # native pass yields names + nodes together — no duplicate
+        # tokenize, no synthetic-decls file.
+        combined = native.try_scan_with_names(files)
+        if combined is not None:
+            per_file_names, nodes = combined
+            declared = set().union(*per_file_names) if per_file_names else set()
+            dh = declared_hash(declared)
+            by_file: Dict[str, List[DeclNode]] = {}
+            for n in nodes:
+                by_file.setdefault(n.file, []).append(n)
+            for idx, f in enumerate(files):
+                cache.put(("types", hashes[idx]), per_file_names[idx])
+                cache.put(("decls", normalize_path(f["path"]), hashes[idx], dh),
+                          by_file.get(normalize_path(f["path"]), []))
+            return nodes
+
     if type_miss:
         native_names = native.try_type_names([files[i] for i in type_miss])
         for j, idx in enumerate(type_miss):

@@ -1,0 +1,176 @@
+"""Multi-host / multi-slice execution scaffolding.
+
+The reference's only "communication backend" is newline-delimited
+JSON-RPC over stdio pipes to one local Node child (reference
+``semmerge/lang/ts/bridge.py:80-118``; ``workers/ts/src/index.ts:9-51``)
+— single host, single worker, one in-flight request. The TPU-native
+equivalent is ``jax.distributed`` + XLA collectives: every host runs the
+same program, arrays are sharded over a global mesh, and cross-chip
+exchange (symbol-table all-gathers for the DivergentRename join,
+shard-to-shard op routing) rides ICI within a slice and DCN across
+slices.
+
+Two pieces:
+
+- :func:`init_distributed` — process bring-up. Wraps
+  ``jax.distributed.initialize`` with environment-driven defaults
+  (coordinator address, process count/index) so the same CLI entry
+  point works single-host (no-op) and multi-host (launched once per
+  host by the job scheduler).
+- :func:`build_hybrid_mesh` — a mesh whose leading ``dcn`` axis spans
+  slices and whose inner axes (dp/pp/sp/tp/ep) stay inside a slice, so
+  only the axes explicitly placed on ``dcn`` ever generate DCN
+  traffic. Data parallelism (the file-batch axis of merge kernels)
+  goes over DCN — per-file merge work is embarrassingly parallel with
+  one small all-gather at compose time — while tp/sp/ep collectives
+  (per-token, per-feature) stay on ICI.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from ..utils.loggingx import logger
+
+
+@dataclass(frozen=True)
+class DistributedConfig:
+    """Resolved bring-up parameters (all optional single-host)."""
+
+    coordinator_address: Optional[str]
+    num_processes: int
+    process_id: int
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_processes > 1
+
+
+def resolve_distributed_config(env: Optional[dict] = None) -> DistributedConfig:
+    """Environment contract (the scheduler-agnostic subset every TPU
+    launcher provides): ``SEMMERGE_COORDINATOR`` (host:port),
+    ``SEMMERGE_NUM_PROCESSES``, ``SEMMERGE_PROCESS_ID`` — falling back
+    to the JAX standard ``JAX_COORDINATOR_ADDRESS`` etc., then to
+    single-host."""
+    env = env if env is not None else dict(os.environ)
+
+    def pick(*names: str, default: Optional[str] = None) -> Optional[str]:
+        for name in names:
+            value = env.get(name)
+            if value:
+                return value
+        return default
+
+    coord = pick("SEMMERGE_COORDINATOR", "JAX_COORDINATOR_ADDRESS")
+    n = int(pick("SEMMERGE_NUM_PROCESSES", "JAX_NUM_PROCESSES", default="1"))
+    pid = int(pick("SEMMERGE_PROCESS_ID", "JAX_PROCESS_ID", default="0"))
+    if n > 1 and coord is None:
+        raise ValueError(
+            "multi-process run (num_processes > 1) needs a coordinator "
+            "address (SEMMERGE_COORDINATOR=host:port)")
+    return DistributedConfig(coordinator_address=coord, num_processes=n,
+                             process_id=pid)
+
+
+_initialized = False
+
+
+def init_distributed(config: Optional[DistributedConfig] = None) -> DistributedConfig:
+    """Bring up ``jax.distributed`` once per process; no-op single-host.
+
+    Safe to call from every entry point — the CLI calls it before
+    building the mesh so the same binary serves laptops and pods.
+    """
+    global _initialized
+    config = config or resolve_distributed_config()
+    if config.multi_host and not _initialized:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+        _initialized = True
+        logger.info("jax.distributed up: process %d/%d via %s",
+                    config.process_id, config.num_processes,
+                    config.coordinator_address)
+    return config
+
+
+def build_hybrid_mesh(devices: Optional[Sequence] = None, *,
+                      num_slices: Optional[int] = None,
+                      dcn_axis: str = "dp",
+                      slice_ids: Optional[Sequence[int]] = None,
+                      dp: Optional[int] = None, pp: Optional[int] = None,
+                      sp: Optional[int] = None, tp: Optional[int] = None,
+                      ep: Optional[int] = None):
+    """A :class:`~semantic_merge_tpu.parallel.mesh.MergeMesh` whose
+    ``dcn_axis`` factor spans slices (DCN) and all other axes stay
+    within a slice (ICI).
+
+    ``num_slices`` defaults to the distinct ``device.slice_index``
+    count (1 when the runtime does not report slices — e.g. the CPU
+    test mesh — which degrades to the plain single-slice mesh). The
+    per-slice device order interleaves so that for the returned mesh,
+    ``reshape(sizes)`` puts slice-crossing strides only on the
+    ``dcn_axis``: consecutive devices along every other axis are
+    same-slice neighbours.
+    """
+    import math
+
+    import jax
+    import numpy as np
+
+    from .mesh import MESH_AXES, MergeMesh, build_mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if slice_ids is None:  # explicit ids support tests on flat CPU meshes
+        slice_ids = [getattr(d, "slice_index", 0) or 0 for d in devices]
+    if num_slices is None:
+        num_slices = len(set(slice_ids))
+    if num_slices <= 1:
+        return build_mesh(devices, dp=dp, pp=pp, sp=sp, tp=tp, ep=ep)
+
+    by_slice: dict = {}
+    for d, s in zip(devices, slice_ids):
+        by_slice.setdefault(s, []).append(d)
+    groups = [by_slice[s] for s in sorted(by_slice)]
+    per_slice = len(groups[0])
+    if any(len(g) != per_slice for g in groups):
+        raise ValueError("slices expose unequal device counts: "
+                         f"{[len(g) for g in groups]}")
+
+    requested = {"dp": dp, "pp": pp, "sp": sp, "tp": tp, "ep": ep}
+    intra = dict(requested)
+    if requested[dcn_axis] is None:
+        intra[dcn_axis] = None  # inferred per-slice; total = inferred * num_slices
+    elif requested[dcn_axis] % num_slices != 0:
+        raise ValueError(
+            f"{dcn_axis}={requested[dcn_axis]} must be a multiple of "
+            f"num_slices={num_slices} (the slice factor rides DCN)")
+    else:
+        intra[dcn_axis] = requested[dcn_axis] // num_slices
+
+    # Build the single-slice factorization for the intra-slice factors.
+    inner = build_mesh(groups[0], **intra)
+    inner_sizes = dict(zip(inner.mesh.axis_names, inner.mesh.devices.shape))
+
+    sizes = dict(inner_sizes)
+    sizes[dcn_axis] = inner_sizes[dcn_axis] * num_slices
+    if math.prod(sizes.values()) != len(devices):
+        raise ValueError(f"axis sizes {sizes} do not cover {len(devices)} devices")
+
+    # Device layout: axis order (slice, *inner axes) reshaped so the
+    # slice factor is the outermost factor of `dcn_axis`.
+    arr = np.stack([np.asarray(g).reshape(inner.mesh.devices.shape)
+                    for g in groups])  # (num_slices, *inner)
+    axis_idx = MESH_AXES.index(dcn_axis)
+    # Move the slice axis next to (in front of) its inner counterpart.
+    arr = np.moveaxis(arr, 0, axis_idx)
+    shape = [sizes[name] for name in MESH_AXES]
+    arr = arr.reshape(shape)
+    from jax.sharding import Mesh
+    return MergeMesh(mesh=Mesh(arr, MESH_AXES))

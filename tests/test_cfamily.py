@@ -253,3 +253,13 @@ def test_java_legacy_array_field_and_truncated_annotation():
     # Truncated file must not raise.
     nodes = scan_file_cfamily("X.java", "class A {}\n@interface", JAVA)
     assert [n.name for n in nodes] == ["A"]
+
+
+def test_indexed_assignment_is_not_a_field():
+    src = "enum E { A; }\nclass C { void f() {} }\n"
+    # Statement-shaped tokens in a member region: arr[idx] = val;
+    src2 = "class D { int a[]; }\nclass X { { arr[idx] = val; } }\n"
+    nodes = scan_file_cfamily("A.java", src2, JAVA)
+    names = [n.name for n in nodes]
+    assert "idx" not in names and "val" not in names
+    assert "a" in names

@@ -1,0 +1,95 @@
+"""Multi-host scaffolding: config resolution and hybrid-mesh layout.
+
+True multi-process bring-up cannot run in one test process; these tests
+cover the environment contract and — on the virtual 8-device CPU mesh —
+that the hybrid (DCN x ICI) mesh puts slice crossings only on the
+designated DCN axis and still executes sharded collectives.
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from semantic_merge_tpu.parallel.distributed import (  # noqa: E402
+    build_hybrid_mesh, resolve_distributed_config)
+from semantic_merge_tpu.parallel.mesh import MESH_AXES  # noqa: E402
+
+
+def test_resolve_config_single_host_default():
+    cfg = resolve_distributed_config(env={})
+    assert not cfg.multi_host
+    assert cfg.num_processes == 1 and cfg.process_id == 0
+
+
+def test_resolve_config_multi_host():
+    cfg = resolve_distributed_config(env={
+        "SEMMERGE_COORDINATOR": "10.0.0.1:1234",
+        "SEMMERGE_NUM_PROCESSES": "4",
+        "SEMMERGE_PROCESS_ID": "2",
+    })
+    assert cfg.multi_host
+    assert cfg.coordinator_address == "10.0.0.1:1234"
+    assert cfg.process_id == 2
+
+
+def test_resolve_config_jax_fallback_and_missing_coordinator():
+    cfg = resolve_distributed_config(env={
+        "JAX_COORDINATOR_ADDRESS": "h:1", "JAX_NUM_PROCESSES": "2",
+        "JAX_PROCESS_ID": "1"})
+    assert cfg.multi_host and cfg.coordinator_address == "h:1"
+    with pytest.raises(ValueError):
+        resolve_distributed_config(env={"SEMMERGE_NUM_PROCESSES": "2"})
+
+
+def test_hybrid_mesh_single_slice_degrades_to_plain():
+    mesh = build_hybrid_mesh(jax.devices())
+    assert np.prod(list(mesh.axis_sizes.values())) == len(jax.devices())
+
+
+def _fake_two_slices():
+    devices = jax.devices()
+    assert len(devices) == 8
+    return devices, [0] * 4 + [1] * 4
+
+
+def test_hybrid_mesh_slice_crossings_only_on_dcn_axis():
+    devices, slice_ids = _fake_two_slices()
+    mesh = build_hybrid_mesh(devices, slice_ids=slice_ids, dcn_axis="dp",
+                             sp=2, tp=1, pp=1, ep=1)
+    sizes = mesh.axis_sizes
+    assert sizes["dp"] % 2 == 0
+    sid = {d: s for d, s in zip(devices, slice_ids)}
+    arr = mesh.mesh.devices
+    # Moving along any non-dcn axis never changes slice.
+    for axis, name in enumerate(MESH_AXES):
+        if name == "dp" or arr.shape[axis] == 1:
+            continue
+        first = np.take(arr, 0, axis=axis)
+        for k in range(1, arr.shape[axis]):
+            other = np.take(arr, k, axis=axis)
+            assert all(sid[a] == sid[b] for a, b in
+                       zip(first.ravel(), other.ravel())), name
+
+
+def test_hybrid_mesh_executes_collectives():
+    devices, slice_ids = _fake_two_slices()
+    mesh = build_hybrid_mesh(devices, slice_ids=slice_ids, dcn_axis="dp",
+                             sp=2, tp=1, pp=1, ep=1)
+    x = jnp.arange(16.0).reshape(8, 2)
+
+    def body(x):
+        return jax.lax.psum(x, "dp")
+
+    out = jax.shard_map(body, mesh=mesh.mesh,
+                        in_specs=P("dp", "sp"), out_specs=P(None, "sp"))(x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(x).reshape(4, 2, 2).sum(axis=0))
+
+
+def test_hybrid_mesh_rejects_bad_factor():
+    devices, slice_ids = _fake_two_slices()
+    with pytest.raises(ValueError):
+        build_hybrid_mesh(devices, slice_ids=slice_ids, dcn_axis="dp",
+                          dp=3, sp=1, tp=1, pp=1, ep=1)

@@ -949,7 +949,7 @@ static void parallel_for(int n, const std::function<void(int)>& fn) {
 
 extern "C" {
 
-int smn_abi_version() { return 2; }
+int smn_abi_version() { return 3; }
 
 // Scan a snapshot: two passes exactly like scan_snapshot() — collect
 // declared type names across all files, then scan each file in snapshot

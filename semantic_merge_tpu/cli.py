@@ -67,11 +67,25 @@ def build_parser() -> argparse.ArgumentParser:
     p_merge.add_argument("--change-signature", action="store_true",
                          help="Detect changeSignature ops instead of delete+add "
                               "(also [engine].change_signature in .semmerge.toml)")
+    p_merge.add_argument("--strict-conflicts", action="store_true",
+                         help="Detect all [CFR-002] conflict categories via a "
+                              "full symbol join (also [engine].conflict_mode)")
 
     p_rebase = sub.add_parser("semrebase", help="Replay a commit's stored op log onto a revision")
     p_rebase.add_argument("commit", help="Commit whose semmerge note holds the op log")
     p_rebase.add_argument("onto", help="Revision to replay onto")
     p_rebase.add_argument("--inplace", action="store_true")
+
+    p_train = sub.add_parser("train-matcher",
+                             help="Train the decl-similarity matcher (orbax "
+                                  "checkpoints; resumes from the latest)")
+    p_train.add_argument("--steps", type=int, default=200)
+    p_train.add_argument("--batch", type=int, default=32)
+    p_train.add_argument("--seq", type=int, default=64)
+    p_train.add_argument("--seed", type=int, default=0)
+    p_train.add_argument("--ckpt-dir", default=None)
+    p_train.add_argument("--ckpt-every", type=int, default=50)
+    p_train.add_argument("--no-resume", action="store_true")
     return parser
 
 
@@ -84,6 +98,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             return cmd_semmerge(args)
         if args.command == "semrebase":
             return cmd_semrebase(args)
+        if args.command == "train-matcher":
+            return cmd_train_matcher(args)
     except subprocess.CalledProcessError as exc:
         cmd = exc.cmd if isinstance(exc.cmd, str) else " ".join(map(str, exc.cmd))
         print(f"error: subprocess failed ({cmd}): exit {exc.returncode}", file=sys.stderr)
@@ -171,8 +187,16 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
             tracer.count("decl_cache_misses", cache.misses)
 
         with tracer.phase("compose"):
+            ops_left, ops_right = result.op_log_left, result.op_log_right
+            conflicts: list = []
+            if (getattr(args, "strict_conflicts", False)
+                    or config.engine.conflict_mode == "strict"):
+                from .core.strict_conflicts import detect_conflicts_strict
+                ops_left, ops_right, conflicts = detect_conflicts_strict(
+                    ops_left, ops_right)
             compose_fn = getattr(backend, "compose", None) or compose_oplogs
-            composed, conflicts = compose_fn(result.op_log_left, result.op_log_right)
+            composed, walk_conflicts = compose_fn(ops_left, ops_right)
+            conflicts.extend(walk_conflicts)
         tracer.count("composed_ops", len(composed))
         tracer.count("conflicts", len(conflicts))
 
@@ -243,6 +267,20 @@ def cmd_semrebase(args: argparse.Namespace) -> int:
             print(str(merged))
     finally:
         _cleanup([base_tree])
+    return 0
+
+
+def cmd_train_matcher(args: argparse.Namespace) -> int:
+    from .models.training import TrainConfig, train_matcher
+    cfg = TrainConfig(steps=args.steps, batch=args.batch, seq=args.seq,
+                      seed=args.seed, ckpt_dir=args.ckpt_dir,
+                      ckpt_every=args.ckpt_every)
+    _, _, loss, ran = train_matcher(cfg, resume=not args.no_resume)
+    where = f", checkpoints in {args.ckpt_dir}" if args.ckpt_dir else ""
+    if ran == 0:  # e.g. resumed at or past --steps
+        print(f"nothing to do: checkpoint already at step {args.steps}{where}")
+    else:
+        print(f"trained {ran} steps, final loss {loss:.4f}{where}")
     return 0
 
 

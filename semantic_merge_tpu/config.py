@@ -20,6 +20,8 @@ Schema (superset of the reference's documented schema at reference
     parity_mode = true             # reproduce reference quirks bit-for-bit
     change_signature = false       # detect changeSignature ops (off in parity mode:
                                    # the reference emits delete+add instead)
+    conflict_mode = "parity"       # "parity" (head-vs-head DivergentRename only)
+                                   # | "strict" (all [CFR-002] categories)
     max_nodes_per_bucket = 2048    # padding bucket sizes, powers of two
     mesh_shape = "auto"            # or e.g. "dp=4,tp=2"
 
@@ -52,6 +54,7 @@ class EngineConfig:
     backend: str = "tpu"
     parity_mode: bool = True
     change_signature: bool = False
+    conflict_mode: str = "parity"
     max_nodes_per_bucket: int = 2048
     mesh_shape: str = "auto"
 
@@ -111,6 +114,9 @@ def load_config(start: pathlib.Path | None = None) -> Config:
         parity_mode=bool(engine.get("parity_mode", config.engine.parity_mode)),
         change_signature=bool(
             engine.get("change_signature", config.engine.change_signature)),
+        conflict_mode=_validated(
+            str(engine.get("conflict_mode", config.engine.conflict_mode)),
+            "engine.conflict_mode", ("parity", "strict")),
         max_nodes_per_bucket=int(
             engine.get("max_nodes_per_bucket", config.engine.max_nodes_per_bucket)
         ),
@@ -130,6 +136,12 @@ def load_config(start: pathlib.Path | None = None) -> Config:
         require_tests=bool(ci.get("require_tests", config.ci.require_tests)),
     )
     return config
+
+
+def _validated(value: str, key: str, allowed: tuple) -> str:
+    if value not in allowed:
+        raise ValueError(f"{key} must be one of {allowed}, got {value!r}")
+    return value
 
 
 def _as_list(value: Any) -> List[Any]:

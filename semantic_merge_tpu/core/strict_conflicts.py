@@ -1,0 +1,157 @@
+"""Strict conflict detection — the [CFR-002] categories.
+
+The reference *requires* six conflict categories (reference
+``requirements.md:93-99`` [CFR-002]) but implements exactly one,
+DivergentRename, and only when the two renames surface simultaneously
+at both compose cursors (reference ``semmerge/compose.py:60-70`` —
+interleaved ops can mask it). This module implements the categories
+expressible over the implemented op vocabulary as a full symbol-level
+join, immune to interleaving:
+
+- **DivergentRename** — both sides rename one symbol to different names.
+- **DivergentMove** — both sides move one symbol to different addresses.
+- **IncompatibleSignatureChange** — both sides change one symbol's
+  signature differently (requires ``changeSignature`` extraction).
+- **DeleteVsEdit** — one side deletes a declaration the other side
+  renames / moves / re-signs.
+
+The remaining two categories (concurrent statement edits, extract vs
+inline) need statement-level edit ops that no backend extracts yet —
+they gate on the op vocabulary, not on this join.
+
+Semantics: conflicting ops drop from both streams (the reference's
+DivergentRename drop semantics, generalized), the pre-pass runs before
+composition, and the composer then finds no residual head-vs-head
+conflicts. Selected via ``[engine] conflict_mode = "strict"`` or
+``--strict-conflicts``; the default ``"parity"`` keeps the reference's
+observable behavior bit-for-bit.
+
+This is the host oracle of the sharded-join design: the device twin is
+the same sorted self-join the TPU composer already runs for its
+DivergentRename prescreen (:mod:`semantic_merge_tpu.ops.compose`),
+extended with the per-category predicates — all segmented comparisons
+on (symbolId-sorted) op tensors.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .conflict import (Conflict, delete_vs_edit_conflict,
+                       divergent_rename_conflict)
+from .ops import Op
+
+_EDIT_TYPES = ("renameSymbol", "moveDecl", "changeSignature")
+
+
+def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
+                            ) -> Tuple[List[Op], List[Op], List[Conflict]]:
+    """Full-stream conflict join; returns the two streams with
+    conflicting ops dropped plus the conflict records (stable order:
+    by first involved A-op's stream position)."""
+    by_sym_a = _group(delta_a)
+    by_sym_b = _group(delta_b)
+
+    drop_a: set = set()
+    drop_b: set = set()
+    conflicts: List[Conflict] = []
+
+    for sym, ops_a in by_sym_a.items():
+        ops_b = by_sym_b.get(sym)
+        if not ops_b:
+            continue
+
+        ren_a = [op for op in ops_a if op.type == "renameSymbol"]
+        ren_b = [op for op in ops_b if op.type == "renameSymbol"]
+        for op_a in ren_a:
+            for op_b in ren_b:
+                if op_a.params.get("newName") != op_b.params.get("newName"):
+                    conflicts.append(divergent_rename_conflict(op_a, op_b))
+                    drop_a.add(id(op_a))
+                    drop_b.add(id(op_b))
+
+        mov_a = [op for op in ops_a if op.type == "moveDecl"]
+        mov_b = [op for op in ops_b if op.type == "moveDecl"]
+        for op_a in mov_a:
+            for op_b in mov_b:
+                if op_a.params.get("newAddress") != op_b.params.get("newAddress"):
+                    conflicts.append(divergent_move_conflict(op_a, op_b))
+                    drop_a.add(id(op_a))
+                    drop_b.add(id(op_b))
+
+        sig_a = [op for op in ops_a if op.type == "changeSignature"]
+        sig_b = [op for op in ops_b if op.type == "changeSignature"]
+        for op_a in sig_a:
+            for op_b in sig_b:
+                if op_a.params.get("newSignature") != op_b.params.get("newSignature"):
+                    conflicts.append(incompatible_signature_conflict(op_a, op_b))
+                    drop_a.add(id(op_a))
+                    drop_b.add(id(op_b))
+
+        del_a = [op for op in ops_a if op.type == "deleteDecl"]
+        del_b = [op for op in ops_b if op.type == "deleteDecl"]
+        edit_a = [op for op in ops_a if op.type in _EDIT_TYPES]
+        edit_b = [op for op in ops_b if op.type in _EDIT_TYPES]
+        for op_del in del_a:
+            for op_edit in edit_b:
+                conflicts.append(delete_vs_edit_conflict(op_del, op_edit, "A"))
+                drop_a.add(id(op_del))
+                drop_b.add(id(op_edit))
+        for op_del in del_b:
+            for op_edit in edit_a:
+                conflicts.append(delete_vs_edit_conflict(op_del, op_edit, "B"))
+                drop_b.add(id(op_del))
+                drop_a.add(id(op_edit))
+
+    kept_a = [op for op in delta_a if id(op) not in drop_a]
+    kept_b = [op for op in delta_b if id(op) not in drop_b]
+    return kept_a, kept_b, conflicts
+
+
+def _group(ops: List[Op]) -> Dict[str, List[Op]]:
+    groups: Dict[str, List[Op]] = {}
+    for op in ops:
+        groups.setdefault(op.target.symbolId, []).append(op)
+    return groups
+
+
+def divergent_move_conflict(op_a: Op, op_b: Op) -> Conflict:
+    """Both sides moved the same symbol to different destinations
+    ([CFR-002] "Move to different destinations")."""
+    return Conflict(
+        id=f"conf-{op_a.id[:8]}-{op_b.id[:8]}",
+        category="DivergentMove",
+        symbolId=op_a.target.symbolId,
+        addressIds={"A": op_a.params.get("newAddress"),
+                    "B": op_b.params.get("newAddress"),
+                    "base": op_a.params.get("oldAddress")},
+        opA=op_a.to_dict(),
+        opB=op_b.to_dict(),
+        minimalSlice={"path": "", "start": 0, "end": 0, "code": ""},
+        suggestions=[
+            {"id": "keepA", "label": f"Move to {op_a.params.get('newAddress')}",
+             "ops": [op_a.id]},
+            {"id": "keepB", "label": f"Move to {op_b.params.get('newAddress')}",
+             "ops": [op_b.id]},
+        ],
+    )
+
+
+def incompatible_signature_conflict(op_a: Op, op_b: Op) -> Conflict:
+    """Both sides changed the same symbol's signature incompatibly
+    ([CFR-002] "Incompatible signature changes")."""
+    return Conflict(
+        id=f"conf-{op_a.id[:8]}-{op_b.id[:8]}",
+        category="IncompatibleSignatureChange",
+        symbolId=op_a.target.symbolId,
+        addressIds={"A": op_a.target.addressId, "B": op_b.target.addressId,
+                    "base": None},
+        opA=op_a.to_dict(),
+        opB=op_b.to_dict(),
+        minimalSlice={"path": "", "start": 0, "end": 0, "code": ""},
+        suggestions=[
+            {"id": "keepA", "label": f"Signature {op_a.params.get('newSignature')}",
+             "ops": [op_a.id]},
+            {"id": "keepB", "label": f"Signature {op_b.params.get('newSignature')}",
+             "ops": [op_b.id]},
+        ],
+    )

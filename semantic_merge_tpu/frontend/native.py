@@ -35,7 +35,7 @@ from .scanner import DeclNode
 
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
 _LIB_PATH = _NATIVE_DIR / "libsemmerge_native.so"
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
@@ -112,31 +112,10 @@ def available() -> bool:
     return _load() is not None
 
 
-def try_type_names(files: Sequence[dict]) -> Optional[List[frozenset]]:
-    """Per-file declared type names via the native tokenizer (pass 1 of
-    the scan); ``None`` → caller should tokenize in Python."""
-    lib = _load()
-    if lib is None:
-        return None
-    contents: List[bytes] = []
-    for f in files:
-        content = f["content"]
-        if not content.isascii() or "\x00" in content:
-            return None
-        contents.append(content.encode("ascii"))
-    n = len(files)
-    content_arr = (ctypes.c_char_p * n)(*contents)
-    ptr = lib.smn_type_names(content_arr, n)
-    if not ptr:
-        return None
-    try:
-        raw = ctypes.string_at(ptr)
-    finally:
-        lib.smn_free(ptr)
-    return [frozenset(names) for names in json.loads(raw)]
-
-
 def _ascii_arrays(files: Sequence[dict]):
+    """Marshal a snapshot into ctypes arrays, or ``None`` when the
+    content is not ASCII/NUL-safe (code-point vs byte offsets would
+    diverge; ``c_char_p`` is NUL-terminated so C would see a prefix)."""
     paths: List[bytes] = []
     contents: List[bytes] = []
     for f in files:
@@ -149,6 +128,26 @@ def _ascii_arrays(files: Sequence[dict]):
         contents.append(content.encode("ascii"))
     n = len(files)
     return (ctypes.c_char_p * n)(*paths), (ctypes.c_char_p * n)(*contents), n
+
+
+def try_type_names(files: Sequence[dict]) -> Optional[List[frozenset]]:
+    """Per-file declared type names via the native tokenizer (pass 1 of
+    the scan); ``None`` → caller should tokenize in Python."""
+    lib = _load()
+    if lib is None:
+        return None
+    arrays = _ascii_arrays(files)
+    if arrays is None:
+        return None
+    _, content_arr, n = arrays
+    ptr = lib.smn_type_names(content_arr, n)
+    if not ptr:
+        return None
+    try:
+        raw = ctypes.string_at(ptr)
+    finally:
+        lib.smn_free(ptr)
+    return [frozenset(names) for names in json.loads(raw)]
 
 
 def try_scan_with_names(files: Sequence[dict]):
@@ -187,19 +186,10 @@ def try_scan_snapshot(files: Sequence[dict]) -> Optional[List[DeclNode]]:
     lib = _load()
     if lib is None:
         return None
-    paths: List[bytes] = []
-    contents: List[bytes] = []
-    for f in files:
-        content = f["content"]
-        if not content.isascii() or not f["path"].isascii():
-            return None  # code-point vs byte offsets would diverge
-        if "\x00" in content or "\x00" in f["path"]:
-            return None  # c_char_p is NUL-terminated; C would see a prefix
-        paths.append(f["path"].encode("ascii"))
-        contents.append(content.encode("ascii"))
-    n = len(files)
-    path_arr = (ctypes.c_char_p * n)(*paths)
-    content_arr = (ctypes.c_char_p * n)(*contents)
+    arrays = _ascii_arrays(files)
+    if arrays is None:
+        return None
+    path_arr, content_arr, n = arrays
     ptr = lib.smn_scan_snapshot(path_arr, content_arr, n)
     if not ptr:
         return None

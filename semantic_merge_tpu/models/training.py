@@ -1,0 +1,137 @@
+"""Matcher training loop: data synthesis, checkpoints, resume.
+
+Checkpoint/resume is a required auxiliary subsystem (SURVEY.md §5.4):
+the reference's only persistence is op logs in git notes; training state
+here persists via **orbax** — sharding-aware, async, multi-host-safe —
+so a preempted TPU job resumes at the last saved step. The data side
+synthesizes contrastive pairs the way the merge pipeline encounters
+them: a declaration and its renamed/edited twin (positive), everything
+else in the batch (negatives).
+"""
+from __future__ import annotations
+
+import pathlib
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..utils.loggingx import logger
+from .features import encode_batch
+from .matcher import MatcherConfig, init_matcher, make_sharded_train_step
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    matcher: MatcherConfig = MatcherConfig()
+    batch: int = 32
+    seq: int = 64
+    steps: int = 200
+    seed: int = 0
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 50
+    keep: int = 3
+
+
+_TYPES = ("number", "string", "boolean", "void", "string[]", "number[]")
+_VERBS = ("get", "set", "make", "load", "store", "filter", "map", "merge",
+          "resolve", "apply", "lift", "scan", "encode", "index")
+_NOUNS = ("user", "node", "decl", "file", "symbol", "op", "tree", "batch",
+          "merge", "config", "token", "chunk", "shard", "mesh")
+
+
+def synth_pair(rng: np.random.RandomState) -> Tuple[str, str]:
+    """One (decl, edited twin) pair: same structure, renamed symbol and
+    light body edits — the signal the matcher must learn to keep
+    together; parameter/return types stay (changeSignature candidates
+    score through the structural channel)."""
+    verb, noun = rng.choice(_VERBS), rng.choice(_NOUNS)
+    n_params = int(rng.randint(1, 4))
+    params = ", ".join(
+        f"p{k}: {rng.choice(_TYPES)}" for k in range(n_params))
+    ret = rng.choice(_TYPES)
+    body_const = int(rng.randint(0, 100))
+    name_a = f"{verb}{noun.capitalize()}"
+    name_b = f"{rng.choice(_VERBS)}{noun.capitalize()}V2"
+    src = (f"export function {name_a}({params}): {ret} {{\n"
+           f"  const k = {body_const};\n  return undefined as any;\n}}\n")
+    edited = src.replace(name_a, name_b).replace(
+        f"const k = {body_const}", f"const k = {body_const + 1}")
+    return src, edited
+
+
+def batches(cfg: TrainConfig) -> Iterator[dict]:
+    rng = np.random.RandomState(cfg.seed)
+    vocab = cfg.matcher.encoder.vocab
+    while True:
+        pairs = [synth_pair(rng) for _ in range(cfg.batch)]
+        ta, ma = encode_batch([p[0] for p in pairs], vocab, cfg.seq)
+        tb, mb = encode_batch([p[1] for p in pairs], vocab, cfg.seq)
+        yield {"tokens_a": ta, "mask_a": ma, "tokens_b": tb, "mask_b": mb}
+
+
+def _manager(cfg: TrainConfig):
+    import orbax.checkpoint as ocp
+    path = pathlib.Path(cfg.ckpt_dir).resolve()
+    path.mkdir(parents=True, exist_ok=True)
+    options = ocp.CheckpointManagerOptions(max_to_keep=cfg.keep,
+                                           create=True)
+    return ocp.CheckpointManager(path, options=options)
+
+
+def train_matcher(cfg: TrainConfig, mesh=None, *, resume: bool = True):
+    """Run the training loop; returns ``(params, opt_state, last_loss,
+    steps_run)``. With ``ckpt_dir`` set, saves every ``ckpt_every``
+    steps and resumes from the latest checkpoint when ``resume``."""
+    import jax
+
+    from ..parallel.mesh import build_mesh
+    if mesh is None:
+        mesh = build_mesh()
+
+    params, opt_state = init_matcher(jax.random.PRNGKey(cfg.seed), cfg.matcher)
+    start_step = 0
+    manager = None
+    if cfg.ckpt_dir:
+        import orbax.checkpoint as ocp
+        manager = _manager(cfg)
+        latest = manager.latest_step()
+        if resume and latest is not None:
+            template = {"params": params, "opt_state": opt_state}
+            restored = manager.restore(
+                latest, args=ocp.args.StandardRestore(template))
+            params, opt_state = restored["params"], restored["opt_state"]
+            # Orbax restores onto single devices; re-lay the trees out on
+            # the mesh (the jitted step pins explicit in_shardings).
+            from .encoder import param_specs
+            specs = param_specs(cfg.matcher.encoder)
+            params = {k: jax.device_put(v, mesh.sharding(*specs[k]))
+                      for k, v in params.items()}
+            opt_state = jax.tree.map(
+                lambda leaf: jax.device_put(leaf, mesh.replicated()), opt_state)
+            start_step = latest
+            logger.info("resumed matcher training at step %d from %s",
+                        start_step, cfg.ckpt_dir)
+
+    step_fn = make_sharded_train_step(cfg.matcher, mesh)
+    data = batches(cfg)
+    # Fast-forward the generator so a resumed run sees the same stream
+    # it would have seen uninterrupted (determinism across preemption).
+    for _ in range(start_step):
+        next(data)
+
+    loss = None
+    step = start_step
+    for step in range(start_step + 1, cfg.steps + 1):
+        params, opt_state, loss = step_fn(params, opt_state, next(data))
+        if manager is not None and (step % cfg.ckpt_every == 0
+                                    or step == cfg.steps):
+            import orbax.checkpoint as ocp
+            manager.save(step, args=ocp.args.StandardSave(
+                {"params": params, "opt_state": opt_state}))
+    if manager is not None:
+        manager.wait_until_finished()
+        manager.close()
+    if loss is not None:
+        loss = float(loss)
+    return params, opt_state, loss, step - start_step

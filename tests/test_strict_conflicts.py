@@ -1,0 +1,130 @@
+"""Strict conflict detection ([CFR-002] categories).
+
+The reference requires six categories (reference ``requirements.md:93-99``)
+but implements only head-vs-head DivergentRename; strict mode implements
+every category expressible over the extracted op vocabulary and is immune
+to the interleaving that masks the reference's detection.
+"""
+from semantic_merge_tpu.core.compose import compose_oplogs
+from semantic_merge_tpu.core.ops import Op, Target
+from semantic_merge_tpu.core.strict_conflicts import detect_conflicts_strict
+
+TS = "2026-01-01T00:00:00Z"
+
+
+def _op(op_type, sym, params, op_id, ts=TS):
+    return Op.new(op_type, Target(symbolId=sym, addressId=f"f.ts::{sym}::0"),
+                  params=params, guards={}, effects={},
+                  provenance={"rev": "base", "timestamp": ts}, op_id=op_id)
+
+
+def test_divergent_rename_detected_despite_interleaving():
+    # Unrelated ops between the two renames mask the reference's
+    # head-vs-head walk; the strict join still finds the conflict.
+    a = [_op("moveDecl", "other1", {"oldAddress": "x", "newAddress": "y",
+                                    "oldFile": "x.ts", "newFile": "y.ts"}, "a1"),
+         _op("renameSymbol", "sym", {"oldName": "f", "newName": "g", "file": "f.ts"}, "a2")]
+    b = [_op("renameSymbol", "sym", {"oldName": "f", "newName": "h", "file": "f.ts"}, "b1")]
+    kept_a, kept_b, conflicts = detect_conflicts_strict(a, b)
+    assert [c.category for c in conflicts] == ["DivergentRename"]
+    assert len(kept_a) == 1 and kept_a[0].id == "a1"
+    assert kept_b == []
+    # The residual streams compose cleanly.
+    composed, walk_conflicts = compose_oplogs(kept_a, kept_b)
+    assert walk_conflicts == [] and len(composed) == 1
+
+
+def test_divergent_move():
+    a = [_op("moveDecl", "sym", {"oldAddress": "f.ts::s::0", "newAddress": "a.ts::s::0",
+                                 "oldFile": "f.ts", "newFile": "a.ts"}, "a1")]
+    b = [_op("moveDecl", "sym", {"oldAddress": "f.ts::s::0", "newAddress": "b.ts::s::0",
+                                 "oldFile": "f.ts", "newFile": "b.ts"}, "b1")]
+    _, _, conflicts = detect_conflicts_strict(a, b)
+    assert [c.category for c in conflicts] == ["DivergentMove"]
+    assert conflicts[0].addressIds == {"A": "a.ts::s::0", "B": "b.ts::s::0",
+                                       "base": "f.ts::s::0"}
+
+
+def test_same_destination_move_is_not_a_conflict():
+    a = [_op("moveDecl", "sym", {"oldAddress": "o", "newAddress": "n",
+                                 "oldFile": "f.ts", "newFile": "g.ts"}, "a1")]
+    b = [_op("moveDecl", "sym", {"oldAddress": "o", "newAddress": "n",
+                                 "oldFile": "f.ts", "newFile": "g.ts"}, "b1")]
+    kept_a, kept_b, conflicts = detect_conflicts_strict(a, b)
+    assert conflicts == [] and len(kept_a) == 1 and len(kept_b) == 1
+
+
+def test_incompatible_signature_change():
+    a = [_op("changeSignature", "sym", {"oldSignature": "fn(int)->int",
+                                        "newSignature": "fn(long)->int"}, "a1")]
+    b = [_op("changeSignature", "sym", {"oldSignature": "fn(int)->int",
+                                        "newSignature": "fn(str)->int"}, "b1")]
+    _, _, conflicts = detect_conflicts_strict(a, b)
+    assert [c.category for c in conflicts] == ["IncompatibleSignatureChange"]
+
+
+def test_delete_vs_edit_both_directions():
+    del_a = [_op("deleteDecl", "sym", {"file": "f.ts"}, "a1")]
+    ren_b = [_op("renameSymbol", "sym", {"oldName": "f", "newName": "g",
+                                         "file": "f.ts"}, "b1")]
+    kept_a, kept_b, conflicts = detect_conflicts_strict(del_a, ren_b)
+    assert [c.category for c in conflicts] == ["DeleteVsEdit"]
+    assert kept_a == [] and kept_b == []
+    assert {s["id"] for s in conflicts[0].suggestions} == {"keepDelete", "keepEdit"}
+
+    kept_a, kept_b, conflicts = detect_conflicts_strict(ren_b, del_a)
+    assert [c.category for c in conflicts] == ["DeleteVsEdit"]
+    assert kept_a == [] and kept_b == []
+
+
+def test_unrelated_symbols_untouched():
+    a = [_op("renameSymbol", "s1", {"oldName": "a", "newName": "b", "file": "f.ts"}, "a1")]
+    b = [_op("deleteDecl", "s2", {"file": "g.ts"}, "b1")]
+    kept_a, kept_b, conflicts = detect_conflicts_strict(a, b)
+    assert conflicts == [] and len(kept_a) == 1 and len(kept_b) == 1
+
+
+def test_cli_strict_mode_end_to_end(tmp_path, monkeypatch):
+    """--strict-conflicts surfaces DeleteVsEdit, which parity mode merges
+    silently (the delete wins and the rename dangles)."""
+    import json
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    (tmp_path / "a.ts").write_text(
+        "export function foo(n: number): number { return n; }\n")
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "t@e")
+    git("config", "user.name", "t")
+    git("add", "-A")
+    git("commit", "-qm", "base")
+    git("branch", "basebr")
+    git("checkout", "-qb", "ba")
+    (tmp_path / "a.ts").write_text(
+        "export function bar(n: number): number { return n; }\n")
+    git("commit", "-qam", "rename")
+    git("checkout", "-q", "main")
+    git("checkout", "-qb", "bb")
+    (tmp_path / "a.ts").write_text("export const unrelated = 1;\n")
+    git("commit", "-qam", "delete")
+    git("checkout", "-q", "main")
+
+    monkeypatch.chdir(tmp_path)
+    from semantic_merge_tpu.cli import main
+    rc = main(["semmerge", "basebr", "ba", "bb", "--backend", "host",
+               "--strict-conflicts"])
+    assert rc == 1
+    payload = json.loads((tmp_path / ".semmerge-conflicts.json").read_text())
+    assert any(c["category"] == "DeleteVsEdit" for c in payload)
+
+
+def test_config_rejects_bad_conflict_mode(tmp_path, monkeypatch):
+    (tmp_path / ".semmerge.toml").write_text('[engine]\nconflict_mode = "Strict"\n')
+    monkeypatch.chdir(tmp_path)
+    import pytest as _pytest
+    from semantic_merge_tpu.config import load_config
+    with _pytest.raises(ValueError, match="conflict_mode"):
+        load_config()

@@ -22,6 +22,10 @@ class CFamilyBackend:
 
     spec: LanguageSpec
 
+    @property
+    def extensions(self) -> frozenset:
+        return self.spec.extensions
+
     def _filter(self, snap: Snapshot):
         return filter_files(snap, self.spec.extensions)
 

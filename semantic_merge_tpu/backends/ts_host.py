@@ -29,6 +29,7 @@ def ts_files(snap: Snapshot):
 
 class HostTSBackend:
     name = "host"
+    extensions = frozenset(TS_EXTENSIONS)
 
     def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
                        *, base_rev: str = "base", seed: str = "0",

@@ -23,6 +23,7 @@ from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
 from ..frontend.scanner import DeclNode, scan_snapshot
 from ..frontend.snapshot import Snapshot
+from ..frontend.snapshot import TS_EXTENSIONS
 from .ts_host import ts_files
 from ..ops.diff import (KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME,
                         DiffOpsTensor, diff_lift_device, diff_lift_device_pair)
@@ -31,6 +32,7 @@ from .base import BuildAndDiffResult, register_backend, symbol_map
 
 class TpuTSBackend:
     name = "tpu"
+    extensions = frozenset(TS_EXTENSIONS)
 
     def __init__(self) -> None:
         # Probe JAX init at construction so the CLI's host-fallback path

@@ -162,9 +162,11 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
         with tracer.phase("snapshot"):
             from .runtime.git import archive_bytes, snapshot_from_bytes
             base_tar = archive_bytes(args.base)
+            left_tar = archive_bytes(args.a)
+            right_tar = archive_bytes(args.b)
             base_snap = snapshot_from_bytes(base_tar)
-            left_snap = snapshot_rev(args.a)
-            right_snap = snapshot_rev(args.b)
+            left_snap = snapshot_from_bytes(left_tar)
+            right_snap = snapshot_from_bytes(right_tar)
         base_rev = resolve_rev(args.base)
         seed = args.seed or config.core.deterministic_seed
         if seed == "auto":
@@ -215,6 +217,19 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                 merged_tree = apply_ops(base_tree, composed)
             finally:
                 _cleanup([base_tree])
+            deleted_paths: list = []
+            if config.engine.text_fallback:
+                # [FBK-001]: files outside the active backend's indexed
+                # set merge textually.
+                from .runtime.textmerge import apply_text_fallback
+                text_conflicts, deleted_paths = apply_text_fallback(
+                    merged_tree, base_tar, left_tar, right_tar,
+                    indexed_extensions=getattr(backend, "extensions", None))
+                tracer.count("text_conflicts", len(text_conflicts))
+                if text_conflicts:
+                    _write_conflict_reports(text_conflicts)
+                    tracer.write()
+                    return 1
         with tracer.phase("format"):
             formatter = None
             ts_cfg = config.languages.get("typescript")
@@ -234,6 +249,8 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
 
         if args.inplace:
             _copy_tree_into_cwd(merged_tree)
+            for rel in deleted_paths:  # text-merge deletions propagate too
+                pathlib.Path(rel).unlink(missing_ok=True)
 
         with tracer.phase("notes"):
             notes_put(resolve_rev(args.a), OpLog(result.op_log_left))

@@ -22,6 +22,8 @@ Schema (superset of the reference's documented schema at reference
                                    # the reference emits delete+add instead)
     conflict_mode = "parity"       # "parity" (head-vs-head DivergentRename only)
                                    # | "strict" (all [CFR-002] categories)
+    text_fallback = true           # [FBK-001]: 3-way text merge for files no
+                                   # backend indexes (off => those stay at base)
     max_nodes_per_bucket = 2048    # padding bucket sizes, powers of two
     mesh_shape = "auto"            # or e.g. "dp=4,tp=2"
 
@@ -55,6 +57,7 @@ class EngineConfig:
     parity_mode: bool = True
     change_signature: bool = False
     conflict_mode: str = "parity"
+    text_fallback: bool = True
     max_nodes_per_bucket: int = 2048
     mesh_shape: str = "auto"
 
@@ -117,6 +120,7 @@ def load_config(start: pathlib.Path | None = None) -> Config:
         conflict_mode=_validated(
             str(engine.get("conflict_mode", config.engine.conflict_mode)),
             "engine.conflict_mode", ("parity", "strict")),
+        text_fallback=bool(engine.get("text_fallback", config.engine.text_fallback)),
         max_nodes_per_bucket=int(
             engine.get("max_nodes_per_bucket", config.engine.max_nodes_per_bucket)
         ),

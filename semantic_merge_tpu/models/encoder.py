@@ -39,6 +39,15 @@ class EncoderConfig:
     d_ff: int = 512
     n_experts: int = 4
     dtype: Any = jnp.bfloat16
+    # Sequence-parallel attention strategy over the `sp` axis:
+    # "ring" (K/V chunks rotate via ppermute; O(L/n) memory) or
+    # "ulysses" (head/sequence all-to-all; full-L per head subset).
+    attn_mode: str = "ring"
+
+    def __post_init__(self):
+        if self.attn_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"attn_mode must be 'ring' or 'ulysses', got {self.attn_mode!r}")
 
 
 def init_encoder(rng: jax.Array, cfg: EncoderConfig) -> Dict[str, jax.Array]:
@@ -106,7 +115,11 @@ def encoder_forward(params: Dict[str, jax.Array], tokens: jax.Array,
         q = jnp.einsum("bld,dhk->blhk", h, lp["wq"].astype(cfg.dtype))
         k = jnp.einsum("bld,dhk->blhk", h, lp["wk"].astype(cfg.dtype))
         v = jnp.einsum("bld,dhk->blhk", h, lp["wv"].astype(cfg.dtype))
-        attn = ring_attention(q, k, v, mask, mesh.mesh)
+        if cfg.attn_mode == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+            attn = ulysses_attention(q, k, v, mask, mesh.mesh)
+        else:
+            attn = ring_attention(q, k, v, mask, mesh.mesh)
         x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(cfg.dtype))
 
         h = _rms_norm(x, lp["ln2"])

@@ -1,0 +1,76 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second long-context strategy (SURVEY.md §2.3): where ring attention
+(:mod:`semantic_merge_tpu.parallel.ring`) keeps K/V sharded and rotates
+chunks around the ``sp`` ring, Ulysses re-shards — one all-to-all turns
+the sequence sharding into a *head* sharding, every device then holds
+the **full sequence for a subset of heads**, computes ordinary (flash)
+attention locally with zero inner-loop communication, and a second
+all-to-all restores sequence sharding.
+
+Trade-off vs ring: 2 all-to-alls of activation size per layer
+(latency-bound, great on ICI) instead of ``n`` ppermute rounds
+overlapped with compute; but heads must divide the ``sp`` axis size,
+and per-device memory is O(L) for its head subset. Ring wins when
+L/device is tight; Ulysses wins when head count is ample and the
+sequence is extreme. Both are exact (no approximation), so the encoder
+can switch per config (``EncoderConfig.attn_mode``).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ulysses_local(q, k, v, kmask, *, axis_name: str):
+    """Per-shard body: q/k/v (B, L_loc, H_loc, Dh); kmask (B, L_loc)."""
+    n = lax.psum(1, axis_name)
+    h_loc = q.shape[2]
+    if h_loc % n != 0:
+        raise ValueError(
+            f"Ulysses needs heads-per-shard ({h_loc}) divisible by the "
+            f"{axis_name!r} axis size ({n}); use ring attention instead")
+
+    def seq_to_head(x):
+        # (B, L_loc, H_loc, Dh) → (B, L, H_loc/n, Dh): split heads n ways,
+        # gather all sequence chunks of one head group.
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg = seq_to_head(q)
+    kg = seq_to_head(k)
+    vg = seq_to_head(v)
+    mask_g = lax.all_gather(kmask, axis_name, axis=1, tiled=True)  # (B, L)
+
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", qg.astype(jnp.float32),
+                   kg.astype(jnp.float32)) * scale
+    s = jnp.where(mask_g[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
+    return head_to_seq(out.astype(q.dtype))
+
+
+def ulysses_attention(q, k, v, kmask, mesh: Mesh, *, axis_name: str = "sp"):
+    """Exact attention with the sequence axis sharded over ``axis_name``
+    via head/sequence all-to-all. Same signature and semantics as
+    :func:`semantic_merge_tpu.parallel.ring.ring_attention`."""
+    qkv_spec = P("dp", axis_name, "tp", None)
+    mask_spec = P("dp", axis_name)
+    return jax.shard_map(
+        partial(_ulysses_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )(q, k, v, kmask)

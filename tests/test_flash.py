@@ -74,3 +74,37 @@ def test_ring_attention_pallas_matches_einsum():
     out_einsum = ring_attention(q, k, v, mask, mesh.mesh, pallas=None)
     np.testing.assert_allclose(np.asarray(out_pallas), np.asarray(out_einsum),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_matches_dense_and_ring():
+    from semantic_merge_tpu.parallel.ulysses import ulysses_attention
+    b, l, h, dh = 4, 16, 4, 8
+    q = jnp.asarray(_rand((b, l, h, dh), 11))
+    k = jnp.asarray(_rand((b, l, h, dh), 12))
+    v = jnp.asarray(_rand((b, l, h, dh), 13))
+    mask = np.random.RandomState(14).rand(b, l) > 0.2
+    mask[:, 0] = True
+    mask = jnp.asarray(mask)
+    mesh = build_mesh(dp=2, pp=1, sp=2, tp=2, ep=1)
+    out_u = ulysses_attention(q, k, v, mask, mesh.mesh)
+    out_r = ring_attention(q, k, v, mask, mesh.mesh, pallas=None)
+    np.testing.assert_allclose(np.asarray(out_u), np.asarray(out_r),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_encoder_ulysses_mode_runs():
+    from dataclasses import replace
+
+    from semantic_merge_tpu.models.encoder import (EncoderConfig,
+                                                   encoder_forward,
+                                                   init_encoder)
+    from semantic_merge_tpu.models.features import encode_batch
+    cfg = EncoderConfig(vocab=256, d_model=32, n_heads=4, d_head=8,
+                        n_layers=1, d_ff=64, n_experts=2, attn_mode="ulysses")
+    mesh = build_mesh(dp=2, pp=1, sp=2, tp=2, ep=1)
+    params = init_encoder(jax.random.PRNGKey(0), cfg)
+    toks, mask = encode_batch(["export function f(x: number): number { return x; }"] * 4,
+                              256, 16)
+    out = encoder_forward(params, jnp.asarray(toks), jnp.asarray(mask), cfg, mesh)
+    assert out.shape == (4, 16, 32)
+    assert np.isfinite(np.asarray(out, dtype=np.float32)).all()

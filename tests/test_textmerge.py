@@ -1,0 +1,167 @@
+"""[FBK-001] text-merge fallback for non-indexed files."""
+import json
+import pathlib
+import subprocess
+
+import pytest
+
+from semantic_merge_tpu.runtime.textmerge import _resolve
+
+
+def test_resolve_matrix():
+    base, a, b = b"base\n", b"side a\n", b"side b\n"
+    assert _resolve("f", base, base, base) == (base, None)
+    assert _resolve("f", base, a, base) == (a, None)
+    assert _resolve("f", base, base, b) == (b, None)
+    assert _resolve("f", base, a, a) == (a, None)
+    # one-side delete, other unchanged → deletion wins
+    assert _resolve("f", base, None, base) == (None, None)
+    # delete vs edit → conflict
+    content, conflict = _resolve("f", base, None, b)
+    assert content is None and conflict.category == "TextMergeConflict"
+    # add same on both sides
+    assert _resolve("f", None, a, a) == (a, None)
+
+
+def test_resolve_non_overlapping_edits_merge():
+    base = b"line1\nline2\nline3\nline4\nline5\n"
+    a = b"LINE1\nline2\nline3\nline4\nline5\n"
+    b = b"line1\nline2\nline3\nline4\nLINE5\n"
+    merged, conflict = _resolve("f", base, a, b)
+    assert conflict is None
+    assert merged == b"LINE1\nline2\nline3\nline4\nLINE5\n"
+
+
+def test_resolve_overlapping_edits_conflict():
+    base = b"hello\n"
+    merged, conflict = _resolve("f", base, b"hola\n", b"bonjour\n")
+    assert merged is None
+    assert conflict.category == "TextMergeConflict"
+    assert conflict.minimalSlice["path"] == "f"
+
+
+def test_resolve_binary_both_changed_conflict():
+    base = b"\x00\x01\x02"
+    merged, conflict = _resolve("f", base, b"\x00\x03", b"\x00\x04")
+    assert merged is None and conflict is not None
+    # one side unchanged → fine even for binary
+    assert _resolve("f", base, base, b"\x00\x05") == (b"\x00\x05", None)
+
+
+def _git(cwd, *args):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _setup_repo(tmp_path, base_files, a_edit, b_edit):
+    for name, content in base_files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    _git(tmp_path, "init", "-q", "-b", "main")
+    _git(tmp_path, "config", "user.email", "t@e")
+    _git(tmp_path, "config", "user.name", "t")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "base")
+    _git(tmp_path, "branch", "basebr")
+    _git(tmp_path, "checkout", "-qb", "ba")
+    a_edit(tmp_path)
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "a")
+    _git(tmp_path, "checkout", "-q", "main")
+    _git(tmp_path, "checkout", "-qb", "bb")
+    b_edit(tmp_path)
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "b")
+    _git(tmp_path, "checkout", "-q", "main")
+
+
+def test_cli_merges_readme_alongside_ts(tmp_path, monkeypatch):
+    """A doc edit on side A and a TS rename on side B both land."""
+    _setup_repo(
+        tmp_path,
+        {"a.ts": "export function foo(n: number): number { return n; }\n",
+         "README.md": "# title\n\nintro\n"},
+        a_edit=lambda p: (p / "README.md").write_text("# title\n\nintro rewritten\n"),
+        b_edit=lambda p: (p / "a.ts").write_text(
+            "export function bar(n: number): number { return n; }\n"),
+    )
+    monkeypatch.chdir(tmp_path)
+    from semantic_merge_tpu.cli import main
+    rc = main(["semmerge", "basebr", "ba", "bb", "--backend", "host", "--inplace"])
+    assert rc == 0
+    assert "rewritten" in (tmp_path / "README.md").read_text()
+    assert "function bar" in (tmp_path / "a.ts").read_text()
+
+
+def test_cli_text_conflict_exits_1(tmp_path, monkeypatch):
+    _setup_repo(
+        tmp_path,
+        {"notes.txt": "hello\n"},
+        a_edit=lambda p: (p / "notes.txt").write_text("hola\n"),
+        b_edit=lambda p: (p / "notes.txt").write_text("bonjour\n"),
+    )
+    monkeypatch.chdir(tmp_path)
+    from semantic_merge_tpu.cli import main
+    rc = main(["semmerge", "basebr", "ba", "bb", "--backend", "host"])
+    assert rc == 1
+    payload = json.loads((tmp_path / ".semmerge-conflicts.json").read_text())
+    assert payload[0]["category"] == "TextMergeConflict"
+    assert payload[0]["minimalSlice"]["path"] == "notes.txt"
+
+
+def test_cli_text_fallback_disabled(tmp_path, monkeypatch):
+    _setup_repo(
+        tmp_path,
+        {"notes.txt": "hello\n"},
+        a_edit=lambda p: (p / "notes.txt").write_text("hola\n"),
+        b_edit=lambda p: (p / "notes.txt").write_text("bonjour\n"),
+    )
+    (tmp_path / ".semmerge.toml").write_text(
+        "[engine]\nbackend = \"host\"\ntext_fallback = false\n")
+    monkeypatch.chdir(tmp_path)
+    from semantic_merge_tpu.cli import main
+    rc = main(["semmerge", "basebr", "ba", "bb"])
+    assert rc == 0  # reference-parity posture: non-indexed files stay at base
+
+
+def test_java_files_text_merge_under_ts_backend(tmp_path, monkeypatch):
+    """With the TS backend active, a .java edit must text-merge, not
+    silently revert (the gate is the backend's extension set, not the
+    global source union)."""
+    _setup_repo(
+        tmp_path,
+        {"a.ts": "export function foo(n: number): number { return n; }\n",
+         "Main.java": "class Main { }\n"},
+        a_edit=lambda p: (p / "Main.java").write_text("class Main { int x; }\n"),
+        b_edit=lambda p: (p / "a.ts").write_text(
+            "export function bar(n: number): number { return n; }\n"),
+    )
+    monkeypatch.chdir(tmp_path)
+    from semantic_merge_tpu.cli import main
+    rc = main(["semmerge", "basebr", "ba", "bb", "--backend", "host", "--inplace"])
+    assert rc == 0
+    assert "int x" in (tmp_path / "Main.java").read_text()
+
+
+def test_inplace_propagates_text_deletions(tmp_path, monkeypatch):
+    _setup_repo(
+        tmp_path,
+        {"a.ts": "export function foo(n: number): number { return n; }\n",
+         "notes.txt": "hello\n"},
+        a_edit=lambda p: (p / "notes.txt").unlink(),
+        b_edit=lambda p: (p / "a.ts").write_text(
+            "export function bar(n: number): number { return n; }\n"),
+    )
+    monkeypatch.chdir(tmp_path)
+    from semantic_merge_tpu.cli import main
+    rc = main(["semmerge", "basebr", "ba", "bb", "--backend", "host", "--inplace"])
+    assert rc == 0
+    assert not (tmp_path / "notes.txt").exists()
+
+
+def test_encoder_rejects_bad_attn_mode():
+    import pytest as _pytest
+    from semantic_merge_tpu.models.encoder import EncoderConfig
+    with _pytest.raises(ValueError, match="attn_mode"):
+        EncoderConfig(attn_mode="ulyses")

@@ -38,12 +38,14 @@ class Backend(Protocol):
     def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
                        *, base_rev: str = "base", seed: str = "0",
                        timestamp: str | None = None,
-                       change_signature: bool = False) -> BuildAndDiffResult: ...
+                       change_signature: bool = False,
+                       structured_apply: bool = False) -> BuildAndDiffResult: ...
 
     def diff(self, base: Snapshot, right: Snapshot,
              *, base_rev: str = "base", seed: str = "0",
              timestamp: str | None = None,
-             change_signature: bool = False) -> List[Op]: ...
+             change_signature: bool = False,
+             structured_apply: bool = False) -> List[Op]: ...
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         """Compose two op logs; backends override to run composition on

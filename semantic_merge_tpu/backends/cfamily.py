@@ -9,7 +9,8 @@ from __future__ import annotations
 
 from typing import List
 
-from ..core.difflift import diff_nodes, lift, refine_signature_changes
+from ..core.difflift import (diff_nodes, lift, refine_signature_changes,
+                             source_maps)
 from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
 from ..frontend.cfamily import LanguageSpec, scan_snapshot_cfamily
@@ -32,7 +33,8 @@ class CFamilyBackend:
     def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
                        *, base_rev: str = "base", seed: str = "0",
                        timestamp: str | None = None,
-                       change_signature: bool = False) -> BuildAndDiffResult:
+                       change_signature: bool = False,
+                       structured_apply: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot_cfamily(self._filter(base), self.spec)
         left_nodes = scan_snapshot_cfamily(self._filter(left), self.spec)
@@ -42,9 +44,15 @@ class CFamilyBackend:
         if change_signature:
             diffs_l = refine_signature_changes(diffs_l)
             diffs_r = refine_signature_changes(diffs_r)
+        src_l = (source_maps(self._filter(base), self._filter(left))
+                 if structured_apply else None)
+        src_r = (source_maps(self._filter(base), self._filter(right))
+                 if structured_apply else None)
         return BuildAndDiffResult(
-            op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts),
-            op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts),
+            op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts,
+                             sources=src_l),
+            op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts,
+                              sources=src_r),
             symbol_maps={
                 "base": symbol_map(base_nodes),
                 "left": symbol_map(left_nodes),
@@ -55,14 +63,18 @@ class CFamilyBackend:
     def diff(self, base: Snapshot, right: Snapshot,
              *, base_rev: str = "base", seed: str = "0",
              timestamp: str | None = None,
-             change_signature: bool = False) -> List[Op]:
+             change_signature: bool = False,
+             structured_apply: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot_cfamily(self._filter(base), self.spec)
         right_nodes = scan_snapshot_cfamily(self._filter(right), self.spec)
         diffs = diff_nodes(base_nodes, right_nodes)
         if change_signature:
             diffs = refine_signature_changes(diffs)
-        return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts)
+        sources = (source_maps(self._filter(base), self._filter(right))
+                   if structured_apply else None)
+        return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts,
+                    sources=sources)
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         return host_compose(delta_a, delta_b)

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 from typing import List
 
-from ..core.difflift import diff_nodes, lift, refine_signature_changes
+from ..core.difflift import (diff_nodes, lift, refine_signature_changes,
+                             source_maps)
 from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
 from ..frontend.scanner import scan_snapshot
@@ -34,7 +35,8 @@ class HostTSBackend:
     def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
                        *, base_rev: str = "base", seed: str = "0",
                        timestamp: str | None = None,
-                       change_signature: bool = False) -> BuildAndDiffResult:
+                       change_signature: bool = False,
+                       structured_apply: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(ts_files(base))
         left_nodes = scan_snapshot(ts_files(left))
@@ -44,9 +46,13 @@ class HostTSBackend:
         if change_signature:
             diffs_l = refine_signature_changes(diffs_l)
             diffs_r = refine_signature_changes(diffs_r)
+        src_l = source_maps(ts_files(base), ts_files(left)) if structured_apply else None
+        src_r = source_maps(ts_files(base), ts_files(right)) if structured_apply else None
         return BuildAndDiffResult(
-            op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts),
-            op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts),
+            op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts,
+                             sources=src_l),
+            op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts,
+                              sources=src_r),
             symbol_maps={
                 "base": symbol_map(base_nodes),
                 "left": symbol_map(left_nodes),
@@ -57,14 +63,17 @@ class HostTSBackend:
     def diff(self, base: Snapshot, right: Snapshot,
              *, base_rev: str = "base", seed: str = "0",
              timestamp: str | None = None,
-             change_signature: bool = False) -> List[Op]:
+             change_signature: bool = False,
+             structured_apply: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(ts_files(base))
         right_nodes = scan_snapshot(ts_files(right))
         diffs = diff_nodes(base_nodes, right_nodes)
         if change_signature:
             diffs = refine_signature_changes(diffs)
-        return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts)
+        sources = source_maps(ts_files(base), ts_files(right)) if structured_apply else None
+        return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts,
+                    sources=sources)
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         return host_compose(delta_a, delta_b)

@@ -17,7 +17,8 @@ from __future__ import annotations
 
 from typing import Dict, List
 
-from ..core.difflift import Diff, lift, refine_signature_changes
+from ..core.difflift import (Diff, lift, refine_signature_changes,
+                             source_maps)
 from ..core.encode import NULL_ID, Interner, encode_decls
 from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
@@ -45,7 +46,8 @@ class TpuTSBackend:
     def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
                        *, base_rev: str = "base", seed: str = "0",
                        timestamp: str | None = None,
-                       change_signature: bool = False) -> BuildAndDiffResult:
+                       change_signature: bool = False,
+                       structured_apply: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(ts_files(base))
         left_nodes = scan_snapshot(ts_files(left))
@@ -60,9 +62,13 @@ class TpuTSBackend:
         if change_signature:
             diffs_l = refine_signature_changes(diffs_l)
             diffs_r = refine_signature_changes(diffs_r)
+        src_l = source_maps(ts_files(base), ts_files(left)) if structured_apply else None
+        src_r = source_maps(ts_files(base), ts_files(right)) if structured_apply else None
         return BuildAndDiffResult(
-            op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts),
-            op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts),
+            op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts,
+                             sources=src_l),
+            op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts,
+                              sources=src_r),
             symbol_maps={
                 "base": symbol_map(base_nodes),
                 "left": symbol_map(left_nodes),
@@ -73,7 +79,8 @@ class TpuTSBackend:
     def diff(self, base: Snapshot, right: Snapshot,
              *, base_rev: str = "base", seed: str = "0",
              timestamp: str | None = None,
-             change_signature: bool = False) -> List[Op]:
+             change_signature: bool = False,
+             structured_apply: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(ts_files(base))
         right_nodes = scan_snapshot(ts_files(right))
@@ -84,7 +91,9 @@ class TpuTSBackend:
         diffs = decode_diffs(t, interner, base_nodes, right_nodes)
         if change_signature:
             diffs = refine_signature_changes(diffs)
-        return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts)
+        sources = source_maps(ts_files(base), ts_files(right)) if structured_apply else None
+        return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts,
+                    sources=sources)
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         from ..ops.compose import compose_oplogs_device

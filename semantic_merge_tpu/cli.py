@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
     p_merge.add_argument("--strict-conflicts", action="store_true",
                          help="Detect all [CFR-002] conflict categories via a "
                               "full symbol join (also [engine].conflict_mode)")
+    p_merge.add_argument("--structured-apply", action="store_true",
+                         help="Ops carry decl text/spans so add/delete/"
+                              "changeSignature materialize structurally "
+                              "(also [engine].structured_apply)")
 
     p_rebase = sub.add_parser("semrebase", help="Replay a commit's stored op log onto a revision")
     p_rebase.add_argument("commit", help="Commit whose semmerge note holds the op log")
@@ -179,6 +183,8 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                 base_rev=base_rev, seed=seed, timestamp=timestamp,
                 change_signature=(args.change_signature
                                   or config.engine.change_signature),
+                structured_apply=(getattr(args, "structured_apply", False)
+                                  or config.engine.structured_apply),
             )
         tracer.count("ops_left", len(result.op_log_left))
         tracer.count("ops_right", len(result.op_log_right))

@@ -24,6 +24,8 @@ Schema (superset of the reference's documented schema at reference
                                    # | "strict" (all [CFR-002] categories)
     text_fallback = true           # [FBK-001]: 3-way text merge for files no
                                    # backend indexes (off => those stay at base)
+    structured_apply = false       # ops carry decl text/spans; applier splices
+                                   # add/delete/changeSignature structurally
     max_nodes_per_bucket = 2048    # padding bucket sizes, powers of two
     mesh_shape = "auto"            # or e.g. "dp=4,tp=2"
 
@@ -58,6 +60,7 @@ class EngineConfig:
     change_signature: bool = False
     conflict_mode: str = "parity"
     text_fallback: bool = True
+    structured_apply: bool = False
     max_nodes_per_bucket: int = 2048
     mesh_shape: str = "auto"
 
@@ -121,6 +124,8 @@ def load_config(start: pathlib.Path | None = None) -> Config:
             str(engine.get("conflict_mode", config.engine.conflict_mode)),
             "engine.conflict_mode", ("parity", "strict")),
         text_fallback=bool(engine.get("text_fallback", config.engine.text_fallback)),
+        structured_apply=bool(
+            engine.get("structured_apply", config.engine.structured_apply)),
         max_nodes_per_bucket=int(
             engine.get("max_nodes_per_bucket", config.engine.max_nodes_per_bucket)
         ),

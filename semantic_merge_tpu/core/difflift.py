@@ -104,17 +104,54 @@ def refine_signature_changes(diffs: List[Diff]) -> List[Diff]:
     return out
 
 
+def source_maps(base_files, side_files) -> tuple:
+    """(base, side) path→content maps for structured-apply payloads."""
+    from ..frontend.scanner import normalize_path
+    return ({normalize_path(f["path"]): f["content"] for f in base_files},
+            {normalize_path(f["path"]): f["content"] for f in side_files})
+
+
+def _decl_payload(d: Diff, sources) -> Dict | None:
+    """Structured-apply payload for an op's ``effects``.
+
+    Spans are *base-content* offsets (``pos`` is the decl's full start,
+    ``end`` its last token), texts are side-content slices — exactly
+    what the applier needs to splice without re-parsing. This is the
+    designed-but-unbuilt worker ``applyOps`` stage (reference
+    ``implementation.md:1258,1339``), opt-in because it extends the
+    reference's op JSON shape.
+    """
+    if sources is None:
+        return None
+    base_map, side_map = sources
+    if d.kind == "add" and d.b is not None:
+        src = side_map.get(d.b.file)
+        if src is not None:
+            return {"text": src[d.b.pos:d.b.end]}
+    elif d.kind == "delete" and d.a is not None:
+        return {"start": d.a.pos, "end": d.a.end}
+    elif d.kind == "changeSig" and d.a is not None and d.b is not None:
+        src = side_map.get(d.b.file)
+        if src is not None:
+            return {"start": d.a.pos, "end": d.a.end,
+                    "text": src[d.b.pos:d.b.end]}
+    return None
+
+
 def lift(base_rev: str, diffs: List[Diff], *, seed: str = "0",
-         timestamp: str = EPOCH_ISO) -> List[Op]:
+         timestamp: str = EPOCH_ISO, sources=None) -> List[Op]:
     """Diff records → Op records.
 
     Op ids are deterministic: a function of the seed, the diff content,
     and the diff's position in the stream — the same inputs yield
-    bit-identical op logs from any backend.
+    bit-identical op logs from any backend. With ``sources`` (a
+    :func:`source_maps` pair), add/delete/changeSig ops carry
+    structured-apply payloads in ``effects["decl"]``.
     """
     ops: List[Op] = []
     for idx, d in enumerate(diffs):
         prov = {"rev": base_rev, "timestamp": timestamp}
+        payload = _decl_payload(d, sources)
         if d.kind == "rename" and d.a and d.b:
             ops.append(Op.new(
                 "renameSymbol",
@@ -141,6 +178,10 @@ def lift(base_rev: str, diffs: List[Diff], *, seed: str = "0",
                 op_id=_op_id(seed, base_rev, idx, "moveDecl", d),
             ))
         elif d.kind == "changeSig" and d.a and d.b:
+            effects = {"summary":
+                       f"changeSignature {d.a.name}: {d.a.signature}→{d.b.signature}"}
+            if payload is not None:
+                effects["decl"] = payload
             ops.append(Op.new(
                 "changeSignature",
                 Target(symbolId=d.a.symbolId, addressId=d.a.addressId),
@@ -154,28 +195,33 @@ def lift(base_rev: str, diffs: List[Diff], *, seed: str = "0",
                     "newSymbolId": d.b.symbolId,
                 },
                 guards={"exists": True, "addressMatch": d.a.addressId},
-                effects={"summary":
-                         f"changeSignature {d.a.name}: {d.a.signature}→{d.b.signature}"},
+                effects=effects,
                 provenance=prov,
                 op_id=_op_id(seed, base_rev, idx, "changeSignature", d),
             ))
         elif d.kind == "add" and d.b:
+            effects = {"summary": "add decl"}
+            if payload is not None:
+                effects["decl"] = payload
             ops.append(Op.new(
                 "addDecl",
                 Target(symbolId=d.b.symbolId, addressId=d.b.addressId),
                 params={"file": d.b.file},
                 guards={},
-                effects={"summary": "add decl"},
+                effects=effects,
                 provenance=prov,
                 op_id=_op_id(seed, base_rev, idx, "addDecl", d),
             ))
         elif d.kind == "delete" and d.a:
+            effects = {"summary": "delete decl"}
+            if payload is not None:
+                effects["decl"] = payload
             ops.append(Op.new(
                 "deleteDecl",
                 Target(symbolId=d.a.symbolId, addressId=d.a.addressId),
                 params={"file": d.a.file},
                 guards={},
-                effects={"summary": "delete decl"},
+                effects=effects,
                 provenance=prov,
                 op_id=_op_id(seed, base_rev, idx, "deleteDecl", d),
             ))

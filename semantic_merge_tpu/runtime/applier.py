@@ -25,14 +25,78 @@ def apply_ops(base_tree: pathlib.Path, ops: Iterable[Op]) -> pathlib.Path:
     base_tree = pathlib.Path(base_tree)
     out = pathlib.Path(tempfile.mkdtemp(prefix="semmerge_merged_"))
     shutil.copytree(base_tree, out, dirs_exist_ok=True)
+    ops = list(ops)
 
+    # Structured-apply span edits (delete/changeSignature carrying
+    # effects["decl"] payloads — the designed worker applyOps stage,
+    # reference ``implementation.md:1258,1339``) run FIRST: their spans
+    # are base-content offsets, so they must land before moves/renames
+    # rewrite paths and text. Per file, descending start order keeps
+    # earlier spans valid.
+    span_ops = [op for op in ops
+                if op.type in ("deleteDecl", "changeSignature")
+                and isinstance(op.effects.get("decl"), dict)
+                and "start" in op.effects["decl"]]
+    _apply_span_edits(out, span_ops)
+    structured = set(map(id, span_ops))
+
+    add_ops = []
     for op in ops:
+        if id(op) in structured:
+            continue
+        if (op.type == "addDecl"
+                and isinstance(op.effects.get("decl"), dict)
+                and "text" in op.effects["decl"]):
+            add_ops.append(op)  # appends run after path-shaping ops
+            continue
         handler = _HANDLERS.get(op.type)
         if handler is None:
             logger.debug("No applier hook for op %s", op.type)
             continue
         handler(out, op)
+    for op in add_ops:
+        _apply_add_decl(out, op)
     return out
+
+
+def _apply_span_edits(root: pathlib.Path, span_ops) -> None:
+    by_file: dict = {}
+    for op in span_ops:
+        file_path = op.params.get("file")
+        if file_path:
+            by_file.setdefault(str(file_path), []).append(op)
+    for file_path, file_ops in by_file.items():
+        path = root / _normalize_relpath(file_path)
+        if not path.exists():
+            logger.debug("span-edit target missing: %s", path)
+            continue
+        code = path.read_text(encoding="utf-8")
+        for op in sorted(file_ops,
+                         key=lambda o: -int(o.effects["decl"]["start"])):
+            decl = op.effects["decl"]
+            start = max(0, int(decl["start"]))
+            end = min(len(code), int(decl["end"]))
+            if start > end:
+                continue
+            replacement = str(decl.get("text", ""))
+            code = code[:start] + replacement + code[end:]
+        path.write_text(code, encoding="utf-8")
+
+
+def _apply_add_decl(root: pathlib.Path, op: Op) -> None:
+    file_path = op.params.get("file")
+    text = op.effects.get("decl", {}).get("text")
+    if not file_path or text is None:
+        return
+    path = root / _normalize_relpath(file_path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    existing = path.read_text(encoding="utf-8") if path.exists() else ""
+    if existing and not existing.endswith("\n"):
+        existing += "\n"
+    snippet = str(text)
+    if not snippet.endswith("\n"):
+        snippet += "\n"
+    path.write_text(existing + snippet.lstrip("\n"), encoding="utf-8")
 
 
 def _apply_move_decl(root: pathlib.Path, op: Op) -> None:

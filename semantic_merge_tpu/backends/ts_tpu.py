@@ -15,6 +15,7 @@ applies to both backends identically.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, List
 
 from ..core.difflift import (Diff, lift, refine_signature_changes,
@@ -35,13 +36,50 @@ class TpuTSBackend:
     name = "tpu"
     extensions = frozenset(TS_EXTENSIONS)
 
-    def __init__(self) -> None:
+    def __init__(self, mesh=None) -> None:
         # Probe JAX init at construction so the CLI's host-fallback path
         # (cli._resolve_backend) catches a broken plugin/runtime here
         # instead of deep inside the first merge. XLA-on-CPU (no
         # accelerator present) is a supported degraded mode, not an error.
         import jax
-        jax.devices()
+        devices = jax.devices()
+        if mesh is None and len(devices) > 1:
+            # Multi-chip: shard the merge kernels' decl/op axis over a
+            # dp mesh by default (BASELINE north star: the file/decl
+            # batch is the core parallel axis). Single chip keeps the
+            # lighter non-shard_map kernels.
+            from ..parallel.mesh import build_mesh
+            mesh = build_mesh(devices, dp=len(devices),
+                              pp=1, sp=1, tp=1, ep=1).mesh
+        self._mesh = mesh
+
+    def configure(self, config) -> None:
+        """Apply ``.semmerge.toml`` settings (called by the CLI): an
+        explicit ``[engine] mesh_shape = "dp=4,tp=2"`` overrides the
+        auto dp mesh."""
+        shape = getattr(config.engine, "mesh_shape", "auto")
+        sizes = None
+        try:
+            from ..parallel.mesh import build_mesh, parse_mesh_shape
+            sizes = parse_mesh_shape(shape)
+            if sizes:
+                import jax
+                self._mesh = build_mesh(jax.devices(), **sizes).mesh
+        except ValueError as exc:
+            from ..utils.loggingx import logger
+            logger.warning("invalid mesh_shape %r ignored: %s", shape, exc)
+
+    def _diff_pair_fn(self):
+        if self._mesh is not None:
+            from ..ops.sharded import diff_lift_device_pair_sharded
+            return partial(diff_lift_device_pair_sharded, mesh=self._mesh)
+        return diff_lift_device_pair
+
+    def _diff_fn(self):
+        if self._mesh is not None:
+            from ..ops.sharded import diff_lift_device_sharded
+            return partial(diff_lift_device_sharded, mesh=self._mesh)
+        return diff_lift_device
 
     def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
                        *, base_rev: str = "base", seed: str = "0",
@@ -56,7 +94,7 @@ class TpuTSBackend:
         base_t = encode_decls(base_nodes, interner)
         left_t = encode_decls(left_nodes, interner)
         right_t = encode_decls(right_nodes, interner)
-        t_l, t_r = diff_lift_device_pair(base_t, left_t, right_t)
+        t_l, t_r = self._diff_pair_fn()(base_t, left_t, right_t)
         diffs_l = decode_diffs(t_l, interner, base_nodes, left_nodes)
         diffs_r = decode_diffs(t_r, interner, base_nodes, right_nodes)
         if change_signature:
@@ -87,7 +125,7 @@ class TpuTSBackend:
         interner = Interner()
         base_t = encode_decls(base_nodes, interner)
         right_t = encode_decls(right_nodes, interner)
-        t = diff_lift_device(base_t, right_t)
+        t = self._diff_fn()(base_t, right_t)
         diffs = decode_diffs(t, interner, base_nodes, right_nodes)
         if change_signature:
             diffs = refine_signature_changes(diffs)
@@ -96,6 +134,9 @@ class TpuTSBackend:
                     sources=sources)
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
+        if self._mesh is not None:
+            from ..ops.sharded import compose_oplogs_device_sharded
+            return compose_oplogs_device_sharded(delta_a, delta_b, self._mesh)
         from ..ops.compose import compose_oplogs_device
         return compose_oplogs_device(delta_a, delta_b)
 

@@ -125,12 +125,16 @@ def _resolve_backend(name_flag: str | None):
         except Exception as exc:
             logger.warning("distributed bring-up failed (%s); continuing single-host", exc)
     try:
-        return get_backend(name), config
+        backend = get_backend(name)
     except Exception as exc:  # TPU backend unavailable → host fallback
         if name != "host":
             logger.warning("Backend %r unavailable (%s); falling back to host", name, exc)
             return get_backend("host"), config
         raise
+    configure = getattr(backend, "configure", None)
+    if configure is not None:
+        configure(config)
+    return backend, config
 
 
 def cmd_semdiff(args: argparse.Namespace) -> int:

@@ -138,6 +138,18 @@ def bucket_size(n: int, minimum: int = 8) -> int:
     return size
 
 
+def shard_bucket(n: int, k: int = 1) -> int:
+    """Bucket that divides evenly into ``k`` shards: ``k`` × a power of
+    two ≥ ceil(n/k), at least 8 rows total. For ``k = 1`` this equals
+    :func:`bucket_size`; for any ``k`` (including non-powers-of-two,
+    e.g. a 6-device mesh) the padded axis is divisible by ``k`` while
+    the set of compiled shapes stays logarithmic in ``n``."""
+    per = bucket_size(max((n + k - 1) // k, 1), minimum=1)
+    while k * per < 8:
+        per *= 2
+    return k * per
+
+
 # --- op-tensor encoding (compose input/output) ------------------------------
 
 #: Op-kind codes for device columns. Only kinds the differ emits get

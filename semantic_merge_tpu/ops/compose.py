@@ -42,7 +42,8 @@ import numpy as np
 
 from ..core.conflict import Conflict, divergent_rename_conflict
 from ..core.encode import (NULL_ID, PAD_ID, Interner, OpTensor,
-                           build_rank_tables, bucket_size, encode_oplog, pad_to)
+                           build_rank_tables, encode_oplog, pad_to,
+                           shard_bucket)
 from ..core.ops import Op, Target
 
 _PAD_PREC = np.int32(2**30)  # sorts after every real precedence
@@ -64,45 +65,75 @@ def _key_leq(pa, ta, pb, tb):
     return (pa < pb) | ((pa == pb) & (ta <= tb))
 
 
-@partial(jax.jit, static_argnames=("na", "nb"))
-def _compose_kernel(a_cols, b_cols, n_a, n_b, na: int, nb: int):
-    # ---- stage 1: canonical per-stream sort + merged order -----------------
-    def sort_stream(cols):
-        order = jnp.lexsort((cols["id_rank"], cols["ts_rank"], cols["prec"]))
-        return {k: v[order] for k, v in cols.items()}
+def _sort_stream(cols):
+    """Stage 1: canonical per-stream sort by (prec, ts rank, id rank)."""
+    order = jnp.lexsort((cols["id_rank"], cols["ts_rank"], cols["prec"]))
+    return {k: v[order] for k, v in cols.items()}
 
-    a = sort_stream({k: jnp.asarray(v) for k, v in a_cols.items()})
-    b = sort_stream({k: jnp.asarray(v) for k, v in b_cols.items()})
 
-    # ---- stage 2: DivergentRename candidates (parallel precheck) ----------
-    def rename_pairs(cols, n_real, n_pad):
-        idx = jnp.arange(n_pad)
-        is_r = (cols["is_rename"] == 1) & (idx < n_real)
-        sym = jnp.where(is_r, cols["sym"], PAD_ID)
-        return sym, cols["new_name"]
+def _rename_pairs(cols, n_real, n_pad):
+    """(symbol, newName) pairs of a stream's rename rows (PAD elsewhere)."""
+    idx = jnp.arange(n_pad)
+    is_r = (cols["is_rename"] == 1) & (idx < n_real)
+    sym = jnp.where(is_r, cols["sym"], PAD_ID)
+    return sym, cols["new_name"]
 
-    a_rsym, a_rname = rename_pairs(a, n_a, na)
-    b_rsym, b_rname = rename_pairs(b, n_b, nb)
+
+def _rename_candidate_tables(a, n_a, na):
+    """Sorted lookup tables over A's rename pairs for the DivergentRename
+    candidate join; replicated across shards in the mesh kernel (the
+    symbol-table all-gather of the north star)."""
+    a_rsym, a_rname = _rename_pairs(a, n_a, na)
     a_ord = jnp.argsort(a_rsym, stable=True)
     srt_sym, srt_name = a_rsym[a_ord], a_rname[a_ord]
-    # For each B rename, does any A rename share the symbol with a
-    # different name?  (Scan the ≤2 boundary slots is not enough when one
-    # symbol has several renames with mixed names, so compare against the
-    # run's min/max name instead.)
+    # Sorting by (sym, name) lets a query read the run's min/max name —
+    # scanning the ≤2 boundary slots is not enough when one symbol has
+    # several renames with mixed names.
+    name_sorted_key = jnp.lexsort((srt_name, srt_sym))
+    return srt_sym, srt_sym[name_sorted_key], srt_name[name_sorted_key]
+
+
+def _rename_candidate_query(tables, na, b_rsym, b_rname):
+    """For each B rename (query side — the shardable axis): does any A
+    rename share the symbol with a different name?"""
+    srt_sym, nm_sym, nm_name = tables
     left = jnp.clip(jnp.searchsorted(srt_sym, b_rsym, side="left"), 0, na - 1)
     seg_has = srt_sym[left] == b_rsym
-    # any differing name in run [left, right]: min/max of names over run
-    name_sorted_key = jnp.lexsort((srt_name, srt_sym))
-    nm_sym = srt_sym[name_sorted_key]
-    nm_name = srt_name[name_sorted_key]
     lo = jnp.clip(jnp.searchsorted(nm_sym, b_rsym, side="left"), 0, na - 1)
     hi = jnp.clip(jnp.searchsorted(nm_sym, b_rsym, side="right") - 1, 0, na - 1)
     run_min = nm_name[lo]
     run_max = nm_name[hi]
-    differing = seg_has & (b_rsym != PAD_ID) & ((run_min != b_rname) | (run_max != b_rname))
+    return (seg_has & (b_rsym != PAD_ID)
+            & ((run_min != b_rname) | (run_max != b_rname)))
+
+
+@partial(jax.jit, static_argnames=("na", "nb"))
+def _compose_kernel(a_cols, b_cols, n_a, n_b, na: int, nb: int):
+    # ---- stage 1: canonical per-stream sort + merged order -----------------
+    a = _sort_stream({k: jnp.asarray(v) for k, v in a_cols.items()})
+    b = _sort_stream({k: jnp.asarray(v) for k, v in b_cols.items()})
+
+    # ---- stage 2: DivergentRename candidates (parallel precheck) ----------
+    tables = _rename_candidate_tables(a, n_a, na)
+    b_rsym, b_rname = _rename_pairs(b, n_b, nb)
+    differing = _rename_candidate_query(tables, na, b_rsym, b_rname)
     has_candidates = jnp.any(differing)
 
-    # ---- stage 2b: exact cursor walk (only when candidates exist) ---------
+    drop_a, drop_b, conf_a, conf_b, n_conf = _conflict_cursor_walk(
+        a, b, n_a, n_b, na, nb, has_candidates)
+
+    # ---- stage 3: merged order + segmented chain scans --------------------
+    return _merge_and_scan(a, b, n_a, n_b, na, nb,
+                           drop_a, drop_b, conf_a, conf_b, n_conf,
+                           seg_scan_impl=_local_seg_scan)
+
+
+def _conflict_cursor_walk(a, b, n_a, n_b, na: int, nb: int, has_candidates):
+    """Stage 2b: exact head-vs-head cursor walk, entered only when the
+    candidate join found a possible DivergentRename. Inherently
+    sequential (reference ``semmerge/compose.py:51-112``); in the mesh
+    kernel it runs replicated on the gathered streams — identical on
+    every shard."""
     max_conf = min(na, nb)
 
     def cursor_walk(_):
@@ -147,10 +178,43 @@ def _compose_kernel(a_cols, b_cols, n_a, n_b, na: int, nb: int):
                 jnp.full((max_conf,), NULL_ID, jnp.int32),
                 jnp.int32(0))
 
-    drop_a, drop_b, conf_a, conf_b, n_conf = jax.lax.cond(
-        has_candidates, cursor_walk, no_walk, operand=None)
+    return jax.lax.cond(has_candidates, cursor_walk, no_walk, operand=None)
 
-    # ---- stage 3: merged order + segmented chain scans --------------------
+
+def _local_seg_scan(seg_sym, seg_order, vals):
+    """Single-device segmented inclusive last-valid scan: rows are in
+    (sym, merged position) order; returns per-row chain value, unsorted
+    back to row order. ``NULL_ID`` where no valid value precedes."""
+    v = vals[seg_order]
+    m = v != NULL_ID
+    _, sv, sm = jax.lax.associative_scan(_seg_combine, (seg_sym, v, m))
+    out = jnp.full_like(vals, NULL_ID)
+    return out.at[seg_order].set(jnp.where(sm, sv, NULL_ID))
+
+
+def _seg_combine(x, y):
+    """Associative 'last valid value within the symbol segment' combine.
+    Elements are (sym, value, valid); invariant: value == NULL_ID
+    whenever valid is False."""
+    xs, xv, xm = x
+    ys, yv, ym = y
+    same = ys == xs
+    val = jnp.where(ym, yv, jnp.where(same, xv, NULL_ID))
+    msk = ym | (same & xm)
+    return ys, val, msk
+
+
+def _merge_and_scan(a, b, n_a, n_b, na: int, nb: int,
+                    drop_a, drop_b, conf_a, conf_b, n_conf,
+                    *, seg_scan_impl):
+    """Stage 3: merged order + segmented chain scans + output assembly.
+
+    ``seg_scan_impl(seg_sym, seg_order, vals)`` performs the segmented
+    last-valid scan — injected so the mesh kernel can substitute the
+    distributed scan (local scans + carry exchange over the ``dp`` axis)
+    while every other instruction stays bit-identical to the
+    single-device path.
+    """
     def cat(name):
         return jnp.concatenate([a[name], b[name]])
 
@@ -184,25 +248,9 @@ def _compose_kernel(a_cols, b_cols, n_a, n_b, na: int, nb: int):
     seg_order = jnp.lexsort((merged_pos, sym))
     seg_sym = sym[seg_order]
 
-    def seg_scan(vals):
-        v = vals[seg_order]
-        m = v != NULL_ID
-
-        def combine(x, y):
-            xs, xv, xm = x
-            ys, yv, ym = y
-            same = ys == xs
-            val = jnp.where(ym, yv, jnp.where(same, xv, NULL_ID))
-            msk = ym | (same & xm)
-            return ys, val, msk
-
-        _, sv, sm = jax.lax.associative_scan(combine, (seg_sym, v, m))
-        out = jnp.full_like(vals, NULL_ID)
-        return out.at[seg_order].set(jnp.where(sm, sv, NULL_ID))
-
-    chain_addr = seg_scan(c_addr_val)
-    chain_file = seg_scan(c_file_val)
-    chain_name = seg_scan(c_name_val)
+    chain_addr = seg_scan_impl(seg_sym, seg_order, c_addr_val)
+    chain_file = seg_scan_impl(seg_sym, seg_order, c_file_val)
+    chain_name = seg_scan_impl(seg_sym, seg_order, c_name_val)
 
     # ---- output assembly ---------------------------------------------------
     live_m = live[merged_order]
@@ -241,19 +289,37 @@ def _compose_kernel(a_cols, b_cols, n_a, n_b, na: int, nb: int):
     ])
 
 
-def compose_oplogs_device(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List[Conflict]]:
-    """Device-composed twin of :func:`core.compose.compose_oplogs`."""
-    if not delta_a and not delta_b:
-        return [], []
+def encode_compose_inputs(delta_a: List[Op], delta_b: List[Op],
+                          shard_multiple: int = 1):
+    """Host-side encoding shared by the single-device and mesh compose
+    paths: intern both logs, pad to buckets divisible by
+    ``shard_multiple`` (the mesh ``dp`` size) so the sharded kernel's
+    row axis splits evenly across any device count."""
     interner = Interner()
     ts_table, id_table = build_rank_tables(delta_a, delta_b)
     ta = encode_oplog(delta_a, interner, ts_table, id_table)
     tb = encode_oplog(delta_b, interner, ts_table, id_table)
-    na = bucket_size(max(ta.n, 1))
-    nb = bucket_size(max(tb.n, 1))
+    na = shard_bucket(ta.n, shard_multiple)
+    nb = shard_bucket(tb.n, shard_multiple)
+    return interner, ta, tb, na, nb
+
+
+def compose_oplogs_device(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List[Conflict]]:
+    """Device-composed twin of :func:`core.compose.compose_oplogs`."""
+    if not delta_a and not delta_b:
+        return [], []
+    interner, ta, tb, na, nb = encode_compose_inputs(delta_a, delta_b)
     out = np.asarray(_compose_kernel(
         _pad_op_tensor(ta, na), _pad_op_tensor(tb, nb),
         np.int32(ta.n), np.int32(tb.n), na, nb))
+    return decode_compose_output(out, delta_a, delta_b, interner, na, nb)
+
+
+def decode_compose_output(out: np.ndarray, delta_a: List[Op], delta_b: List[Op],
+                          interner: Interner, na: int, nb: int
+                          ) -> Tuple[List[Op], List[Conflict]]:
+    """Decode the kernel's stacked int32 result matrix back to op/conflict
+    lists (shared by the single-device and mesh compose paths)."""
     (out_side, out_row, chain_addr, chain_file, chain_name,
      n_out_row, conf_a, conf_b, n_conf_row, a_op_index, b_op_index) = out
     n_out, n_conf = n_out_row[0], n_conf_row[0]

@@ -1,0 +1,378 @@
+"""Mesh-sharded merge kernels — the decl/op axis distributed over ``dp``.
+
+This is the scale path of the north star (BASELINE.json: "op-log
+sorting, chaining, CRDT reconciliation run as data-parallel segmented
+scans across thousands of files … sharded symbol-ID join … across a
+v4-8"). The single-device kernels (:mod:`semantic_merge_tpu.ops.diff`,
+:mod:`semantic_merge_tpu.ops.compose`) stay the fast path for one chip;
+these twins run the same logic under :func:`jax.shard_map` over the
+``dp`` axis of the framework mesh
+(:mod:`semantic_merge_tpu.parallel.mesh`), with XLA collectives riding
+ICI:
+
+- **Diff sort-join** (reference ``workers/ts/src/diff.ts:5-31`` hash
+  join): decl slots shard contiguously over ``dp``; each shard sorts
+  its slice locally (the distributed sort), then **all-gathers the
+  per-shard sorted symbol tables** — the symbol-table exchange of the
+  north star — and answers its own slots' join queries against all
+  ``k`` runs (first/last occurrence = min/max over shards, presence =
+  any). Emission offsets are global prefix sums (local cumsum + an
+  all-gather of shard totals); each shard scatters its ops into the
+  full output and an elementwise ``pmax`` merges the shards (every
+  position is written by exactly one shard; the fill ``NULL_ID`` is
+  the identity).
+- **Compose** (reference ``semmerge/compose.py:51-112``): op rows
+  shard over ``dp``. The streams' key columns are all-gathered (11
+  int32 columns — megabytes at 10k files, nothing against ICI), the
+  canonical sorts and the sequential conflict cursor walk run
+  replicated, the **DivergentRename candidate join** shards its query
+  axis, and the **segmented chain scans** — the O(n) state propagation
+  that dominates at scale — run as local
+  ``lax.associative_scan`` slices with a carry exchange across shards
+  (rows are sorted by symbol, so exactly one segment spans each shard
+  boundary; the carries combine with the same associative operator).
+
+Bit-parity with the single-device kernels and the host composer is
+property-tested on the virtual 8-device CPU mesh
+(``tests/test_sharded_merge.py``) and executed by the driver through
+``__graft_entry__.dryrun_multichip``.
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.conflict import Conflict
+from ..core.encode import NULL_ID, PAD_ID, DeclTensor, shard_bucket
+from ..core.ops import Op
+from .compose import (_conflict_cursor_walk, _merge_and_scan, _pad_op_tensor,
+                      _rename_candidate_query, _rename_candidate_tables,
+                      _rename_pairs, _seg_combine, _sort_stream,
+                      decode_compose_output, encode_compose_inputs)
+from .diff import (KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME,
+                   DiffOpsTensor, _decode_stacked, _padded_cols)
+
+AXIS = "dp"
+
+_INT_MAX = jnp.int32(2**31 - 1)
+
+
+# --------------------------------------------------------------------------
+# sharded diff sort-join
+# --------------------------------------------------------------------------
+
+def _local_sorted_run(sym):
+    """Stable local sort of this shard's slice by symbol — one run of the
+    distributed sort. Returns (sorted syms, sorted-position → local slot)."""
+    order = jnp.argsort(sym, stable=True).astype(jnp.int32)
+    return sym[order], order
+
+
+def _run_query(tables, orders, offs, S: int, queries):
+    """Join ``queries`` against all ``k`` gathered sorted runs.
+
+    Returns per-query (present-anywhere, global first slot, global last
+    slot). Stable sorting makes each run's boundary elements the
+    smallest/largest local slot index of the symbol, so min/max over
+    shards reconstruct the exact global occurrence bounds the
+    single-device kernel reads off its one sorted array.
+    """
+    lo = jax.vmap(lambda t: jnp.searchsorted(t, queries, side="left"))(tables)
+    hi = jax.vmap(lambda t: jnp.searchsorted(t, queries, side="right"))(tables) - 1
+    lo_c = jnp.clip(lo, 0, S - 1)
+    hi_c = jnp.clip(hi, 0, S - 1)
+    present = jnp.take_along_axis(tables, lo_c, axis=1) == queries[None, :]
+    first = jnp.take_along_axis(orders, lo_c, axis=1) + offs[:, None]
+    last = jnp.take_along_axis(orders, hi_c, axis=1) + offs[:, None]
+    g_first = jnp.min(jnp.where(present, first, _INT_MAX), axis=0)
+    g_last = jnp.max(jnp.where(present, last, jnp.int32(-1)), axis=0)
+    return jnp.any(present, axis=0), g_first, g_last
+
+
+def _sharded_diff_core(b_sym, b_addr, b_name, b_file,
+                       s_sym, s_addr, s_name, s_file,
+                       nb: int, ns: int, k: int):
+    """Per-shard body: local blocks of the base/side decl columns in,
+    full (replicated) stacked op-stream matrix out."""
+    j = lax.axis_index(AXIS)
+    Sb, Ss = nb // k, ns // k
+    my_b_idx = j * Sb + jnp.arange(Sb, dtype=jnp.int32)  # global base slots
+    b_valid = b_sym != PAD_ID
+    s_valid = s_sym != PAD_ID
+
+    # Distributed sort: local runs, then the symbol-table all-gather.
+    b_srt_l, b_ord_l = _local_sorted_run(b_sym)
+    s_srt_l, s_ord_l = _local_sorted_run(s_sym)
+    b_tab = lax.all_gather(b_srt_l, AXIS)          # (k, Sb)
+    b_tord = lax.all_gather(b_ord_l, AXIS)
+    s_tab = lax.all_gather(s_srt_l, AXIS)
+    s_tord = lax.all_gather(s_ord_l, AXIS)
+    off_b = jnp.arange(k, dtype=jnp.int32) * Sb
+    off_s = jnp.arange(k, dtype=jnp.int32) * Ss
+    # Raw columns, gathered for the cross-shard data lookups (the node
+    # payload behind a matched symbol lives on whichever shard owns it).
+    b_addr_g = lax.all_gather(b_addr, AXIS, tiled=True)
+    b_name_g = lax.all_gather(b_name, AXIS, tiled=True)
+    b_file_g = lax.all_gather(b_file, AXIS, tiled=True)
+    s_addr_g = lax.all_gather(s_addr, AXIS, tiled=True)
+    s_name_g = lax.all_gather(s_name, AXIS, tiled=True)
+    s_file_g = lax.all_gather(s_file, AXIS, tiled=True)
+
+    # Occurrence bounds of my base slots' symbols (JS Map semantics:
+    # first occurrence emits, last occurrence's data wins).
+    _, bg_first, bg_last = _run_query(b_tab, b_tord, off_b, Sb, b_sym)
+    emits = b_valid & (bg_first == my_b_idx)
+    bl = jnp.clip(bg_last, 0, nb - 1)
+    b_addr_l = b_addr_g[bl]
+    b_name_l = b_name_g[bl]
+    b_file_l = b_file_g[bl]
+
+    # Side representative (Map last-wins) for my base symbols.
+    s_found, _, sg_last = _run_query(s_tab, s_tord, off_s, Ss, b_sym)
+    found = s_found & b_valid
+    sr = jnp.clip(sg_last, 0, ns - 1)
+    s_addr_r = s_addr_g[sr]
+    s_name_r = s_name_g[sr]
+    s_file_r = s_file_g[sr]
+
+    is_delete = emits & ~found
+    is_move = emits & found & (b_addr_l != s_addr_r)
+    is_rename = (emits & found & (b_name_l != NULL_ID) & (s_name_r != NULL_ID)
+                 & (b_name_l != s_name_r))
+
+    # Adds: my side slots whose symbol is absent from the whole base.
+    in_base, _, _ = _run_query(b_tab, b_tord, off_b, Sb, s_sym)
+    is_add = s_valid & ~in_base
+
+    # Global emission offsets: local cumsum + prefix of shard totals.
+    def global_offsets(count, prior_total):
+        """(global emission position per slot, running global total)."""
+        cum = jnp.cumsum(count)
+        totals = lax.all_gather(cum[-1], AXIS)  # (k,)
+        prev = jnp.sum(jnp.where(jnp.arange(k) < j, totals, 0))
+        return prior_total + prev + cum - count, prior_total + jnp.sum(totals)
+
+    base_count = jnp.where(is_delete, 1,
+                           is_move.astype(jnp.int32) + is_rename.astype(jnp.int32))
+    base_off, total_base = global_offsets(base_count, 0)
+    add_off, total_all = global_offsets(is_add.astype(jnp.int32), total_base)
+    n_ops = total_all
+
+    m = 2 * nb + ns
+    neg = jnp.int32(NULL_ID)
+
+    def init():
+        return jnp.full((m,), neg, dtype=jnp.int32)
+
+    cols = [init() for _ in range(8)]
+
+    def scatter(cols, posn, mask, values):
+        posn = jnp.where(mask, posn, m)  # out-of-range rows drop
+        return [arr.at[posn].set(val, mode="drop")
+                for arr, val in zip(cols, values)]
+
+    full_b = lambda v: jnp.full((Sb,), v, jnp.int32)  # noqa: E731
+    full_s = lambda v: jnp.full((Ss,), v, jnp.int32)  # noqa: E731
+
+    cols = scatter(cols, base_off, is_delete,
+                   [full_b(KIND_DELETE), b_sym, b_addr_l, b_name_l, b_file_l,
+                    full_b(NULL_ID), full_b(NULL_ID), full_b(NULL_ID)])
+    cols = scatter(cols, base_off, is_move,
+                   [full_b(KIND_MOVE), b_sym, b_addr_l, b_name_l, b_file_l,
+                    s_addr_r, s_name_r, s_file_r])
+    ren_pos = base_off + is_move.astype(jnp.int32)
+    cols = scatter(cols, ren_pos, is_rename,
+                   [full_b(KIND_RENAME), b_sym, b_addr_l, b_name_l, b_file_l,
+                    s_addr_r, s_name_r, s_file_r])
+    cols = scatter(cols, add_off, is_add,
+                   [full_s(KIND_ADD), s_sym, full_s(NULL_ID), full_s(NULL_ID),
+                    full_s(NULL_ID), s_addr, s_name, s_file])
+
+    out = jnp.concatenate(
+        [jnp.stack(cols), jnp.full((1, m), n_ops, jnp.int32)], axis=0)
+    # Each emission position was written by exactly one shard (slots are
+    # partitioned); everywhere else holds the fill NULL_ID — elementwise
+    # max across the axis is the exact union.
+    return lax.pmax(out, AXIS)
+
+
+@lru_cache(maxsize=None)
+def _sharded_diff_fn(mesh: Mesh, nb: int, ns: int, k: int):
+    spec = P(AXIS)
+    return jax.jit(jax.shard_map(
+        partial(_sharded_diff_core, nb=nb, ns=ns, k=k),
+        mesh=mesh, in_specs=(spec,) * 8, out_specs=P(),
+        check_vma=False))
+
+
+@lru_cache(maxsize=None)
+def _sharded_diff_pair_fn(mesh: Mesh, nb: int, nl: int, nr: int, k: int):
+    spec = P(AXIS)
+
+    def pair(b_sym, b_addr, b_name, b_file,
+             l_sym, l_addr, l_name, l_file,
+             r_sym, r_addr, r_name, r_file):
+        out_l = _sharded_diff_core(b_sym, b_addr, b_name, b_file,
+                                   l_sym, l_addr, l_name, l_file,
+                                   nb=nb, ns=nl, k=k)
+        out_r = _sharded_diff_core(b_sym, b_addr, b_name, b_file,
+                                   r_sym, r_addr, r_name, r_file,
+                                   nb=nb, ns=nr, k=k)
+        m = max(out_l.shape[1], out_r.shape[1])
+
+        def pad(a):
+            return jnp.pad(a, ((0, 0), (0, m - a.shape[1])),
+                           constant_values=NULL_ID)
+
+        return jnp.stack([pad(out_l), pad(out_r)])
+
+    return jax.jit(jax.shard_map(
+        pair, mesh=mesh, in_specs=(spec,) * 12, out_specs=P(),
+        check_vma=False))
+
+
+def _dp_size(mesh: Mesh) -> int:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))[AXIS]
+
+
+def _bucket(n: int, k: int) -> int:
+    return shard_bucket(n, k)
+
+
+def diff_lift_device_sharded(base: DeclTensor, side: DeclTensor,
+                             mesh: Mesh) -> DiffOpsTensor:
+    """Mesh twin of :func:`semantic_merge_tpu.ops.diff.diff_lift_device`."""
+    k = _dp_size(mesh)
+    nb, ns = _bucket(base.n, k), _bucket(side.n, k)
+    fn = _sharded_diff_fn(mesh, nb, ns, k)
+    out = np.asarray(fn(*_padded_cols(base, nb), *_padded_cols(side, ns)))
+    return _decode_stacked(out)
+
+
+def diff_lift_device_pair_sharded(base: DeclTensor, left: DeclTensor,
+                                  right: DeclTensor, mesh: Mesh
+                                  ) -> tuple[DiffOpsTensor, DiffOpsTensor]:
+    """Mesh twin of :func:`semantic_merge_tpu.ops.diff.diff_lift_device_pair`."""
+    k = _dp_size(mesh)
+    nb = _bucket(base.n, k)
+    nl = _bucket(left.n, k)
+    nr = _bucket(right.n, k)
+    fn = _sharded_diff_pair_fn(mesh, nb, nl, nr, k)
+    out = np.asarray(fn(*_padded_cols(base, nb), *_padded_cols(left, nl),
+                        *_padded_cols(right, nr)))
+    return _decode_stacked(out[0]), _decode_stacked(out[1])
+
+
+# --------------------------------------------------------------------------
+# sharded compose
+# --------------------------------------------------------------------------
+
+def _dist_seg_scan(k: int, seg_sym, seg_order, vals):
+    """Distributed segmented last-valid scan over the ``dp`` axis.
+
+    Rows are in (symbol, merged position) order, so each shard's slice
+    is a contiguous range of at most one boundary-spanning segment per
+    edge. Each shard scans its slice locally; the per-shard carries
+    (last row's symbol/value/validity) are all-gathered and prefix-
+    combined with the same associative operator; the incoming carry is
+    applied elementwise. Bit-identical to the single-device scan —
+    integer ops under an exactly associative combine.
+    """
+    j = lax.axis_index(AXIS)
+    total = seg_sym.shape[0]
+    T = total // k
+    v_sorted = vals[seg_order]
+    m_sorted = v_sorted != NULL_ID
+
+    start = (j * T,)
+    my_sym = lax.dynamic_slice(seg_sym, start, (T,))
+    my_v = lax.dynamic_slice(v_sorted, start, (T,))
+    my_m = lax.dynamic_slice(m_sorted, start, (T,))
+
+    _, sv, sm = lax.associative_scan(_seg_combine, (my_sym, my_v, my_m))
+
+    # Carry exchange: combine shards' summaries in axis order.
+    cs = lax.all_gather(my_sym[-1], AXIS)   # (k,)
+    cv = lax.all_gather(sv[-1], AXIS)
+    cm = lax.all_gather(sm[-1], AXIS)
+    _, cv_s, cm_s = lax.associative_scan(_seg_combine, (cs, cv, cm))
+    prev = jnp.clip(j - 1, 0, k - 1)
+    inc_sym = cs[prev]
+    inc_v = jnp.where(j > 0, cv_s[prev], NULL_ID)
+    inc_m = (j > 0) & cm_s[prev]
+
+    same = my_sym == inc_sym
+    out_v = jnp.where(sm, sv, jnp.where(same & inc_m, inc_v, NULL_ID))
+    out_m = sm | (same & inc_m)
+
+    sv_full = lax.all_gather(out_v, AXIS, tiled=True)
+    sm_full = lax.all_gather(out_m, AXIS, tiled=True)
+    out = jnp.full_like(vals, NULL_ID)
+    return out.at[seg_order].set(jnp.where(sm_full, sv_full, NULL_ID))
+
+
+def _sharded_compose_core(a_loc, b_loc, n_a, n_b, na: int, nb: int, k: int):
+    """Per-shard body: local row-blocks of both encoded op streams in,
+    full (replicated) compose result matrix out."""
+    j = lax.axis_index(AXIS)
+    # Op-table exchange: gather both streams' key columns (11 × int32).
+    a_full = {name: lax.all_gather(v, AXIS, tiled=True)
+              for name, v in a_loc.items()}
+    b_full = {name: lax.all_gather(v, AXIS, tiled=True)
+              for name, v in b_loc.items()}
+
+    a = _sort_stream(a_full)
+    b = _sort_stream(b_full)
+
+    # Sharded DivergentRename candidate join: A's rename table is
+    # replicated (gathered), B's query axis shards over ``dp``.
+    tables = _rename_candidate_tables(a, n_a, na)
+    b_rsym, b_rname = _rename_pairs(b, n_b, nb)
+    Tb = nb // k
+    my_rsym = lax.dynamic_slice(b_rsym, (j * Tb,), (Tb,))
+    my_rname = lax.dynamic_slice(b_rname, (j * Tb,), (Tb,))
+    differing = _rename_candidate_query(tables, na, my_rsym, my_rname)
+    has_candidates = lax.pmax(jnp.any(differing).astype(jnp.int32), AXIS) > 0
+
+    # Sequential cursor walk: replicated (identical on every shard).
+    drop_a, drop_b, conf_a, conf_b, n_conf = _conflict_cursor_walk(
+        a, b, n_a, n_b, na, nb, has_candidates)
+
+    return _merge_and_scan(a, b, n_a, n_b, na, nb,
+                           drop_a, drop_b, conf_a, conf_b, n_conf,
+                           seg_scan_impl=partial(_dist_seg_scan, k))
+
+
+@lru_cache(maxsize=None)
+def _sharded_compose_fn(mesh: Mesh, na: int, nb: int, k: int):
+    spec = P(AXIS)
+    col_specs = {name: spec for name in
+                 ("prec", "ts_rank", "id_rank", "is_rename", "is_move", "sym",
+                  "new_name", "chain_name", "new_addr", "chain_file",
+                  "op_index")}
+    return jax.jit(jax.shard_map(
+        partial(_sharded_compose_core, na=na, nb=nb, k=k),
+        mesh=mesh, in_specs=(col_specs, col_specs, P(), P()),
+        out_specs=P(), check_vma=False))
+
+
+def compose_oplogs_device_sharded(delta_a: List[Op], delta_b: List[Op],
+                                  mesh: Mesh
+                                  ) -> Tuple[List[Op], List[Conflict]]:
+    """Mesh twin of
+    :func:`semantic_merge_tpu.ops.compose.compose_oplogs_device`."""
+    if not delta_a and not delta_b:
+        return [], []
+    k = _dp_size(mesh)
+    interner, ta, tb, na, nb = encode_compose_inputs(
+        delta_a, delta_b, shard_multiple=k)
+    fn = _sharded_compose_fn(mesh, na, nb, k)
+    out = np.asarray(fn(_pad_op_tensor(ta, na), _pad_op_tensor(tb, nb),
+                        np.int32(ta.n), np.int32(tb.n)))
+    return decode_compose_output(out, delta_a, delta_b, interner, na, nb)

@@ -76,6 +76,27 @@ def _factor(n: int, weights: Sequence[int]) -> list[int]:
     return sizes
 
 
+def parse_mesh_shape(spec: str) -> Dict[str, int]:
+    """Parse a ``.semmerge.toml`` ``[engine] mesh_shape`` value like
+    ``"dp=4,tp=2"`` into :func:`build_mesh` axis kwargs. ``"auto"`` (or
+    empty) returns ``{}`` — let :func:`build_mesh` infer."""
+    spec = (spec or "").strip()
+    if not spec or spec == "auto":
+        return {}
+    sizes: Dict[str, int] = {}
+    for part in spec.split(","):
+        name, _, value = part.partition("=")
+        name = name.strip()
+        if name not in MESH_AXES:
+            raise ValueError(
+                f"mesh_shape axis {name!r} not one of {MESH_AXES}")
+        try:
+            sizes[name] = int(value)
+        except ValueError as exc:
+            raise ValueError(f"mesh_shape {part!r}: size must be an int") from exc
+    return sizes
+
+
 def build_mesh(devices: Sequence[jax.Device] | None = None,
                *, dp: int | None = None, pp: int | None = None,
                sp: int | None = None, tp: int | None = None,

@@ -1,0 +1,104 @@
+"""JAX environment hardening shared by every process entry point.
+
+The deployment image registers an accelerator *relay* plugin (``axon``)
+via a sitecustomize hook: importing anything that touches jax makes
+backend discovery dial a TPU tunnel that may be absent, slow, or down.
+Round 1 lost its entire scoreboard to this — ``bench.py`` crashed on
+``jax.devices()`` (UNAVAILABLE) and ``dryrun_multichip`` hung >560 s in
+backend discovery — while the test suite survived because
+``tests/conftest.py`` carried the fix. This module is that fix, made
+reusable: call :func:`force_cpu` before any jax device work to guarantee
+host-CPU execution, or :func:`accelerator_available` to probe the real
+chip safely (in a throwaway subprocess, so a hang cannot take down the
+caller).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Pin this process to the host-CPU XLA backend, no matter what
+    plugins a sitecustomize hook registered.
+
+    Safe to call whether or not jax is already imported (a hook importing
+    the plugin pulls jax in before user code runs, so env vars alone are
+    read too late — the live config is updated too). Must run before the
+    first backend *initialisation* (`jax.devices()` etc.).
+
+    ``n_devices`` requests a virtual CPU device count (for mesh tests /
+    multi-chip dry runs on one host).
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    # chex (via optax) imports jax.experimental.checkify, whose
+    # import-time MLIR lowering registration inspects the live platform
+    # registry — import it BEFORE the factory surgery or it raises on a
+    # half-removed plugin platform. Same for pallas, which registers a
+    # 'tpu' lowering at import time (the kernels run in interpret mode
+    # on CPU). Failures must not skip the surgery.
+    try:
+        import optax  # noqa: F401
+    except Exception:
+        pass
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        import jax.experimental.pallas.tpu  # noqa: F401
+    except Exception:
+        pass
+
+    import jax._src.xla_bridge as _xb
+
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices is not None:
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except Exception:
+            pass  # backend already initialised; XLA_FLAGS took care of it
+    # Drop every non-CPU backend factory so discovery can never dial the
+    # accelerator relay.
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu", "interpreter"):
+            _xb._backend_factories.pop(_name, None)
+
+
+def accelerator_available(timeout: float = 120.0, retries: int = 1) -> str | None:
+    """Probe whether a real accelerator backend initialises, without
+    risking this process.
+
+    Runs ``jax.devices()`` in a subprocess with a hard timeout (backend
+    discovery through a relay plugin can hang indefinitely — a signal
+    alarm does not interrupt the blocked C++ call, a subprocess kill
+    does). Returns the platform string (e.g. ``"tpu"``) on success, or
+    ``None`` if every attempt fails or times out.
+    """
+    code = (
+        "import jax; ds = jax.devices(); "
+        "print('PLATFORM=' + ds[0].platform)"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    for _ in range(retries + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=timeout, env=env)
+        except subprocess.TimeoutExpired:
+            continue
+        if proc.returncode == 0:
+            for line in proc.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    plat = line.split("=", 1)[1].strip()
+                    if plat and plat != "cpu":
+                        return plat
+            return None  # initialised but CPU-only: no accelerator
+    return None

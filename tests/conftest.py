@@ -2,52 +2,23 @@
 
 Tests run on a virtual 8-device CPU mesh so that every sharding and
 collective path compiles and executes without TPU hardware; the bench
-harness runs the same code on the real chip. The env vars must be set
-before the first ``import jax`` anywhere in the process.
+harness runs the same code on the real chip. The platform pinning +
+relay-plugin factory surgery lives in
+``semantic_merge_tpu.utils.jaxenv.force_cpu`` (shared with the driver
+entry points ``__graft_entry__.dryrun_multichip`` and ``bench.py``) and
+must run before the first jax backend initialisation.
 """
 import os
 import sys
 import pathlib
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-os.environ["JAX_PLATFORMS"] = "cpu"
 # Persistent XLA compilation cache: device-kernel tests compile a handful
 # of padded shapes; caching makes repeat suite runs take seconds.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/semmerge_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
-# If a TPU plugin (e.g. an 'axon' loopback relay) was registered by a
-# sitecustomize hook, drop its factory so CPU-only tests never dial the
-# accelerator — backend discovery would otherwise block on the relay.
-try:
-    import jax
-    # chex (via optax) imports jax.experimental.checkify, whose import-time
-    # MLIR lowering registration inspects the live platform registry —
-    # import it BEFORE the factory surgery below or it raises on the
-    # half-removed 'tpu' plugin platform. Failure must not skip the
-    # surgery: without it CPU-only tests dial the accelerator relay.
-    try:
-        import optax  # noqa: F401
-    except ImportError:
-        pass
-    # Pallas registers a 'tpu' MLIR lowering at import time and raises
-    # once the platform registry has been stripped — import it first too
-    # (the kernels themselves run in interpret mode on CPU).
-    try:
-        import jax.experimental.pallas  # noqa: F401
-        import jax.experimental.pallas.tpu  # noqa: F401
-    except Exception:
-        pass
-    import jax._src.xla_bridge as _xb
-
-    # jax may already be imported (a sitecustomize hook importing the
-    # plugin pulls jax in before conftest runs), so the env vars above
-    # were read too late — update the live config as well.
-    jax.config.update("jax_platforms", "cpu")
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name not in ("cpu", "interpreter"):
-            _xb._backend_factories.pop(_name, None)
-except Exception:
-    pass
-
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from semantic_merge_tpu.utils.jaxenv import force_cpu  # noqa: E402
+
+force_cpu(8)

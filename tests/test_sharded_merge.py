@@ -1,0 +1,247 @@
+"""Mesh-sharded merge kernels: bit-parity vs single-device vs host.
+
+The VERDICT round-1 gap: the merge pipeline itself never touched the
+mesh. These tests run the ``dp``-sharded diff sort-join and compose
+(:mod:`semantic_merge_tpu.ops.sharded`) on the virtual 8-device CPU
+mesh and assert exact agreement with the single-device kernels and the
+pure-Python host oracle on fuzzed ~1k-decl/op streams — the sharded
+DivergentRename join and symbol-table all-gather of the BASELINE north
+star.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from semantic_merge_tpu.backends.ts_host import HostTSBackend
+from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+from semantic_merge_tpu.core.compose import compose_oplogs
+from semantic_merge_tpu.core.encode import DeclTensor
+from semantic_merge_tpu.core.ops import Op, Target
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+from semantic_merge_tpu.ops.compose import compose_oplogs_device
+from semantic_merge_tpu.ops.diff import diff_lift_device, diff_lift_device_pair
+from semantic_merge_tpu.ops.sharded import (compose_oplogs_device_sharded,
+                                            diff_lift_device_pair_sharded,
+                                            diff_lift_device_sharded)
+from semantic_merge_tpu.parallel.mesh import build_mesh, parse_mesh_shape
+
+DIFF_FIELDS = ("kind", "sym", "a_addr", "a_name", "a_file",
+               "b_addr", "b_name", "b_file")
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(jax.devices(), dp=8, pp=1, sp=1, tp=1, ep=1).mesh
+
+
+def rand_decls(rng: np.random.Generator, n: int, n_syms: int) -> DeclTensor:
+    sym = rng.integers(0, n_syms, n).astype(np.int32)
+    addr = rng.integers(100, 100 + 3 * max(n, 1), n).astype(np.int32)
+    name = rng.integers(0, max(n_syms // 2, 2), n).astype(np.int32)
+    name[rng.random(n) < 0.1] = -1  # anonymous decls (VariableStatement)
+    file = rng.integers(500, 530, n).astype(np.int32)
+    return DeclTensor(sym=sym, addr=addr, name=name, file=file, n=n)
+
+
+def assert_diff_equal(a, b):
+    assert a.n_ops == b.n_ops
+    for f in DIFF_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(a, f)[: a.n_ops], getattr(b, f)[: a.n_ops], err_msg=f)
+
+
+class TestShardedDiff:
+    def test_fuzz_1k_decls(self, mesh):
+        rng = np.random.default_rng(42)
+        for trial in range(6):
+            nb = int(rng.integers(1, 1100))
+            ns = int(rng.integers(1, 1100))
+            base = rand_decls(rng, nb, n_syms=max(nb // 2, 4))
+            side = rand_decls(rng, ns, n_syms=max(nb // 2, 4))
+            single = diff_lift_device(base, side)
+            sharded = diff_lift_device_sharded(base, side, mesh)
+            assert_diff_equal(single, sharded)
+
+    def test_duplicate_symbols_collide_last_wins(self, mesh):
+        # Heavy symbol collisions: first-occurrence emission with
+        # last-occurrence data must survive the shard boundaries.
+        rng = np.random.default_rng(7)
+        base = rand_decls(rng, 700, n_syms=5)
+        side = rand_decls(rng, 650, n_syms=5)
+        assert_diff_equal(diff_lift_device(base, side),
+                          diff_lift_device_sharded(base, side, mesh))
+
+    def test_pair_kernel(self, mesh):
+        rng = np.random.default_rng(3)
+        base = rand_decls(rng, 900, n_syms=400)
+        left = rand_decls(rng, 930, n_syms=400)
+        right = rand_decls(rng, 880, n_syms=400)
+        sl, sr = diff_lift_device_pair(base, left, right)
+        hl, hr = diff_lift_device_pair_sharded(base, left, right, mesh)
+        assert_diff_equal(sl, hl)
+        assert_diff_equal(sr, hr)
+
+    def test_empty_and_tiny(self, mesh):
+        rng = np.random.default_rng(5)
+        empty = DeclTensor.empty()
+        one = rand_decls(rng, 1, n_syms=1)
+        for b, s in [(empty, one), (one, empty), (empty, empty), (one, one)]:
+            assert_diff_equal(diff_lift_device(b, s),
+                              diff_lift_device_sharded(b, s, mesh))
+
+
+def mk(op_type, sym, params=None, ts="2024-01-01T00:00:00Z", op_id=None,
+       addr=None):
+    return Op.new(op_type, Target(symbolId=sym, addressId=addr),
+                  params=params or {}, provenance={"timestamp": ts},
+                  op_id=op_id)
+
+
+def rand_ops(rng: random.Random, n: int, side: str, n_syms: int = 40):
+    types = ["renameSymbol", "moveDecl", "addDecl", "deleteDecl",
+             "editStmtBlock", "modifyImport"]
+    out = []
+    for i in range(n):
+        t = rng.choice(types)
+        params = {}
+        if t == "renameSymbol":
+            params = {"oldName": "o", "newName": rng.choice(["p", "q", "r"]),
+                      "file": f"f{rng.randint(0, 3)}.ts"}
+        elif t == "moveDecl":
+            if rng.random() < 0.8:
+                params["newAddress"] = f"addr-{rng.randint(0, 9)}"
+            if rng.random() < 0.5:
+                params["newFile"] = f"g{rng.randint(0, 3)}.ts"
+            elif rng.random() < 0.5:
+                params["file"] = f"h{rng.randint(0, 3)}.ts"
+        ts = rng.choice(["2024-01-01T00:00:00Z", "2024-06-01T00:00:00Z"])
+        out.append(mk(t, f"sym-{rng.randint(0, n_syms)}", params, ts=ts,
+                      op_id=f"{side}{i:04d}" + "0" * 27, addr=f"ba-{i}"))
+    return out
+
+
+def dicts(ops):
+    return [o.to_dict() for o in ops]
+
+
+class TestShardedCompose:
+    def test_fuzz_1k_ops_three_way(self, mesh):
+        rng = random.Random(11)
+        for trial in range(5):
+            A = rand_ops(rng, rng.randint(0, 1000), "a")
+            B = rand_ops(rng, rng.randint(0, 1000), "b")
+            h_ops, h_conf = compose_oplogs(A, B)
+            d_ops, d_conf = compose_oplogs_device(A, B)
+            s_ops, s_conf = compose_oplogs_device_sharded(A, B, mesh)
+            assert dicts(h_ops) == dicts(d_ops) == dicts(s_ops), f"trial {trial}"
+            assert ([c.to_dict() for c in h_conf]
+                    == [c.to_dict() for c in d_conf]
+                    == [c.to_dict() for c in s_conf]), f"trial {trial}"
+
+    def test_divergent_rename_across_shards(self, mesh):
+        # Conflicting renames far apart in the stream: the sharded
+        # candidate join must still surface them to the cursor walk.
+        ra = mk("renameSymbol", "s", {"newName": "x"}, op_id="1" * 32)
+        rb = mk("renameSymbol", "s", {"newName": "y"}, op_id="2" * 32)
+        filler_a = rand_ops(random.Random(1), 500, "a", n_syms=500)
+        filler_b = rand_ops(random.Random(2), 500, "b", n_syms=500)
+        A = [ra] + filler_a
+        B = [rb] + filler_b
+        h_ops, h_conf = compose_oplogs(A, B)
+        s_ops, s_conf = compose_oplogs_device_sharded(A, B, mesh)
+        assert dicts(h_ops) == dicts(s_ops)
+        assert [c.to_dict() for c in h_conf] == [c.to_dict() for c in s_conf]
+
+    def test_chain_spans_shard_boundary(self, mesh):
+        # One symbol's move chain feeding ops that land on later shards.
+        ops_a = [mk("moveDecl", "sym-x",
+                    {"newAddress": f"A{i}", "newFile": f"m{i}.ts"},
+                    ts=f"2024-01-0{i + 1}T00:00:00Z",
+                    op_id=f"a{i:03d}" + "0" * 28, addr="ba")
+                 for i in range(4)]
+        ops_b = [mk("editStmtBlock", "sym-x", {},
+                    ts="2024-06-01T00:00:00Z",
+                    op_id=f"b{i:03d}" + "0" * 28, addr="ba")
+                 for i in range(600)]
+        h_ops, h_conf = compose_oplogs(ops_a, ops_b)
+        s_ops, s_conf = compose_oplogs_device_sharded(ops_a, ops_b, mesh)
+        assert dicts(h_ops) == dicts(s_ops)
+        assert not h_conf and not s_conf
+
+    def test_empty(self, mesh):
+        assert compose_oplogs_device_sharded([], [], mesh) == ([], [])
+
+
+class TestNonPowerOfTwoMesh:
+    """A dp size that is not a power of two (e.g. a 6-device slice) must
+    still split the padded buckets evenly (core.encode.shard_bucket)."""
+
+    @pytest.fixture(scope="class")
+    def mesh6(self):
+        return build_mesh(jax.devices()[:6], dp=6, pp=1, sp=1, tp=1,
+                          ep=1).mesh
+
+    def test_diff_parity_dp6(self, mesh6):
+        rng = np.random.default_rng(17)
+        base = rand_decls(rng, 333, n_syms=100)
+        side = rand_decls(rng, 200, n_syms=100)
+        assert_diff_equal(diff_lift_device(base, side),
+                          diff_lift_device_sharded(base, side, mesh6))
+
+    def test_compose_parity_dp6(self, mesh6):
+        rng = random.Random(23)
+        A = rand_ops(rng, 250, "a")
+        B = rand_ops(rng, 190, "b")
+        h_ops, h_conf = compose_oplogs(A, B)
+        s_ops, s_conf = compose_oplogs_device_sharded(A, B, mesh6)
+        assert dicts(h_ops) == dicts(s_ops)
+        assert [c.to_dict() for c in h_conf] == [c.to_dict() for c in s_conf]
+
+
+class TestShardedBackend:
+    def test_auto_mesh_on_multichip(self):
+        backend = TpuTSBackend()
+        assert backend._mesh is not None, (
+            "8 visible devices must auto-shard the merge kernels")
+
+    def test_backend_end_to_end_parity(self):
+        host = HostTSBackend()
+        tpu = TpuTSBackend()  # auto dp=8 mesh on the virtual CPU mesh
+        files = {}
+        rng = random.Random(9)
+        for i in range(40):
+            decls = [f"export function fn{i}_{j}(x: number): number "
+                     f"{{ return {j}; }}" for j in range(rng.randint(1, 4))]
+            files[f"src/m{i}.ts"] = "\n".join(decls) + "\n"
+        base = Snapshot(files=[{"path": p, "content": c}
+                               for p, c in files.items()])
+        left_files = dict(files)
+        left_files["src/m0.ts"] = files["src/m0.ts"].replace("fn0_0", "renamed0")
+        right_files = dict(files)
+        right_files["lib/m1.ts"] = right_files.pop("src/m1.ts")
+        left = Snapshot(files=[{"path": p, "content": c}
+                               for p, c in left_files.items()])
+        right = Snapshot(files=[{"path": p, "content": c}
+                                for p, c in right_files.items()])
+        h = host.build_and_diff(base, left, right, base_rev="r", seed="s",
+                                timestamp="T")
+        t = tpu.build_and_diff(base, left, right, base_rev="r", seed="s",
+                               timestamp="T")
+        assert dicts(h.op_log_left) == dicts(t.op_log_left)
+        assert dicts(h.op_log_right) == dicts(t.op_log_right)
+        hc, hf = host.compose(h.op_log_left, h.op_log_right)
+        tc, tf = tpu.compose(t.op_log_left, t.op_log_right)
+        assert dicts(hc) == dicts(tc)
+        assert [c.to_dict() for c in hf] == [c.to_dict() for c in tf]
+
+    def test_parse_mesh_shape(self):
+        assert parse_mesh_shape("auto") == {}
+        assert parse_mesh_shape("") == {}
+        assert parse_mesh_shape("dp=4,tp=2") == {"dp": 4, "tp": 2}
+        with pytest.raises(ValueError):
+            parse_mesh_shape("bogus=2")
+        with pytest.raises(ValueError):
+            parse_mesh_shape("dp=x")

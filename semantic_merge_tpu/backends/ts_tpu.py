@@ -20,10 +20,10 @@ from typing import Dict, List
 
 from ..core.difflift import (Diff, lift, refine_signature_changes,
                              source_maps)
-from ..core.encode import NULL_ID, Interner, encode_decls
+from ..core.encode import Interner, encode_decls_keyed
 from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
-from ..frontend.scanner import DeclNode, scan_snapshot
+from ..frontend.scanner import DeclNode, scan_snapshot_keyed
 from ..frontend.snapshot import Snapshot
 from ..frontend.snapshot import TS_EXTENSIONS
 from .ts_host import ts_files
@@ -52,6 +52,19 @@ class TpuTSBackend:
             mesh = build_mesh(devices, dp=len(devices),
                               pp=1, sp=1, tp=1, ep=1).mesh
         self._mesh = mesh
+        # Persistent across merges: encoded ids are stable for the
+        # interner's lifetime, so per-file encoded columns cache in the
+        # shared decl cache (keyed by scan identity + interner token).
+        self._interner = Interner()
+
+    def _scan_encode(self, snapshot: Snapshot):
+        if len(self._interner) > 4_000_000:
+            # Unbounded growth guard for long-lived processes; the new
+            # token invalidates every cached column naturally.
+            self._interner = Interner()
+        from ..frontend.declcache import global_cache
+        keyed = scan_snapshot_keyed(ts_files(snapshot))
+        return encode_decls_keyed(keyed, self._interner, global_cache())
 
     def configure(self, config) -> None:
         """Apply ``.semmerge.toml`` settings (called by the CLI): an
@@ -87,16 +100,12 @@ class TpuTSBackend:
                        change_signature: bool = False,
                        structured_apply: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
-        base_nodes = scan_snapshot(ts_files(base))
-        left_nodes = scan_snapshot(ts_files(left))
-        right_nodes = scan_snapshot(ts_files(right))
-        interner = Interner()
-        base_t = encode_decls(base_nodes, interner)
-        left_t = encode_decls(left_nodes, interner)
-        right_t = encode_decls(right_nodes, interner)
+        base_t, base_nodes = self._scan_encode(base)
+        left_t, left_nodes = self._scan_encode(left)
+        right_t, right_nodes = self._scan_encode(right)
         t_l, t_r = self._diff_pair_fn()(base_t, left_t, right_t)
-        diffs_l = decode_diffs(t_l, interner, base_nodes, left_nodes)
-        diffs_r = decode_diffs(t_r, interner, base_nodes, right_nodes)
+        diffs_l = decode_diffs(t_l, base_t, left_t, base_nodes, left_nodes)
+        diffs_r = decode_diffs(t_r, base_t, right_t, base_nodes, right_nodes)
         if change_signature:
             diffs_l = refine_signature_changes(diffs_l)
             diffs_r = refine_signature_changes(diffs_r)
@@ -120,13 +129,10 @@ class TpuTSBackend:
              change_signature: bool = False,
              structured_apply: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
-        base_nodes = scan_snapshot(ts_files(base))
-        right_nodes = scan_snapshot(ts_files(right))
-        interner = Interner()
-        base_t = encode_decls(base_nodes, interner)
-        right_t = encode_decls(right_nodes, interner)
+        base_t, base_nodes = self._scan_encode(base)
+        right_t, right_nodes = self._scan_encode(right)
         t = self._diff_fn()(base_t, right_t)
-        diffs = decode_diffs(t, interner, base_nodes, right_nodes)
+        diffs = decode_diffs(t, base_t, right_t, base_nodes, right_nodes)
         if change_signature:
             diffs = refine_signature_changes(diffs)
         sources = source_maps(ts_files(base), ts_files(right)) if structured_apply else None
@@ -144,7 +150,8 @@ class TpuTSBackend:
         pass
 
 
-def decode_diffs(t: DiffOpsTensor, interner: Interner,
+def decode_diffs(t: DiffOpsTensor,
+                 base_t, side_t,
                  base_nodes: List[DeclNode],
                  side_nodes: List[DeclNode]) -> List[Diff]:
     """Device op stream → the host backend's ``Diff`` records.
@@ -155,22 +162,25 @@ def decode_diffs(t: DiffOpsTensor, interner: Interner,
     unique per node within a snapshot (reference
     ``workers/ts/src/sast.ts:65-67``); under Map last-wins collisions
     the device join already selected the surviving occurrence's address.
-    """
-    base_by_addr: Dict[str, DeclNode] = {n.addressId: n for n in base_nodes}
-    side_by_addr: Dict[str, DeclNode] = {n.addressId: n for n in side_nodes}
 
-    def s(idx: int) -> str | None:
-        return interner.lookup(int(idx)) if idx != NULL_ID else None
+    The lookup is columnar: the encoded ``DeclTensor`` rows align with
+    the node lists, so int-keyed maps resolve interned ids to nodes
+    directly — no per-row string round-trip through the interner (the
+    round-1 per-op Python loop this replaces was slower than the pure
+    host path at the 1k-file rung).
+    """
+    base_by_id: Dict[int, DeclNode] = dict(
+        zip(base_t.addr.tolist(), base_nodes))
+    side_by_id: Dict[int, DeclNode] = dict(
+        zip(side_t.addr.tolist(), side_nodes))
 
     kinds = {KIND_RENAME: "rename", KIND_MOVE: "move",
              KIND_ADD: "add", KIND_DELETE: "delete"}
-    diffs: List[Diff] = []
-    for i in range(t.n_ops):
-        kind = kinds[int(t.kind[i])]
-        a = base_by_addr.get(s(t.a_addr[i]) or "")
-        b = side_by_addr.get(s(t.b_addr[i]) or "")
-        diffs.append(Diff(kind, a=a, b=b))
-    return diffs
+    n = t.n_ops
+    bget, sget = base_by_id.get, side_by_id.get
+    return [Diff(kinds[k], a=bget(a), b=sget(b))
+            for k, a, b in zip(t.kind[:n].tolist(), t.a_addr[:n].tolist(),
+                               t.b_addr[:n].tolist())]
 
 
 register_backend("tpu", TpuTSBackend)

@@ -36,7 +36,6 @@ implementation (:mod:`semantic_merge_tpu.ops.compose`) must match:
 """
 from __future__ import annotations
 
-import copy
 from typing import Dict, List, Tuple
 
 from .conflict import Conflict, divergent_rename_conflict
@@ -102,17 +101,15 @@ def compose_oplogs(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List
 
 def _materialize(op: Op, rename_chain: Dict[str, str],
                  move_chain: Dict[str, Dict[str, str]]) -> Op:
-    cloned = Op(
-        id=op.id,
-        schemaVersion=op.schemaVersion,
-        type=op.type,
-        target=Target(symbolId=op.target.symbolId, addressId=op.target.addressId),
-        params=copy.deepcopy(op.params),
-        guards=copy.deepcopy(op.guards),
-        effects=copy.deepcopy(op.effects),
-        provenance=copy.deepcopy(op.provenance),
-    )
-    sym = cloned.target.symbolId
+    sym = op.target.symbolId
+    if move_chain.get(sym) is None and (
+            sym not in rename_chain or op.type == "renameSymbol"):
+        # No chain rewrite applies: the composed stream reuses the input
+        # op unchanged. Composed ops are treated as immutable downstream
+        # (JSON-observable output is identical to cloning, which the
+        # reference does unconditionally — semmerge/compose.py:117-127).
+        return op
+    cloned = op.clone()
     moved = move_chain.get(sym)
     if moved is not None:
         new_addr = moved.get("newAddress")

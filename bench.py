@@ -94,11 +94,10 @@ def synth_repo(n_files: int, decls_per_file: int, divergent: bool = False):
     return Snapshot(files=base), Snapshot(files=left), Snapshot(files=right)
 
 
-def run_merge(backend, base, left, right):
-    result = backend.build_and_diff(base, left, right, base_rev="bench",
-                                    seed="bench", timestamp="2026-01-01T00:00:00Z")
-    composed, conflicts = backend.compose(result.op_log_left, result.op_log_right)
-    return result, composed, conflicts
+def run_merge(backend, base, left, right, phases=None):
+    from semantic_merge_tpu.backends.base import run_merge as _rm
+    return _rm(backend, base, left, right, base_rev="bench", seed="bench",
+               timestamp="2026-01-01T00:00:00Z", phases=phases)
 
 
 def time_merge(backend, base, left, right, *, repeats: int = 3) -> float:
@@ -108,6 +107,26 @@ def time_merge(backend, base, left, right, *, repeats: int = 3) -> float:
         run_merge(backend, base, left, right)
         best = min(best, time.perf_counter() - t0)
     return best
+
+
+def probe_roundtrip_ms(repeats: int = 5) -> float:
+    """Median dispatch+fetch latency of a trivial device program — the
+    floor any synchronous device interaction pays. Through the remote
+    accelerator tunnel this measured ~65 ms (2026-07-29), which is the
+    number that killed the two-program device path of rounds 2-3 and
+    motivated the one-fetch fused merge program."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    x = jnp.zeros((8,), jnp.int32)
+    f = jax.jit(lambda a, k: a + k)
+    np.asarray(f(x, 0))  # compile
+    times = []
+    for k in range(1, repeats + 1):
+        t0 = time.perf_counter()
+        np.asarray(f(x, k))
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2] * 1e3
 
 
 # BASELINE.json measurement ladder (rung 1 is the e2e pytest scenario).
@@ -173,7 +192,10 @@ def main() -> int:
     # exercised (XLA-on-CPU), the record says so in "error".
     from semantic_merge_tpu.utils.jaxenv import accelerator_available, force_cpu
 
-    plat = accelerator_available(timeout=120.0, retries=1)
+    if os.environ.get("SEMMERGE_BENCH_PLATFORM") == "cpu":
+        plat = None  # explicit local-iteration override: skip the probe
+    else:
+        plat = accelerator_available(timeout=120.0, retries=1)
     if plat is None:
         force_cpu()
         record["error"] = ("no accelerator: TPU/relay backend failed to "
@@ -193,7 +215,8 @@ def main() -> int:
     host = get_backend("host")
 
     # Parity gate: the bench number is meaningless if the device path
-    # diverges from the oracle.
+    # diverges from the oracle. Also warms compiles and the fused
+    # path's capacity hint, so the timed runs measure steady state.
     res_t, comp_t, conf_t = run_merge(tpu, base, left, right)
     res_h, comp_h, conf_h = run_merge(host, base, left, right)
     parity = (
@@ -203,11 +226,23 @@ def main() -> int:
         and [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_h]
     )
 
+    # Phase split (VERDICT r3 #1a): one instrumented warm merge per
+    # path. The fused device path reports scan_encode/h2d/kernel/fetch/
+    # materialize/compose_decode; the host path build_and_diff/compose.
+    tpu_phases: dict = {}
+    run_merge(tpu, base, left, right, phases=tpu_phases)
+    host_phases: dict = {}
+    run_merge(host, base, left, right, phases=host_phases)
+
     tpu_s = time_merge(tpu, base, left, right)
     host_s = time_merge(host, base, left, right)
 
     import jax
     platform = jax.devices()[0].platform
+    try:
+        rtt_ms = round(probe_roundtrip_ms(), 1)
+    except Exception:
+        rtt_ms = None
 
     conflicts_ok = (len(conf_t) > 0) if conflicts_expected else True
 
@@ -219,6 +254,11 @@ def main() -> int:
         f"{'ok' if parity else 'FAIL'}, platform={platform})")
     record["value"] = round(files_per_sec, 2)
     record["vs_baseline"] = round(vs_baseline, 3)
+    record["phases_ms"] = {k: round(v * 1e3, 1) for k, v in tpu_phases.items()}
+    record["host_phases_ms"] = {k: round(v * 1e3, 1)
+                                for k, v in host_phases.items()}
+    if rtt_ms is not None:
+        record["device_roundtrip_ms"] = rtt_ms
     if not conflicts_ok:
         record["error"] = (record.get("error", "") +
                            " preset declares conflicts but none were produced").strip()
@@ -229,6 +269,12 @@ def main() -> int:
               file=sys.stderr)
         print(f"# composed ops: {len(comp_t)}  conflicts: {len(conf_t)}  parity: {parity}",
               file=sys.stderr)
+        print(f"# tpu phases:  " + "  ".join(
+            f"{k}={v*1e3:.1f}ms" for k, v in tpu_phases.items()), file=sys.stderr)
+        print(f"# host phases: " + "  ".join(
+            f"{k}={v*1e3:.1f}ms" for k, v in host_phases.items()), file=sys.stderr)
+        if rtt_ms is not None:
+            print(f"# device round trip: {rtt_ms} ms", file=sys.stderr)
     print(json.dumps(record), flush=True)
     return 0 if (parity and conflicts_ok) else 1
 

@@ -60,6 +60,36 @@ def host_compose(delta_a: List[Op], delta_b: List[Op]):
     return compose_oplogs(delta_a, delta_b)
 
 
+def run_merge(backend: Backend, base: Snapshot, left: Snapshot,
+              right: Snapshot, *, base_rev: str = "base", seed: str = "0",
+              timestamp: str | None = None, change_signature: bool = False,
+              structured_apply: bool = False, phases: Dict | None = None):
+    """Full 3-way merge through a backend: uses the backend's fused
+    ``merge`` entry point when it has one (the TPU backend's
+    one-round-trip program), otherwise ``build_and_diff`` + ``compose``.
+    Returns ``(BuildAndDiffResult, composed_ops, conflicts)``."""
+    merge = getattr(backend, "merge", None)
+    if merge is not None:
+        return merge(base, left, right, base_rev=base_rev, seed=seed,
+                     timestamp=timestamp, change_signature=change_signature,
+                     structured_apply=structured_apply, phases=phases)
+    import time
+    t0 = time.perf_counter()
+    result = backend.build_and_diff(
+        base, left, right, base_rev=base_rev, seed=seed, timestamp=timestamp,
+        change_signature=change_signature, structured_apply=structured_apply)
+    if phases is not None:
+        phases["build_and_diff"] = (phases.get("build_and_diff", 0.0)
+                                    + time.perf_counter() - t0)
+        t0 = time.perf_counter()
+    compose = getattr(backend, "compose", None) or host_compose
+    composed, conflicts = compose(result.op_log_left, result.op_log_right)
+    if phases is not None:
+        phases["compose"] = (phases.get("compose", 0.0)
+                             + time.perf_counter() - t0)
+    return result, composed, conflicts
+
+
 def symbol_map(nodes) -> List[dict]:
     """SymbolMaps payload entry (reference ``workers/ts/src/index.ts:30-35``)."""
     return [{"symbolId": n.symbolId, "addressId": n.addressId} for n in nodes]

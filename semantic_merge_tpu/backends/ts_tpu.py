@@ -51,20 +51,46 @@ class TpuTSBackend:
             from ..parallel.mesh import build_mesh
             mesh = build_mesh(devices, dp=len(devices),
                               pp=1, sp=1, tp=1, ep=1).mesh
-        self._mesh = mesh
+        self._mesh = mesh or None  # mesh=False forces the single-device path
         # Persistent across merges: encoded ids are stable for the
         # interner's lifetime, so per-file encoded columns cache in the
         # shared decl cache (keyed by scan identity + interner token).
         self._interner = Interner()
+        self._fused = None
+
+    def _fused_engine(self):
+        from ..ops.fused import FusedMergeEngine
+        if self._fused is None or self._fused.interner is not self._interner:
+            self._fused = FusedMergeEngine(self._interner)
+        return self._fused
 
     def _scan_encode(self, snapshot: Snapshot):
+        t, nodes, _ = self._scan_encode_keyed(snapshot)
+        return t, nodes
+
+    def _maybe_reset_interner(self) -> None:
+        """Unbounded growth guard for long-lived processes; the new
+        token invalidates every cached column naturally. Must run only
+        *between* merges — never between the three snapshot scans of
+        one merge, whose interned ids must share one id space."""
         if len(self._interner) > 4_000_000:
-            # Unbounded growth guard for long-lived processes; the new
-            # token invalidates every cached column naturally.
             self._interner = Interner()
+
+    def _scan_encode_keyed(self, snapshot: Snapshot):
+        """Scan+encode, also returning the snapshot's stable identity
+        (the tuple of per-file decl-cache keys + interner token) — the
+        key under which the fused path caches device-resident decl
+        columns. ``None`` when any file lacks a stable key."""
         from ..frontend.declcache import global_cache
         keyed = scan_snapshot_keyed(ts_files(snapshot))
-        return encode_decls_keyed(keyed, self._interner, global_cache())
+        t, nodes = encode_decls_keyed(keyed, self._interner, global_cache())
+        identity = None
+        keys = [k for k, _ in keyed]
+        if keys and all(k is not None for k in keys):
+            identity = (self._interner.token, tuple(keys))
+        elif not keys:
+            identity = (self._interner.token, ())
+        return t, nodes, identity
 
     def configure(self, config) -> None:
         """Apply ``.semmerge.toml`` settings (called by the CLI): an
@@ -100,6 +126,7 @@ class TpuTSBackend:
                        change_signature: bool = False,
                        structured_apply: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
+        self._maybe_reset_interner()
         base_t, base_nodes = self._scan_encode(base)
         left_t, left_nodes = self._scan_encode(left)
         right_t, right_nodes = self._scan_encode(right)
@@ -129,6 +156,7 @@ class TpuTSBackend:
              change_signature: bool = False,
              structured_apply: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
+        self._maybe_reset_interner()
         base_t, base_nodes = self._scan_encode(base)
         right_t, right_nodes = self._scan_encode(right)
         t = self._diff_fn()(base_t, right_t)
@@ -145,6 +173,61 @@ class TpuTSBackend:
             return compose_oplogs_device_sharded(delta_a, delta_b, self._mesh)
         from ..ops.compose import compose_oplogs_device
         return compose_oplogs_device(delta_a, delta_b)
+
+    def merge(self, base: Snapshot, left: Snapshot, right: Snapshot,
+              *, base_rev: str = "base", seed: str = "0",
+              timestamp: str | None = None,
+              change_signature: bool = False,
+              structured_apply: bool = False,
+              phases: Dict | None = None):
+        """Full 3-way merge in ONE device round trip when eligible (see
+        :mod:`semantic_merge_tpu.ops.fused`): diff, deterministic op
+        identity, and composition all stay on device; one compact fetch.
+        Ineligible configurations (a mesh is active, changeSignature or
+        structured-apply requested, oversized strings) fall back to the
+        two-program path with identical observable output. Returns
+        ``(BuildAndDiffResult, composed_ops, conflicts)``."""
+        import time
+        ts = timestamp or EPOCH_ISO
+        self._maybe_reset_interner()
+        if self._mesh is None and not change_signature and not structured_apply:
+            t0 = time.perf_counter()
+            base_t, base_nodes, base_key = self._scan_encode_keyed(base)
+            left_t, left_nodes, left_key = self._scan_encode_keyed(left)
+            right_t, right_nodes, right_key = self._scan_encode_keyed(right)
+            if phases is not None:
+                phases["scan_encode"] = (phases.get("scan_encode", 0.0)
+                                         + time.perf_counter() - t0)
+            fused = self._fused_engine().merge(
+                base_t, base_key, base_nodes, left_t, left_key, left_nodes,
+                right_t, right_key, right_nodes,
+                seed=seed, base_rev=base_rev, timestamp=ts, phases=phases)
+            if fused is not None:
+                ops_l, ops_r, composed, conflicts = fused
+                result = BuildAndDiffResult(
+                    op_log_left=ops_l, op_log_right=ops_r,
+                    symbol_maps={
+                        "base": symbol_map(base_nodes),
+                        "left": symbol_map(left_nodes),
+                        "right": symbol_map(right_nodes),
+                    },
+                )
+                return result, composed, conflicts
+        t0 = time.perf_counter()
+        result = self.build_and_diff(
+            base, left, right, base_rev=base_rev, seed=seed, timestamp=ts,
+            change_signature=change_signature,
+            structured_apply=structured_apply)
+        if phases is not None:
+            phases["build_and_diff"] = (phases.get("build_and_diff", 0.0)
+                                        + time.perf_counter() - t0)
+            t0 = time.perf_counter()
+        composed, conflicts = self.compose(result.op_log_left,
+                                           result.op_log_right)
+        if phases is not None:
+            phases["compose"] = (phases.get("compose", 0.0)
+                                 + time.perf_counter() - t0)
+        return result, composed, conflicts
 
     def close(self) -> None:
         pass

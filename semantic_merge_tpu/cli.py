@@ -181,15 +181,36 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
             seed = base_rev
         timestamp = commit_timestamp_iso(args.base)
 
-        with tracer.phase("build_and_diff", backend=backend.name):
-            result = backend.build_and_diff(
-                base_snap, left_snap, right_snap,
-                base_rev=base_rev, seed=seed, timestamp=timestamp,
-                change_signature=(args.change_signature
-                                  or config.engine.change_signature),
-                structured_apply=(getattr(args, "structured_apply", False)
-                                  or config.engine.structured_apply),
-            )
+        change_sig = args.change_signature or config.engine.change_signature
+        structured = (getattr(args, "structured_apply", False)
+                      or config.engine.structured_apply)
+        strict = (getattr(args, "strict_conflicts", False)
+                  or config.engine.conflict_mode == "strict")
+        if not strict:
+            # The normal path goes through the backend's fused merge
+            # entry point — on the TPU backend that is one device
+            # round trip for diff + op identity + composition.
+            from .backends.base import run_merge
+            with tracer.phase("merge", backend=backend.name):
+                result, composed, conflicts = run_merge(
+                    backend, base_snap, left_snap, right_snap,
+                    base_rev=base_rev, seed=seed, timestamp=timestamp,
+                    change_signature=change_sig, structured_apply=structured)
+        else:
+            # Strict conflict detection inspects the raw op logs between
+            # diff and compose, so it needs the two-step path.
+            with tracer.phase("build_and_diff", backend=backend.name):
+                result = backend.build_and_diff(
+                    base_snap, left_snap, right_snap,
+                    base_rev=base_rev, seed=seed, timestamp=timestamp,
+                    change_signature=change_sig, structured_apply=structured)
+            with tracer.phase("compose"):
+                from .core.strict_conflicts import detect_conflicts_strict
+                ops_left, ops_right, conflicts = detect_conflicts_strict(
+                    result.op_log_left, result.op_log_right)
+                compose_fn = getattr(backend, "compose", None) or compose_oplogs
+                composed, walk_conflicts = compose_fn(ops_left, ops_right)
+                conflicts.extend(walk_conflicts)
         tracer.count("ops_left", len(result.op_log_left))
         tracer.count("ops_right", len(result.op_log_right))
         from .frontend.declcache import global_cache
@@ -197,18 +218,6 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
         if cache is not None:  # cache hit rate (reference architecture.md:248)
             tracer.count("decl_cache_hits", cache.hits)
             tracer.count("decl_cache_misses", cache.misses)
-
-        with tracer.phase("compose"):
-            ops_left, ops_right = result.op_log_left, result.op_log_right
-            conflicts: list = []
-            if (getattr(args, "strict_conflicts", False)
-                    or config.engine.conflict_mode == "strict"):
-                from .core.strict_conflicts import detect_conflicts_strict
-                ops_left, ops_right, conflicts = detect_conflicts_strict(
-                    ops_left, ops_right)
-            compose_fn = getattr(backend, "compose", None) or compose_oplogs
-            composed, walk_conflicts = compose_fn(ops_left, ops_right)
-            conflicts.extend(walk_conflicts)
         tracer.count("composed_ops", len(composed))
         tracer.count("conflicts", len(conflicts))
 

@@ -46,36 +46,30 @@ def compose_oplogs(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List
     ops_a = sorted(delta_a, key=Op.sort_key)
     ops_b = sorted(delta_b, key=Op.sort_key)
 
+    # Conflict detection is the cursor walk below — factored out so the
+    # fused device path replays the *same* implementation. Dropping the
+    # conflicted pairs first and then running a plain two-pointer merge
+    # is take-order-identical to the reference's single interleaved
+    # loop: a conflict advances both cursors without emitting, and the
+    # pairwise (precedence, timestamp) comparisons that order the
+    # remaining ops never depend on the dropped neighbors.
+    conflicts, dropped_a, dropped_b = cursor_walk_conflicts(ops_a, ops_b)
+    stream_a = [op for i, op in enumerate(ops_a) if i not in dropped_a]
+    stream_b = [op for i, op in enumerate(ops_b) if i not in dropped_b]
+
     out: List[Op] = []
-    conflicts: List[Conflict] = []
     rename_chain: Dict[str, str] = {}
     move_chain: Dict[str, Dict[str, str]] = {}
 
     ia = ib = 0
-    while ia < len(ops_a) or ib < len(ops_b):
-        a_head = ops_a[ia] if ia < len(ops_a) else None
-        b_head = ops_b[ib] if ib < len(ops_b) else None
+    while ia < len(stream_a) or ib < len(stream_b):
+        a_head = stream_a[ia] if ia < len(stream_a) else None
+        b_head = stream_b[ib] if ib < len(stream_b) else None
         take_a = a_head is not None and (
             b_head is None or a_head.sort_key()[:2] <= b_head.sort_key()[:2]
         )
         op = a_head if take_a else b_head
-        other = b_head if take_a else a_head
         assert op is not None
-
-        if (
-            op.type == "renameSymbol"
-            and other is not None
-            and other.type == "renameSymbol"
-            and op.target.symbolId == other.target.symbolId
-            and op.params.get("newName") != other.params.get("newName")
-        ):
-            # Conflict record always lists A's op as opA, regardless of
-            # which side's head was consumed first (reference
-            # semmerge/compose.py:67,95 passes op_a first in both arms).
-            conflicts.append(divergent_rename_conflict(a_head, b_head))
-            ia += 1
-            ib += 1
-            continue
 
         if op.type == "renameSymbol":
             rename_chain[op.target.symbolId] = str(op.params.get("newName"))
@@ -97,6 +91,52 @@ def compose_oplogs(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List
             ib += 1
 
     return out, conflicts
+
+
+def cursor_walk_conflicts(ops_a: List[Op], ops_b: List[Op]
+                          ) -> Tuple[List[Conflict], set, set]:
+    """The head-vs-head DivergentRename walk alone, over *already
+    canonically sorted* streams: returns ``(conflicts, dropped_a,
+    dropped_b)`` where the drop sets hold positions into the sorted
+    streams. Chain state never influences detection, so the walk
+    separates cleanly from materialization — the fused device path
+    (:mod:`semantic_merge_tpu.ops.fused`) composes speculatively with
+    no drops in O(log n) on device, then runs this exact sequential
+    oracle on host only when its parallel candidate join fired, and
+    patches the affected symbols. Same quirks as
+    :func:`compose_oplogs`: detection only when both heads surface
+    simultaneously, both ops dropped, interleavings can mask."""
+    conflicts: List[Conflict] = []
+    dropped_a: set = set()
+    dropped_b: set = set()
+    ia = ib = 0
+    while ia < len(ops_a) or ib < len(ops_b):
+        a_head = ops_a[ia] if ia < len(ops_a) else None
+        b_head = ops_b[ib] if ib < len(ops_b) else None
+        take_a = a_head is not None and (
+            b_head is None or a_head.sort_key()[:2] <= b_head.sort_key()[:2]
+        )
+        op = a_head if take_a else b_head
+        other = b_head if take_a else a_head
+        assert op is not None
+        if (
+            op.type == "renameSymbol"
+            and other is not None
+            and other.type == "renameSymbol"
+            and op.target.symbolId == other.target.symbolId
+            and op.params.get("newName") != other.params.get("newName")
+        ):
+            conflicts.append(divergent_rename_conflict(a_head, b_head))
+            dropped_a.add(ia)
+            dropped_b.add(ib)
+            ia += 1
+            ib += 1
+            continue
+        if take_a:
+            ia += 1
+        else:
+            ib += 1
+    return conflicts, dropped_a, dropped_b
 
 
 def _materialize(op: Op, rename_chain: Dict[str, str],

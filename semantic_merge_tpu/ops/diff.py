@@ -70,11 +70,13 @@ def _occurrence_bounds(sym, order, sorted_sym, n_pad):
     return first_idx, last_idx
 
 
-def _diff_lift_core(b_sym, b_addr, b_name, b_file,
-                    s_sym, s_addr, s_name, s_file,
-                    nb: int, ns: int):
+def _diff_plan(b_sym, b_addr, b_name, s_sym, s_addr, s_name,
+               nb: int, ns: int):
+    """The parallel join itself: which slots emit which diff kinds, at
+    which positions of the op stream, with node data taken from which
+    slots. Shared by the interned-column op emitter below and the fused
+    merge program's slot emitter (:mod:`semantic_merge_tpu.ops.fused`)."""
     idx_b = jnp.arange(nb, dtype=jnp.int32)
-    idx_s = jnp.arange(ns, dtype=jnp.int32)
     b_valid = b_sym != PAD_ID
     s_valid = s_sym != PAD_ID
 
@@ -97,10 +99,8 @@ def _diff_lift_core(b_sym, b_addr, b_name, b_file,
     bl = b_last  # node data index (last occurrence)
     b_addr_l = b_addr[bl]
     b_name_l = b_name[bl]
-    b_file_l = b_file[bl]
     s_addr_r = s_addr[s_repr]
     s_name_r = s_name[s_repr]
-    s_file_r = s_file[s_repr]
 
     is_delete = emits & ~found
     is_move = emits & found & (b_addr_l != s_addr_r)
@@ -121,6 +121,27 @@ def _diff_lift_core(b_sym, b_addr, b_name, b_file,
     add_count = is_add.astype(jnp.int32)
     add_off = total_base + jnp.cumsum(add_count) - add_count
     n_ops = total_base + jnp.sum(add_count)
+    return {
+        "is_delete": is_delete, "is_move": is_move, "is_rename": is_rename,
+        "is_add": is_add, "base_off": base_off, "add_off": add_off,
+        "n_ops": n_ops, "bl": bl, "s_repr": s_repr,
+    }
+
+
+def _diff_lift_core(b_sym, b_addr, b_name, b_file,
+                    s_sym, s_addr, s_name, s_file,
+                    nb: int, ns: int):
+    plan = _diff_plan(b_sym, b_addr, b_name, s_sym, s_addr, s_name, nb, ns)
+    is_delete, is_move, is_rename, is_add = (
+        plan["is_delete"], plan["is_move"], plan["is_rename"], plan["is_add"])
+    base_off, add_off, n_ops = plan["base_off"], plan["add_off"], plan["n_ops"]
+    bl, s_repr = plan["bl"], plan["s_repr"]
+    b_addr_l = b_addr[bl]
+    b_name_l = b_name[bl]
+    b_file_l = b_file[bl]
+    s_addr_r = s_addr[s_repr]
+    s_name_r = s_name[s_repr]
+    s_file_r = s_file[s_repr]
 
     m = 2 * nb + ns  # static output capacity
     neg = jnp.int32(NULL_ID)

@@ -1,0 +1,688 @@
+"""One-round-trip fused merge program.
+
+The round-2/3 device path ran diff and compose as *separate* device
+programs with a Python hop in between: fetch diff rows, build ``Op``
+objects, hash their ids one ``hashlib`` call at a time, re-intern, and
+ship the encoding back (``backends/ts_tpu.py`` round 2). On a
+locally-attached accelerator that is merely wasteful; through the
+remote TPU tunnel this session measured (~65 ms per host↔device round
+trip, ~25 MB/s) it is fatal — BENCH_r03 showed the device path at
+0.277× the pure-Python baseline.
+
+This module collapses everything between scan and final decode into
+ONE jitted program and ONE compact fetch:
+
+1. **diff** both sides against base — the parallel join plan from
+   :mod:`semantic_merge_tpu.ops.diff`, emitting ``(kind, base-slot,
+   side-slot)`` rows (slots index the scanned decl lists, so the host
+   can materialize ops without any interned-string round trip);
+2. **op identity on device** — each op's deterministic id payload
+   (``seed|rev|idx|type|sym|aAddr|bAddr``, see
+   :mod:`semantic_merge_tpu.core.ids`) is assembled as bytes from a
+   device-resident string table and hashed with the batched SHA-256 of
+   :mod:`semantic_merge_tpu.ops.sha256`;
+3. **id ranking** — the composition sort key ranks id *strings*
+   (reference ``semmerge/compose.py:16-18``); UUID-formatted hex ids
+   with dashes at fixed positions order exactly like their leading
+   128 digest bits, so a 4-word lexsort over both streams reproduces
+   the host's rank table;
+4. **compose** — the canonical sorts, DivergentRename candidate join,
+   and segmented chain scans of :mod:`semantic_merge_tpu.ops.compose`,
+   run directly on columns derived from the diff output (no re-intern:
+   scan-interner ids are the compose equality ids);
+5. one **compact fetch**: op rows + digest words + canonical-order
+   permutations + composed stream references + chain columns, packed
+   into a single int32 vector sized by a learned capacity hint.
+
+Conflicts are handled *speculatively*: the device program runs the
+parallel candidate join only. In the overwhelmingly common case (no
+candidates) the fetched result is final. When candidates exist, the
+host replays the reference's sequential head-vs-head cursor walk
+(:func:`semantic_merge_tpu.core.compose.cursor_walk_conflicts`) over
+the already-materialized sorted streams and patches the few affected
+symbols — exact oracle semantics at a cost proportional to the
+conflict count, not the merge size.
+
+Replaces the hot path of reference ``workers/ts/src/diff.ts:5-31``,
+``workers/ts/src/lift.ts:11-66`` and ``semmerge/compose.py:51-112``.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.conflict import Conflict
+from ..core.encode import NULL_ID, PAD_ID, DeclTensor, Interner, bucket_size, pad_to
+from ..core.ops import Op, Target
+from .compose import (_PAD_PREC, _local_seg_scan, _materialize_decoded,
+                      _rename_candidate_query, _rename_candidate_tables,
+                      _rename_pairs, _sort_stream)
+from .diff import KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME, _diff_plan
+from .sha256 import sha256_device
+
+#: Order must match the KIND_* codes (0..3).
+_TYPE_NAMES = ("renameSymbol", "moveDecl", "addDecl", "deleteDecl")
+#: OP_PRECEDENCE of each kind code (core/ops.py).
+_PREC_BY_KIND = np.asarray([11, 10, 30, 31], dtype=np.int32)
+
+_PREFIX_CAP = 96     # seed|rev| byte capacity (fall back beyond)
+_TYPE_SEG_CAP = 16   # "|renameSymbol|" is 14 bytes
+_DIGIT_CAP = 8       # op index < 10**8 always (capacity is ~2**20)
+
+# "|<type>|" segments, padded to _TYPE_SEG_CAP.
+_TYPE_SEG = np.zeros((4, _TYPE_SEG_CAP), dtype=np.uint8)
+_TYPE_SEG_LEN = np.zeros((4,), dtype=np.int32)
+for _k, _name in enumerate(_TYPE_NAMES):
+    _seg = ("|" + _name + "|").encode("ascii")
+    _TYPE_SEG[_k, :len(_seg)] = np.frombuffer(_seg, dtype=np.uint8)
+    _TYPE_SEG_LEN[_k] = len(_seg)
+
+
+class DeviceStrings:
+    """Device-resident byte table for an :class:`Interner`'s strings.
+
+    The table is append-only (interner ids are stable), so warm merges
+    ship only the *new* strings' bytes — on the tunnel-attached TPU the
+    h2d cost of a repeated merge is a few hundred bytes, not megabytes.
+    Width and capacity grow in buckets (each growth is a full reship +
+    kernel recompile, amortized away by the append-only pattern).
+    """
+
+    WIDTHS = (32, 64, 128, 256)
+
+    def __init__(self, interner: Interner) -> None:
+        self.interner = interner
+        self._encoded: List[bytes] = []
+        self.width = self.WIDTHS[0]
+        self.cap = 1024
+        self.max_len = 0  # true max byte length (sizes the SHA blocks)
+        self.disabled = False  # an oversized string disables the table
+        self._host_bytes = np.zeros((self.cap, self.width), dtype=np.uint8)
+        self._host_lens = np.zeros((self.cap,), dtype=np.int32)
+        self._dev_bytes = None
+        self._dev_lens = None
+        self._n_dev = 0  # rows synced to device
+
+    def sync(self) -> Optional[tuple]:
+        """Bring the device table up to date with the interner. Returns
+        ``(dev_bytes, dev_lens, width)`` or ``None`` when some string
+        exceeds the maximum supported width — permanently, since interned
+        strings live as long as the interner (the caller falls back to
+        the two-program path for every merge on this interner)."""
+        if self.disabled:
+            return None
+        strings = self.interner.strings
+        n = len(strings)
+        new_max = 0
+        for s in strings[len(self._encoded):]:
+            b = s.encode("utf-8")
+            self._encoded.append(b)
+            new_max = max(new_max, len(b))
+        if new_max > self.WIDTHS[-1]:
+            self.disabled = True
+            return None
+        self.max_len = max(self.max_len, new_max)
+        width = self.width
+        while new_max > width:
+            width = self.WIDTHS[self.WIDTHS.index(width) + 1]
+        cap = self.cap
+        while n > cap:
+            cap *= 2
+        if width != self.width or cap != self.cap:
+            # Geometry change: rebuild the host mirror, full reship.
+            self.width, self.cap = width, cap
+            self._host_bytes = np.zeros((cap, width), dtype=np.uint8)
+            self._host_lens = np.zeros((cap,), dtype=np.int32)
+            for i, b in enumerate(self._encoded):
+                self._host_bytes[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+                self._host_lens[i] = len(b)
+            self._dev_bytes = jax.device_put(self._host_bytes)
+            self._dev_lens = jax.device_put(self._host_lens)
+            self._n_dev = n
+            return self._dev_bytes, self._dev_lens, self.width
+        if n > self._n_dev or self._dev_bytes is None:
+            start = self._n_dev if self._dev_bytes is not None else 0
+            for i in range(start, n):
+                b = self._encoded[i]
+                self._host_bytes[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+                self._host_lens[i] = len(b)
+            if self._dev_bytes is None:
+                self._dev_bytes = jax.device_put(self._host_bytes)
+                self._dev_lens = jax.device_put(self._host_lens)
+            else:
+                # Ship only the delta, padded to a power-of-two row count
+                # so the update-slice kernel compiles O(log) variants.
+                rows = bucket_size(n - start, minimum=8)
+                if start + rows > self.cap:
+                    self._dev_bytes = jax.device_put(self._host_bytes)
+                    self._dev_lens = jax.device_put(self._host_lens)
+                else:
+                    upd_b = self._host_bytes[start:start + rows]
+                    upd_l = self._host_lens[start:start + rows]
+                    self._dev_bytes = _dev_update2(self._dev_bytes, upd_b,
+                                                   np.int32(start))
+                    self._dev_lens = _dev_update1(self._dev_lens, upd_l,
+                                                  np.int32(start))
+            self._n_dev = n
+        return self._dev_bytes, self._dev_lens, self.width
+
+
+@jax.jit
+def _dev_update2(buf, upd, start):
+    return jax.lax.dynamic_update_slice(buf, upd, (start, jnp.int32(0)))
+
+
+@jax.jit
+def _dev_update1(buf, upd, start):
+    return jax.lax.dynamic_update_slice(buf, upd, (start,))
+
+
+# --------------------------------------------------------------------------
+# Device program
+# --------------------------------------------------------------------------
+
+def _emit_slots(plan, C: int, nb: int, ns: int):
+    """Scatter the diff plan into compact ``(kind, a_slot, b_slot)``
+    rows of capacity ``C`` (rows beyond C drop; the overflow flag tells
+    the host to retry with a larger capacity)."""
+    neg = jnp.int32(NULL_ID)
+    kind = jnp.full((C,), neg)
+    a_slot = jnp.full((C,), neg)
+    b_slot = jnp.full((C,), neg)
+    idx_s = jnp.arange(ns, dtype=jnp.int32)
+    bl, s_repr = plan["bl"], plan["s_repr"]
+
+    def scat(cols, posn, mask, vals):
+        posn = jnp.where(mask, posn, C)
+        return [c.at[posn].set(v, mode="drop") for c, v in zip(cols, vals)]
+
+    cols = [kind, a_slot, b_slot]
+    nbneg = jnp.full((nb,), neg)
+    nsneg = jnp.full((ns,), neg)
+    cols = scat(cols, plan["base_off"], plan["is_delete"],
+                [jnp.full((nb,), KIND_DELETE, jnp.int32), bl, nbneg])
+    cols = scat(cols, plan["base_off"], plan["is_move"],
+                [jnp.full((nb,), KIND_MOVE, jnp.int32), bl, s_repr])
+    cols = scat(cols, plan["base_off"] + plan["is_move"].astype(jnp.int32),
+                plan["is_rename"],
+                [jnp.full((nb,), KIND_RENAME, jnp.int32), bl, s_repr])
+    cols = scat(cols, plan["add_off"], plan["is_add"],
+                [jnp.full((ns,), KIND_ADD, jnp.int32), nsneg, idx_s])
+    return cols[0], cols[1], cols[2], plan["n_ops"]
+
+
+def _op_id_words(kind, a_slot, b_slot, b_cols, s_cols, tab_b, tab_l,
+                 prefix, prefix_len, *, C: int, B: int, W: int):
+    """Assemble each op's id payload bytes and hash them: uint32 [C, 4].
+
+    Payload layout (must match ``core.ids.deterministic_op_id``):
+    ``<seed>|<rev>|`` (prefix) + decimal op index + ``|<type>|`` +
+    symbolId + ``|`` + aAddr + ``|`` + bAddr.
+    """
+    b_sym, b_addr = b_cols[0], b_cols[1]
+    s_sym, s_addr = s_cols[0], s_cols[1]
+    a_sl = jnp.clip(a_slot, 0, b_sym.shape[0] - 1)
+    b_sl = jnp.clip(b_slot, 0, s_sym.shape[0] - 1)
+    is_add = kind == KIND_ADD
+    valid = kind >= 0
+    sym_id = jnp.where(is_add, s_sym[b_sl], b_sym[a_sl])
+    a_id = jnp.where(valid & ~is_add, b_addr[a_sl], NULL_ID)
+    b_id = jnp.where((kind == KIND_MOVE) | (kind == KIND_RENAME) | is_add,
+                     s_addr[b_sl], NULL_ID)
+
+    cap = tab_l.shape[0]
+
+    def slen(sid):
+        return jnp.where(sid >= 0, tab_l[jnp.clip(sid, 0, cap - 1)], 0)
+
+    sym_len, a_len, b_len = slen(sym_id), slen(a_id), slen(b_id)
+
+    idx = jnp.arange(C, dtype=jnp.int32)
+    pow10 = jnp.asarray([10 ** t for t in range(_DIGIT_CAP)], jnp.int32)
+    di = jnp.int32(1) + sum((idx >= pow10[t]).astype(jnp.int32)
+                            for t in range(1, _DIGIT_CAP))
+
+    kc = jnp.clip(kind, 0, 3)
+    ttab = jnp.asarray(_TYPE_SEG)
+    tlen = jnp.asarray(_TYPE_SEG_LEN)[kc]
+
+    one = jnp.ones((C,), jnp.int32)
+    o1 = jnp.full((C,), prefix_len, jnp.int32)
+    o2 = o1 + di
+    o3 = o2 + tlen
+    o4 = o3 + sym_len
+    o5 = o4 + one
+    o6 = o5 + a_len
+    o7 = o6 + one
+    msg_len = o7 + b_len
+
+    # Two-step assembly, built for cheap gathers: elementwise 2D gathers
+    # are pathological on both XLA CPU and TPU, so (1) every variable
+    # part lands in a per-row STAGING buffer at a *static* column offset
+    # via whole-row gathers (table rows, type rows), then (2) one
+    # elementwise gather compacts staging into the contiguous message
+    # using an affine per-segment index map.
+    pcap = prefix.shape[0]
+    s_dig = pcap
+    s_typ = s_dig + _DIGIT_CAP
+    s_sym = s_typ + _TYPE_SEG_CAP
+    s_p1 = s_sym + W
+    s_a = s_p1 + 1
+    s_p2 = s_a + W
+    s_b = s_p2 + 1
+
+    k = jnp.arange(_DIGIT_CAP, dtype=jnp.int32)[None, :]
+    e = jnp.clip(di[:, None] - 1 - k, 0, _DIGIT_CAP - 1)
+    digit_block = (48 + (idx[:, None] // pow10[e]) % 10).astype(jnp.uint8)
+
+    def rows(sid):
+        return tab_b[jnp.clip(sid, 0, cap - 1)]
+
+    pipe_col = jnp.full((C, 1), 124, jnp.uint8)  # '|'
+    staging = jnp.concatenate([
+        jnp.broadcast_to(prefix[None, :], (C, pcap)),
+        digit_block,
+        ttab[kc],
+        rows(sym_id),
+        pipe_col,
+        rows(a_id),
+        pipe_col,
+        rows(b_id),
+    ], axis=1)
+
+    MSG = B * 64
+    j = jnp.arange(MSG, dtype=jnp.int32)[None, :]
+
+    def seg(src_idx, start, stage_off):
+        return jnp.where(j >= start[:, None],
+                         stage_off + (j - start[:, None]), src_idx)
+
+    src_idx = j  # prefix segment at staging offset 0
+    src_idx = seg(src_idx, o1, s_dig)
+    src_idx = seg(src_idx, o2, s_typ)
+    src_idx = seg(src_idx, o3, s_sym)
+    src_idx = seg(src_idx, o4, s_p1)
+    src_idx = seg(src_idx, o5, s_a)
+    src_idx = seg(src_idx, o6, s_p2)
+    src_idx = seg(src_idx, o7, s_b)
+    src_idx = jnp.clip(src_idx, 0, staging.shape[1] - 1)
+    out = jnp.take_along_axis(staging, src_idx, axis=1)
+
+    return sha256_device(out, jnp.where(valid, msg_len, 9), n_words=4)
+
+
+def _compose_cols(kind, a_slot, b_slot, id_rank, b_cols, s_cols, C: int):
+    """Derive the composer's encoded columns directly from diff rows —
+    the scan interner's ids ARE the compose equality ids (names, files
+    and addresses only ever get compared or decoded, never re-tagged;
+    see ``core.encode.encode_oplog`` for the host's equivalent)."""
+    b_file = b_cols[3]
+    s_name, s_file = s_cols[2], s_cols[3]
+    s_addr = s_cols[1]
+    b_sym, s_sym = b_cols[0], s_cols[0]
+    a_sl = jnp.clip(a_slot, 0, b_sym.shape[0] - 1)
+    b_sl = jnp.clip(b_slot, 0, s_sym.shape[0] - 1)
+    valid = kind >= 0
+    is_add = kind == KIND_ADD
+    is_ren = kind == KIND_RENAME
+    is_mv = kind == KIND_MOVE
+    kc = jnp.clip(kind, 0, 3)
+    sym_id = jnp.where(is_add, s_sym[b_sl], b_sym[a_sl])
+    nn = jnp.where(is_ren, s_name[b_sl], NULL_ID)
+    return {
+        "prec": jnp.where(valid, jnp.asarray(_PREC_BY_KIND)[kc], _PAD_PREC),
+        "ts_rank": jnp.where(valid, 0, NULL_ID),  # single shared timestamp
+        "id_rank": jnp.where(valid, id_rank, NULL_ID),
+        "is_rename": (is_ren & valid).astype(jnp.int32),
+        "is_move": (is_mv & valid).astype(jnp.int32),
+        "sym": jnp.where(valid, sym_id, PAD_ID),
+        "new_name": nn,
+        "chain_name": nn,
+        "new_addr": jnp.where(is_mv, s_addr[b_sl], NULL_ID),
+        "chain_file": jnp.where(valid,
+                                jnp.where(kind == KIND_DELETE,
+                                          b_file[a_sl], s_file[b_sl]),
+                                NULL_ID),
+        "op_index": jnp.where(valid, jnp.arange(C, dtype=jnp.int32), NULL_ID),
+    }
+
+
+def _merge_scan_spec(a, b, C: int):
+    """Speculative merged order + segmented chain scans (no drops) —
+    the same stage-3 instructions as ``ops.compose._merge_and_scan``,
+    emitting compact ``side<<30|op_index`` references."""
+    def cat(name):
+        return jnp.concatenate([a[name], b[name]])
+
+    total = 2 * C
+    side = jnp.concatenate([jnp.zeros((C,), jnp.int32), jnp.ones((C,), jnp.int32)])
+    opidx = cat("op_index")
+    live = opidx != NULL_ID
+
+    prec, ts, idr = cat("prec"), cat("ts_rank"), cat("id_rank")
+    merged_order = jnp.lexsort((idr, side, ts, prec))
+    merged_pos = jnp.argsort(merged_order).astype(jnp.int32)
+
+    sym = cat("sym")
+    is_rename = cat("is_rename") == 1
+    is_move = cat("is_move") == 1
+    new_name = cat("chain_name")
+    new_addr = cat("new_addr")
+    file_contrib = cat("chain_file")
+
+    move_live = is_move & live
+    c_addr_val = jnp.where(move_live & (new_addr != NULL_ID), new_addr, NULL_ID)
+    c_file_val = jnp.where(move_live & (file_contrib != NULL_ID), file_contrib, NULL_ID)
+    c_name_val = jnp.where(is_rename & live, new_name, NULL_ID)
+
+    seg_order = jnp.lexsort((merged_pos, sym))
+    seg_sym = sym[seg_order]
+    chain_addr = _local_seg_scan(seg_sym, seg_order, c_addr_val)
+    chain_file = _local_seg_scan(seg_sym, seg_order, c_file_val)
+    chain_name = _local_seg_scan(seg_sym, seg_order, c_name_val)
+
+    live_m = live[merged_order]
+    out_pos = jnp.cumsum(live_m.astype(jnp.int32)) - 1
+    n_out = jnp.sum(live_m.astype(jnp.int32))
+    pos = jnp.where(live_m, out_pos, total)
+    packed = (side << 30) | jnp.where(opidx >= 0, opidx, 0)
+
+    def place(vals):
+        buf = jnp.full((total,), NULL_ID, jnp.int32)
+        return buf.at[pos].set(vals[merged_order], mode="drop")
+
+    return (n_out, place(packed), place(chain_addr), place(chain_file),
+            place(chain_name))
+
+
+@partial(jax.jit, static_argnames=("nb", "nl", "nr", "C", "B", "W"))
+def _fused_merge_kernel(b_cols, l_cols, r_cols, tab_b, tab_l,
+                        pre_l, plen_l, pre_r, plen_r,
+                        nb: int, nl: int, nr: int, C: int, B: int, W: int):
+    planL = _diff_plan(b_cols[0], b_cols[1], b_cols[2],
+                       l_cols[0], l_cols[1], l_cols[2], nb, nl)
+    planR = _diff_plan(b_cols[0], b_cols[1], b_cols[2],
+                       r_cols[0], r_cols[1], r_cols[2], nb, nr)
+    kL, aL, bL, nopsL = _emit_slots(planL, C, nb, nl)
+    kR, aR, bR, nopsR = _emit_slots(planR, C, nb, nr)
+    overflow = ((nopsL > C) | (nopsR > C)).astype(jnp.int32)
+
+    wL = _op_id_words(kL, aL, bL, b_cols, l_cols, tab_b, tab_l,
+                      pre_l, plen_l, C=C, B=B, W=W)
+    wR = _op_id_words(kR, aR, bR, b_cols, r_cols, tab_b, tab_l,
+                      pre_r, plen_r, C=C, B=B, W=W)
+
+    # Global id ranks: 128-bit big-endian word lexsort over both streams
+    # == lexicographic rank of the uuid-formatted id strings.
+    inval = jnp.uint32(0xFFFFFFFF)
+    validL = (kL >= 0)[:, None]
+    validR = (kR >= 0)[:, None]
+    all_words = jnp.concatenate([jnp.where(validL, wL, inval),
+                                 jnp.where(validR, wR, inval)])
+    order = jnp.lexsort((all_words[:, 3], all_words[:, 2],
+                         all_words[:, 1], all_words[:, 0]))
+    rank = jnp.zeros((2 * C,), jnp.int32).at[order].set(
+        jnp.arange(2 * C, dtype=jnp.int32))
+    id_rank_l, id_rank_r = rank[:C], rank[C:]
+
+    colsL = _compose_cols(kL, aL, bL, id_rank_l, b_cols, l_cols, C)
+    colsR = _compose_cols(kR, aR, bR, id_rank_r, b_cols, r_cols, C)
+    a = _sort_stream(colsL)
+    b = _sort_stream(colsR)
+
+    tables = _rename_candidate_tables(a, nopsL, C)
+    b_rsym, b_rname = _rename_pairs(b, nopsR, C)
+    has_cand = jnp.any(_rename_candidate_query(tables, C, b_rsym, b_rname))
+
+    n_out, ref, c_addr, c_file, c_name = _merge_scan_spec(a, b, C)
+
+    scalars = jnp.stack([nopsL, nopsR, n_out, has_cand.astype(jnp.int32),
+                         overflow, jnp.int32(0), jnp.int32(0), jnp.int32(0)])
+    as_i32 = partial(jax.lax.bitcast_convert_type, new_dtype=jnp.int32)
+    return jnp.concatenate([
+        scalars,
+        kL, aL, bL, as_i32(wL[:, 0]), as_i32(wL[:, 1]),
+        as_i32(wL[:, 2]), as_i32(wL[:, 3]),
+        kR, aR, bR, as_i32(wR[:, 0]), as_i32(wR[:, 1]),
+        as_i32(wR[:, 2]), as_i32(wR[:, 3]),
+        a["op_index"], b["op_index"],
+        ref, c_addr, c_file, c_name,
+    ])
+
+
+# --------------------------------------------------------------------------
+# Host side: decode, materialize, conflict patch
+# --------------------------------------------------------------------------
+
+def _format_ids(words: np.ndarray) -> List[str]:
+    """int32-bitcast digest words [n, 4] → uuid-shaped id strings, one
+    bulk hex conversion for the whole batch."""
+    hx = np.ascontiguousarray(words).view(np.uint32).astype(">u4").tobytes().hex()
+    return [f"{s[:8]}-{s[8:12]}-{s[12:16]}-{s[16:20]}-{s[20:]}"
+            for s in (hx[32 * i:32 * i + 32] for i in range(len(words)))]
+
+
+def _materialize_stream(kind: np.ndarray, a_slot: np.ndarray,
+                        b_slot: np.ndarray, words: np.ndarray,
+                        base_nodes, side_nodes, prov: Dict) -> List[Op]:
+    """Compact device rows → the same ``Op`` records ``core.difflift.lift``
+    builds, ids taken from the device digests (parity property-tested
+    against the host lift). ``prov`` is shared across the stream's ops —
+    ops are immutable downstream and ``Op.clone`` copies it."""
+    ids = _format_ids(words)
+    ops: List[Op] = []
+    for i, (k, ai, bi) in enumerate(zip(kind.tolist(), a_slot.tolist(),
+                                        b_slot.tolist())):
+        a = base_nodes[ai] if ai >= 0 else None
+        b = side_nodes[bi] if bi >= 0 else None
+        if k == KIND_RENAME:
+            op = Op(ids[i], 1, "renameSymbol",
+                    Target(a.symbolId, a.addressId),
+                    {"oldName": a.name, "newName": b.name, "file": b.file},
+                    {"exists": True, "addressMatch": a.addressId},
+                    {"summary": f"rename {a.name}→{b.name}"}, prov)
+        elif k == KIND_MOVE:
+            op = Op(ids[i], 1, "moveDecl",
+                    Target(a.symbolId, a.addressId),
+                    {"oldAddress": a.addressId, "newAddress": b.addressId,
+                     "oldFile": a.file, "newFile": b.file},
+                    {"exists": True, "addressMatch": a.addressId},
+                    {"summary": f"move {a.addressId}→{b.addressId}"}, prov)
+        elif k == KIND_ADD:
+            op = Op(ids[i], 1, "addDecl",
+                    Target(b.symbolId, b.addressId),
+                    {"file": b.file}, {}, {"summary": "add decl"}, prov)
+        else:  # KIND_DELETE
+            op = Op(ids[i], 1, "deleteDecl",
+                    Target(a.symbolId, a.addressId),
+                    {"file": a.file}, {}, {"summary": "delete decl"}, prov)
+        ops.append(op)
+    return ops
+
+
+class FusedMergeEngine:
+    """Owns the device-resident state of the fused path: the string
+    byte table, per-snapshot decl-column device arrays (keyed by scan
+    identity — warm merges ship zero input bytes), and the learned op
+    capacity hint that sizes the compact output."""
+
+    def __init__(self, interner: Interner) -> None:
+        self.interner = interner
+        self.strings = DeviceStrings(interner)
+        self._decl_cache: "OrderedDict" = OrderedDict()
+        self._cap_hint = 256
+
+    def _device_decl(self, t: DeclTensor, identity) -> tuple:
+        bucket = bucket_size(max(t.n, 1))
+        if identity is not None:
+            hit = self._decl_cache.get(identity)
+            if hit is not None and hit[1] == bucket:
+                self._decl_cache.move_to_end(identity)
+                return hit
+        null = np.int32(NULL_ID)
+        stacked = np.stack([pad_to(t.sym, bucket, PAD_ID),
+                            pad_to(t.addr, bucket, null),
+                            pad_to(t.name, bucket, null),
+                            pad_to(t.file, bucket, null)])
+        entry = (jax.device_put(stacked), bucket)
+        if identity is not None:
+            self._decl_cache[identity] = entry
+            while len(self._decl_cache) > 12:
+                self._decl_cache.popitem(last=False)
+        return entry
+
+    def merge(self, base_t: DeclTensor, base_key, base_nodes,
+              left_t: DeclTensor, left_key, left_nodes,
+              right_t: DeclTensor, right_key, right_nodes,
+              *, seed: str, base_rev: str, timestamp: str,
+              phases: Dict | None = None
+              ) -> Optional[Tuple[List[Op], List[Op], List[Op], List[Conflict]]]:
+        """Run the one-round-trip merge; ``None`` when ineligible (a
+        string exceeds the table width, or the prefix exceeds its cap) —
+        the caller falls back to the two-program path."""
+        import time
+        pre_l = f"{seed}/L|{base_rev}|".encode("utf-8")
+        pre_r = f"{seed}/R|{base_rev}|".encode("utf-8")
+        if max(len(pre_l), len(pre_r)) > _PREFIX_CAP:
+            return None
+
+        t0 = time.perf_counter()
+        synced = self.strings.sync()
+        if synced is None:
+            return None
+        tab_b, tab_l, W = synced
+        dev_b, nb = self._device_decl(base_t, base_key)
+        dev_l, nl = self._device_decl(left_t, left_key)
+        dev_r, nr = self._device_decl(right_t, right_key)
+        pl = np.zeros((_PREFIX_CAP,), np.uint8)
+        pl[:len(pre_l)] = np.frombuffer(pre_l, np.uint8)
+        pr = np.zeros((_PREFIX_CAP,), np.uint8)
+        pr[:len(pre_r)] = np.frombuffer(pre_r, np.uint8)
+        # SHA block count from the *actual* max message length, not the
+        # table width cap — halves hash work in the common case. Inputs
+        # quantized to 16 so B only changes on real growth (a recompile).
+        q = lambda x: -(-x // 16) * 16  # noqa: E731
+        max_msg = (q(max(len(pre_l), len(pre_r))) + _DIGIT_CAP
+                   + _TYPE_SEG_CAP + 3 * q(self.strings.max_len) + 2 + 9)
+        B = -(-max_msg // 64)
+        if phases is not None:
+            phases["h2d"] = phases.get("h2d", 0.0) + time.perf_counter() - t0
+
+        flat = None
+        for _attempt in range(4):
+            C = bucket_size(max(self._cap_hint, 8))
+            t0 = time.perf_counter()
+            out_dev = _fused_merge_kernel(
+                dev_b, dev_l, dev_r, tab_b, tab_l,
+                pl, np.int32(len(pre_l)), pr, np.int32(len(pre_r)),
+                nb=nb, nl=nl, nr=nr, C=C, B=B, W=W)
+            if phases is not None:
+                out_dev.block_until_ready()
+                phases["kernel"] = (phases.get("kernel", 0.0)
+                                    + time.perf_counter() - t0)
+                t0 = time.perf_counter()
+            flat = np.asarray(out_dev)
+            if phases is not None:
+                phases["fetch"] = (phases.get("fetch", 0.0)
+                                   + time.perf_counter() - t0)
+            n_l, n_r = int(flat[0]), int(flat[1])
+            if not flat[4]:  # no overflow
+                break
+            self._cap_hint = max(n_l, n_r)
+        else:
+            return None
+        n_out, has_cand = int(flat[2]), bool(flat[3])
+
+        t0 = time.perf_counter()
+        off = 8
+
+        def take(k):
+            nonlocal off
+            v = flat[off:off + k]
+            off += k
+            return v
+
+        kL, aL, bL = take(C), take(C), take(C)
+        wL = np.stack([take(C) for _ in range(4)], axis=1)
+        kR, aR, bR = take(C), take(C), take(C)
+        wR = np.stack([take(C) for _ in range(4)], axis=1)
+        permL, permR = take(C), take(C)
+        ref, c_addr, c_file, c_name = (take(2 * C), take(2 * C),
+                                       take(2 * C), take(2 * C))
+
+        ops_l = _materialize_stream(kL[:n_l], aL[:n_l], bL[:n_l], wL[:n_l],
+                                    base_nodes, left_nodes,
+                                    {"rev": base_rev, "timestamp": timestamp})
+        ops_r = _materialize_stream(kR[:n_r], aR[:n_r], bR[:n_r], wR[:n_r],
+                                    base_nodes, right_nodes,
+                                    {"rev": base_rev, "timestamp": timestamp})
+        if phases is not None:
+            phases["materialize"] = (phases.get("materialize", 0.0)
+                                     + time.perf_counter() - t0)
+            t0 = time.perf_counter()
+
+        # Direct list indexing, O(n_out): never copy the whole (long-
+        # lived, growing) interner table per merge.
+        strings = self.interner.strings
+
+        def decode_col(col):
+            return [strings[i] if i >= 0 else None for i in col.tolist()]
+
+        refs = ref[:n_out]
+        sides = (refs >> 30).tolist()
+        idxs = (refs & ((1 << 30) - 1)).tolist()
+        addr_s = decode_col(c_addr[:n_out])
+        file_s = decode_col(c_file[:n_out])
+        name_s = decode_col(c_name[:n_out])
+
+        conflicts: List[Conflict] = []
+        if has_cand:
+            sorted_a = [ops_l[i] for i in permL[:n_l].tolist()]
+            sorted_b = [ops_r[i] for i in permR[:n_r].tolist()]
+            from ..core.compose import cursor_walk_conflicts
+            conflicts, da, db = cursor_walk_conflicts(sorted_a, sorted_b)
+        if conflicts:
+            composed = _compose_with_drops(
+                sides, idxs, addr_s, file_s, name_s, ops_l, ops_r,
+                {permL[i] for i in da}, {permR[j] for j in db})
+        else:
+            composed = [
+                _materialize_decoded((ops_l if side == 0 else ops_r)[i],
+                                     na_, nf_, nn_)
+                for side, i, na_, nf_, nn_ in zip(sides, idxs, addr_s,
+                                                  file_s, name_s)]
+        if phases is not None:
+            phases["compose_decode"] = (phases.get("compose_decode", 0.0)
+                                        + time.perf_counter() - t0)
+        return ops_l, ops_r, composed, conflicts
+
+
+def _compose_with_drops(sides, idxs, addr_s, file_s, name_s, ops_l, ops_r,
+                        dropped_l: set, dropped_r: set) -> List[Op]:
+    """Patch the speculative composition after the host cursor walk
+    found real DivergentRename conflicts: dropped renames leave the
+    stream, and the rename chains of *affected symbols only* are
+    replayed in composed order (drops are always renames, so the
+    addr/file chains from the device scan remain exact)."""
+    aff = {ops_l[i].target.symbolId for i in dropped_l}
+    aff |= {ops_r[j].target.symbolId for j in dropped_r}
+    ctx: Dict[str, str] = {}
+    out: List[Op] = []
+    for side, i, na_, nf_, nn_ in zip(sides, idxs, addr_s, file_s, name_s):
+        dropped = dropped_l if side == 0 else dropped_r
+        op = (ops_l if side == 0 else ops_r)[i]
+        if i in dropped:
+            continue
+        sym = op.target.symbolId
+        if sym in aff:
+            if op.type == "renameSymbol":
+                ctx[sym] = str(op.params.get("newName"))
+            out.append(_materialize_decoded(op, na_, nf_, ctx.get(sym)))
+        else:
+            out.append(_materialize_decoded(op, na_, nf_, nn_))
+    return out

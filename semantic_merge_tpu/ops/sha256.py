@@ -1,0 +1,145 @@
+"""Batched SHA-256 on device.
+
+The engine's deterministic op identity is ``sha256(seed|rev|idx|type|
+sym|aAddr|bAddr)`` (:mod:`semantic_merge_tpu.core.ids`, replacing the
+reference's ``crypto.randomUUID()`` at reference
+``workers/ts/src/lift.ts:5-9``) — and the composition sort key *ranks
+those ids* (reference ``semmerge/compose.py:16-18``). So a merge
+pipeline that wants to stay on device between the diff join and the
+composition scans must produce the hashes on device: this module is
+what makes the one-round-trip fused merge program possible on a
+remote-attached TPU, where every host↔device hop costs ~65 ms.
+
+SHA-256 is pure 32-bit integer arithmetic — rotations, xors, modular
+adds — which vectorizes perfectly across message lanes: one lane per
+op, every round executed SIMD across the whole op batch on the VPU.
+The message schedule is unrolled (48 static steps); the 64 rounds run
+as a ``lax.fori_loop`` so the program stays compact for XLA.
+
+Messages are fixed-capacity rows (``B`` 64-byte blocks, static) with a
+dynamic byte length per row; standard SHA padding (0x80, zeros, 64-bit
+big-endian bit length) is applied on device. Callers guarantee
+``msg_len <= B*64 - 9`` so padding never truncates.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Round constants (FIPS 180-4).
+_K = [
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+]
+
+_H0 = [0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+       0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19]
+
+
+def _rotr(x, n: int):
+    return (x >> jnp.uint32(n)) | (x << jnp.uint32(32 - n))
+
+
+def _pad_and_pack(msg: jnp.ndarray, msg_len: jnp.ndarray) -> jnp.ndarray:
+    """Apply SHA padding and pack bytes into big-endian uint32 words.
+
+    ``msg``: uint8 ``[n, B*64]`` (bytes past ``msg_len`` are ignored);
+    ``msg_len``: int32 ``[n]``. Returns uint32 ``[n, B*16]``.
+
+    Rows are padded to their *own* final block — 0x80 after the
+    message, the 64-bit big-endian bit length in the last 8 bytes of
+    block ``ceil((len+9)/64)`` — not to the buffer capacity; the
+    compression loop in :func:`sha256_device` stops per-row at that
+    block, so a fixed-capacity batch hashes identically to
+    :mod:`hashlib` on each row.
+    """
+    n, cap = msg.shape
+    pos = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    length = msg_len[:, None]
+    endpos = ((msg_len + 9 + 63) // 64)[:, None] * 64  # per-row padded end
+    b = jnp.where(pos < length, msg, jnp.uint8(0))
+    b = jnp.where(pos == length, jnp.uint8(0x80), b).astype(jnp.uint32)
+    # Messages here are far below 2**29 bytes, so the high length word
+    # is always zero and 32-bit shifts suffice.
+    bitlen = (msg_len.astype(jnp.uint32) * 8)[:, None]
+    shift = 8 * (endpos - 1 - pos)  # negative past the row's end
+    in_zone = (pos >= endpos - 8) & (pos < endpos)
+    sh = jnp.clip(shift, 0, 31).astype(jnp.uint32)
+    len_byte = jnp.where(in_zone & (shift < 32), (bitlen >> sh) & 0xFF, 0)
+    b = jnp.where(in_zone, b | len_byte, b)
+    w = b.reshape(n, cap // 4, 4)
+    return (w[:, :, 0] << 24) | (w[:, :, 1] << 16) | (w[:, :, 2] << 8) | w[:, :, 3]
+
+
+def _compress_block(state, block):
+    """One SHA-256 compression over a ``[n, 16]`` uint32 block; the 64
+    rounds run as a fori_loop with the message schedule precomputed."""
+    w = [block[:, t] for t in range(16)]
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> jnp.uint32(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> jnp.uint32(10))
+        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+    w_all = jnp.stack(w)                       # [64, n]
+    k_all = jnp.asarray(_K, dtype=jnp.uint32)  # [64]
+
+    def round_body(t, vs):
+        a, b, c, d, e, f, g, h = vs
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k_all[t] + w_all[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+    out = jax.lax.fori_loop(0, 64, round_body, tuple(state))
+    return tuple(s + o for s, o in zip(state, out))
+
+
+def sha256_device(msg: jnp.ndarray, msg_len: jnp.ndarray,
+                  n_words: int = 8) -> jnp.ndarray:
+    """Batched SHA-256: uint8 ``[n, B*64]`` + int32 ``[n]`` lengths →
+    uint32 ``[n, n_words]`` big-endian digest words (``n_words=4`` gives
+    the 128 bits an op id uses). Traceable; call inside jit."""
+    n, cap = msg.shape
+    assert cap % 64 == 0, "message capacity must be whole SHA blocks"
+    words = _pad_and_pack(msg, msg_len)
+    n_blocks = (msg_len + 9 + 63) // 64  # per-row block count
+    init = tuple(jnp.full((n,), h, dtype=jnp.uint32) for h in _H0)
+
+    def block_body(blk, state):
+        block = jax.lax.dynamic_slice(words, (0, blk * 16), (n, 16))
+        nxt = _compress_block(state, block)
+        keep = blk < n_blocks  # [n] — rows already finished stay frozen
+        return tuple(jnp.where(keep, nw, old) for nw, old in zip(nxt, state))
+
+    state = jax.lax.fori_loop(0, cap // 64, block_body, init)
+    return jnp.stack(state[:n_words], axis=1)
+
+
+@partial(jax.jit, static_argnames=("n_words",))
+def _sha256_jit(msg, msg_len, n_words: int = 8):
+    return sha256_device(msg, msg_len, n_words)
+
+
+def sha256_host_check(data: bytes, capacity_blocks: int) -> str:
+    """Test helper: run the device implementation on one message and
+    return the hex digest (compare against :mod:`hashlib`)."""
+    import numpy as np
+    cap = capacity_blocks * 64
+    assert len(data) <= cap - 9
+    row = np.zeros((1, cap), dtype=np.uint8)
+    row[0, :len(data)] = np.frombuffer(data, dtype=np.uint8)
+    out = np.asarray(_sha256_jit(row, np.asarray([len(data)], np.int32)))
+    return "".join(f"{int(w):08x}" for w in out[0])

@@ -165,7 +165,8 @@ def test_trace_artifact(repo):
     assert rc == 0
     trace = json.loads((repo / ".semmerge-trace.json").read_text())
     phase_names = [p["name"] for p in trace["phases"]]
-    assert "build_and_diff" in phase_names and "compose" in phase_names
+    # The non-strict CLI path runs diff+compose as one fused merge phase.
+    assert "merge" in phase_names and "snapshot" in phase_names
     assert trace["counters"]["conflicts"] == 0
 
 

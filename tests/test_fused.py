@@ -1,0 +1,180 @@
+"""Parity of the one-round-trip fused merge path vs the host oracle.
+
+The fused program (ops/fused.py) re-derives everything the two-program
+device path computed — diff rows, deterministic SHA-256 op ids, compose
+sort ranks, chain scans — inside one jit. Every test here compares its
+observable output (op-log dicts, composed dicts, conflict dicts)
+against the pure-Python oracle backend on the same snapshots.
+"""
+import hashlib
+import random
+
+import pytest
+
+from semantic_merge_tpu.backends.base import get_backend, run_merge
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+
+
+def _dicts(ops):
+    return [o.to_dict() for o in ops]
+
+
+def fused_backend():
+    from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+    return TpuTSBackend(mesh=False)  # force the single-device fused path
+
+
+def assert_parity(base, left, right, *, seed="s", base_rev="r",
+                  timestamp="2026-01-02T03:04:05Z"):
+    tpu = fused_backend()
+    host = get_backend("host")
+    res_t, comp_t, conf_t = run_merge(tpu, base, left, right, seed=seed,
+                                      base_rev=base_rev, timestamp=timestamp)
+    res_h, comp_h, conf_h = run_merge(host, base, left, right, seed=seed,
+                                      base_rev=base_rev, timestamp=timestamp)
+    assert _dicts(res_t.op_log_left) == _dicts(res_h.op_log_left)
+    assert _dicts(res_t.op_log_right) == _dicts(res_h.op_log_right)
+    assert _dicts(comp_t) == _dicts(comp_h)
+    assert [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_h]
+    return comp_t, conf_t
+
+
+def snap(files):
+    return Snapshot(files=[{"path": p, "content": c} for p, c in files])
+
+
+def test_sha256_device_matches_hashlib():
+    from semantic_merge_tpu.ops.sha256 import sha256_host_check
+    rng = random.Random(7)
+    for _ in range(24):
+        n = rng.randrange(0, 183)
+        data = bytes(rng.randrange(256) for _ in range(n))
+        blocks = max(1, (n + 9 + 63) // 64)
+        assert sha256_host_check(data, blocks) == hashlib.sha256(data).hexdigest()
+
+
+def test_rename_move_add_delete_parity():
+    base = snap([
+        ("a.ts", "export function f(x: number): number { return x; }\n"
+                 "export function g(y: string): string { return y; }\n"),
+        ("b.ts", "export class C { m(): void {} }\n"),
+        ("c.ts", "export function gone(): void {}\n"),
+    ])
+    left = snap([
+        ("a.ts", "export function renamed(x: number): number { return x; }\n"
+                 "export function g(y: string): string { return y; }\n"),
+        ("b.ts", "export class C { m(): void {} }\n"),
+        ("c.ts", "export function gone(): void {}\n"),
+        ("d.ts", "export function fresh(z: boolean): boolean { return z; }\n"),
+    ])
+    right = snap([
+        ("a.ts", "export function f(x: number): number { return x; }\n"
+                 "export function g(y: string): string { return y; }\n"),
+        ("lib/b.ts", "export class C { m(): void {} }\n"),
+    ])
+    composed, conflicts = assert_parity(base, left, right)
+    assert conflicts == []
+    assert any(o.type == "moveDecl" for o in composed)
+    assert any(o.type == "renameSymbol" for o in composed)
+    assert any(o.type == "addDecl" for o in composed)
+    assert any(o.type == "deleteDecl" for o in composed)
+
+
+def test_divergent_rename_conflict_parity():
+    base = snap([("a.ts", "export function f(x: number): number { return x; }\n")])
+    left = snap([("a.ts", "export function lname(x: number): number { return x; }\n")])
+    right = snap([("a.ts", "export function rname(x: number): number { return x; }\n")])
+    _, conflicts = assert_parity(base, left, right)
+    assert len(conflicts) == 1
+    assert conflicts[0].to_dict()["category"] == "DivergentRename"
+
+
+def test_rename_chain_context_parity():
+    # A renames f; B moves the same symbol's file — the move must carry
+    # renameContext and the chained address, identically on both paths.
+    base = snap([("a.ts", "export function f(x: number): number { return x; }\n")])
+    left = snap([("a.ts", "export function newf(x: number): number { return x; }\n")])
+    right = snap([("lib/a.ts", "export function f(x: number): number { return x; }\n")])
+    composed, _ = assert_parity(base, left, right)
+    types = sorted(o.type for o in composed)
+    # The rename changes the addressId too (addresses embed the name),
+    # so side A emits move+rename; side B's file move adds another move.
+    assert "renameSymbol" in types and "moveDecl" in types
+
+
+def test_bench_workload_parity_with_conflicts():
+    import bench
+    base, left, right = bench.synth_repo(97, 3, divergent=True)
+    _, conflicts = assert_parity(base, left, right, seed="bench",
+                                 base_rev="bench",
+                                 timestamp="2026-01-01T00:00:00Z")
+    assert conflicts, "divergent preset must produce conflicts"
+
+
+def test_bench_workload_parity_clean():
+    import bench
+    base, left, right = bench.synth_repo(60, 4)
+    assert_parity(base, left, right, seed="bench", base_rev="bench",
+                  timestamp="2026-01-01T00:00:00Z")
+
+
+def test_fused_warm_repeat_and_capacity_growth():
+    # Same backend across merges: device decl cache + string table must
+    # not corrupt results; a larger second workload forces capacity
+    # retry inside one engine.
+    import bench
+    tpu = fused_backend()
+    host = get_backend("host")
+    for files in (24, 24, 130):
+        base, left, right = bench.synth_repo(files, 3)
+        res_t, comp_t, conf_t = run_merge(tpu, base, left, right,
+                                          seed="b", base_rev="b")
+        res_h, comp_h, conf_h = run_merge(host, base, left, right,
+                                          seed="b", base_rev="b")
+        assert _dicts(comp_t) == _dicts(comp_h)
+        assert _dicts(res_t.op_log_left) == _dicts(res_h.op_log_left)
+        assert _dicts(res_t.op_log_right) == _dicts(res_h.op_log_right)
+
+
+def test_fused_empty_and_identical_snapshots():
+    empty = snap([])
+    same = snap([("a.ts", "export function f(): void {}\n")])
+    assert_parity(empty, empty, empty)
+    assert_parity(same, same, same)
+
+
+def test_fused_randomized_fuzz_parity():
+    rng = random.Random(3)
+    kinds = ["number", "string", "boolean"]
+    for trial in range(6):
+        n_files = rng.randrange(1, 14)
+        files = {}
+        for i in range(n_files):
+            decls = []
+            for d in range(rng.randrange(1, 4)):
+                t = kinds[rng.randrange(3)]
+                decls.append(f"export function fn{i}_{d}(p: {t}): {t} "
+                             f"{{ return p; }}")
+            files[f"m{i}.ts"] = "\n".join(decls) + "\n"
+
+        def mutate(files, rng):
+            out = {}
+            for p, c in files.items():
+                roll = rng.random()
+                if roll < 0.2:
+                    out["moved/" + p] = c
+                elif roll < 0.4:
+                    out[p] = c.replace("fn", f"rn{rng.randrange(9)}_", 1)
+                elif roll < 0.5:
+                    continue  # delete the file
+                else:
+                    out[p] = c
+            if rng.random() < 0.4:
+                out[f"new{rng.randrange(9)}.ts"] = (
+                    "export function added(q: string): string { return q; }\n")
+            return out
+
+        base = snap(sorted(files.items()))
+        left = snap(sorted(mutate(files, rng).items()))
+        right = snap(sorted(mutate(files, rng).items()))
+        assert_parity(base, left, right, seed=f"t{trial}")

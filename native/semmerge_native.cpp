@@ -478,6 +478,23 @@ static bool is_numeric_literal(std::string_view name) {
   return true;
 }
 
+// Python str.isidentifier() for the ASCII subset the tokenizer emits:
+// letters/underscore start, letters/digits/underscore continue ('$' is
+// an identifier char in JS but NOT in Python — parity with the oracle).
+static bool is_identifier_text(std::string_view s) {
+  if (s.empty()) return false;
+  char c0 = s[0];
+  if (!((c0 >= 'a' && c0 <= 'z') || (c0 >= 'A' && c0 <= 'Z') || c0 == '_'))
+    return false;
+  for (size_t k = 1; k < s.size(); k++) {
+    char c = s[k];
+    if (!((c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || is_digit(c) ||
+          c == '_'))
+      return false;
+  }
+  return true;
+}
+
 static std::string join(const std::vector<std::string_view>& parts,
                         const char* sep) {
   std::string out;
@@ -490,6 +507,7 @@ static std::string join(const std::vector<std::string_view>& parts,
 
 static std::string render_type_text(const std::vector<std::string_view>& parts,
                                     const StrSet& declared) {
+  if (parts.empty()) return "any";  // e.g. trailing comma's empty element
   // Union / intersection at top level.
   for (const char* op : {"|", "&"}) {
     auto pieces = split_top(parts, op);
@@ -530,7 +548,54 @@ static std::string render_type_text(const std::vector<std::string_view>& parts,
   if (!parts.empty() && !PRIMITIVE_TYPES.count(parts[0]) && parts.size() >= 2 &&
       parts[1] == "<")
     return declared.count(std::string(parts[0])) ? std::string(parts[0]) : "any";
-  return join(parts, " ");
+  // Qualified name ``Ns.Thing`` — namespaces are not indexed decl kinds,
+  // so the no-default-lib checker cannot resolve the root: "any".
+  if (parts.size() >= 3 && parts.size() % 2 == 1) {
+    bool qualified = true;
+    for (size_t k = 1; k < parts.size(); k += 2)
+      if (parts[k] != ".") { qualified = false; break; }
+    if (qualified)
+      for (size_t k = 0; k < parts.size(); k += 2)
+        if (!is_identifier_text(parts[k])) { qualified = false; break; }
+    if (qualified) return "any";
+  }
+  // Tuple type ``[A, B]`` — render element-wise like the checker.
+  if (!parts.empty() && parts[0] == "[" && parts.back() == "]" &&
+      parts.size() > 2) {
+    std::vector<std::string_view> inner(parts.begin() + 1, parts.end() - 1);
+    auto elems = split_top(inner, ",");
+    std::string out = "[";
+    bool first = true;
+    for (auto& elem : elems) {
+      if (elem.empty()) continue;  // trailing comma's empty element drops
+      if (!first) out += ", ";
+      first = false;
+      out += render_type_text(elem, declared);
+    }
+    return out + "]";
+  }
+  // Fallback display with checker-style punctuation spacing: no space
+  // before ":,;.)]>", none after "([<.".
+  std::vector<std::string> grouped;
+  for (const auto& p : parts) {
+    bool attach = false;
+    if (!grouped.empty()) {
+      char last = grouped.back().back();
+      if (p == "," || p == ";" || p == ":" || p == ")" || p == "]" ||
+          p == ">" || p == ".")
+        attach = true;
+      else if (last == '(' || last == '[' || last == '<' || last == '.')
+        attach = true;
+    }
+    if (attach) grouped.back().append(p.data(), p.size());
+    else grouped.emplace_back(p);
+  }
+  std::string out;
+  for (size_t k = 0; k < grouped.size(); k++) {
+    if (k) out += " ";
+    out += grouped[k];
+  }
+  return out;
 }
 
 static std::string render_type(const std::vector<const Token*>& type_toks,
@@ -583,20 +648,27 @@ static std::vector<std::string> parse_param_types(
   return types;
 }
 
+// A depth-0 "{" after one of these continues the type (object-literal
+// type position); after a completed type atom it opens the body.
+static const StrSet TYPE_EXPECTED_AFTER = {":", "|", "&", "(", ",", "<", "=>",
+                                           "extends", "keyof", "readonly", "?"};
+
 static std::pair<std::vector<const Token*>, int> collect_type_tokens(
     const TokVec& toks, int i, const StrSet& stop) {
   std::vector<const Token*> out;
   int depth = 0;
   int n = int(toks.size());
+  bool expecting = true;  // start of annotation: a type is expected
   while (i < n) {
     const Token& t = toks[i];
     std::string txt(t.text);
-    if (depth == 0 && stop.count(txt)) break;
+    if (depth == 0 && stop.count(txt) && !(txt == "{" && expecting)) break;
     if (t.text == "(" || t.text == "[" || t.text == "<" || t.text == "{") depth += 1;
     else if (t.text == ")" || t.text == "]" || t.text == ">" || t.text == "}") {
       if (depth == 0) break;
       depth -= 1;
     }
+    expecting = TYPE_EXPECTED_AFTER.count(txt) != 0;
     out.push_back(&t);
     i += 1;
   }

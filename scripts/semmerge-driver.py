@@ -46,10 +46,25 @@ def run(cmd: list[str], cwd: str | None = None) -> str:
     return proc.stdout.strip()
 
 
-def incoming_head(repo_root: pathlib.Path) -> str | None:
-    """The rev being merged in: MERGE_HEAD for merges, REBASE_HEAD /
-    CHERRY_PICK_HEAD when the driver fires during rebase or
-    cherry-pick (git never sets a GITHEAD_REF env var)."""
+def incoming_head(repo_root: pathlib.Path, head: str) -> str | None:
+    """The rev being merged in.
+
+    While ``git merge`` is *running* its strategies, ``MERGE_HEAD`` does
+    not exist yet (it is written only when the merge stops for conflicts
+    or a commit); what git gives merge drivers is a ``GITHEAD_<sha>``
+    environment variable per head being merged. So: a single non-HEAD
+    ``GITHEAD_*`` sha wins (the normal two-head merge); otherwise fall
+    back to the on-disk refs, which cover rebase (``REBASE_HEAD``),
+    cherry-pick (``CHERRY_PICK_HEAD``) and ``git merge --continue``
+    flows. Octopus merges (several incoming heads) return ``None`` —
+    the driver leaves those files conflicted rather than guessing."""
+    githeads = [key[len("GITHEAD_"):] for key in os.environ
+                if key.startswith("GITHEAD_")]
+    others = sorted({sha for sha in githeads if sha != head})
+    if len(others) == 1:
+        return others[0]
+    if len(others) > 1:
+        return None
     for ref in ("MERGE_HEAD", "REBASE_HEAD", "CHERRY_PICK_HEAD"):
         proc = subprocess.run(["git", "rev-parse", "--verify", "--quiet", ref],
                               cwd=repo_root, stdout=subprocess.PIPE, text=True)
@@ -68,7 +83,7 @@ def main() -> None:
 
     repo_root = pathlib.Path(run(["git", "rev-parse", "--show-toplevel"]))
     head = run(["git", "rev-parse", "HEAD"])
-    merge_head = incoming_head(repo_root)
+    merge_head = incoming_head(repo_root, head)
     if merge_head is None:
         # No merge in progress that we understand: leave the file
         # conflicted rather than guessing.

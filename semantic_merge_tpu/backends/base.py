@@ -63,7 +63,8 @@ def host_compose(delta_a: List[Op], delta_b: List[Op]):
 def run_merge(backend: Backend, base: Snapshot, left: Snapshot,
               right: Snapshot, *, base_rev: str = "base", seed: str = "0",
               timestamp: str | None = None, change_signature: bool = False,
-              structured_apply: bool = False, phases: Dict | None = None):
+              structured_apply: bool = False, signature_matcher=None,
+              phases: Dict | None = None):
     """Full 3-way merge through a backend: uses the backend's fused
     ``merge`` entry point when it has one (the TPU backend's
     one-round-trip program), otherwise ``build_and_diff`` + ``compose``.
@@ -72,12 +73,14 @@ def run_merge(backend: Backend, base: Snapshot, left: Snapshot,
     if merge is not None:
         return merge(base, left, right, base_rev=base_rev, seed=seed,
                      timestamp=timestamp, change_signature=change_signature,
-                     structured_apply=structured_apply, phases=phases)
+                     structured_apply=structured_apply,
+                     signature_matcher=signature_matcher, phases=phases)
     import time
     t0 = time.perf_counter()
     result = backend.build_and_diff(
         base, left, right, base_rev=base_rev, seed=seed, timestamp=timestamp,
-        change_signature=change_signature, structured_apply=structured_apply)
+        change_signature=change_signature, structured_apply=structured_apply,
+        signature_matcher=signature_matcher)
     if phases is not None:
         phases["build_and_diff"] = (phases.get("build_and_diff", 0.0)
                                     + time.perf_counter() - t0)

@@ -34,20 +34,25 @@ class CFamilyBackend:
                        *, base_rev: str = "base", seed: str = "0",
                        timestamp: str | None = None,
                        change_signature: bool = False,
-                       structured_apply: bool = False) -> BuildAndDiffResult:
+                       structured_apply: bool = False,
+                       signature_matcher=None) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot_cfamily(self._filter(base), self.spec)
         left_nodes = scan_snapshot_cfamily(self._filter(left), self.spec)
         right_nodes = scan_snapshot_cfamily(self._filter(right), self.spec)
         diffs_l = diff_nodes(base_nodes, left_nodes)
         diffs_r = diff_nodes(base_nodes, right_nodes)
-        if change_signature:
-            diffs_l = refine_signature_changes(diffs_l)
-            diffs_r = refine_signature_changes(diffs_r)
+        want_sources = structured_apply or (change_signature
+                                            and signature_matcher is not None)
         src_l = (source_maps(self._filter(base), self._filter(left))
-                 if structured_apply else None)
+                 if want_sources else None)
         src_r = (source_maps(self._filter(base), self._filter(right))
-                 if structured_apply else None)
+                 if want_sources else None)
+        if change_signature:
+            diffs_l = refine_signature_changes(diffs_l, src_l, signature_matcher)
+            diffs_r = refine_signature_changes(diffs_r, src_r, signature_matcher)
+        if not structured_apply:
+            src_l = src_r = None
         return BuildAndDiffResult(
             op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts,
                              sources=src_l),
@@ -64,15 +69,20 @@ class CFamilyBackend:
              *, base_rev: str = "base", seed: str = "0",
              timestamp: str | None = None,
              change_signature: bool = False,
-             structured_apply: bool = False) -> List[Op]:
+             structured_apply: bool = False,
+             signature_matcher=None) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot_cfamily(self._filter(base), self.spec)
         right_nodes = scan_snapshot_cfamily(self._filter(right), self.spec)
         diffs = diff_nodes(base_nodes, right_nodes)
-        if change_signature:
-            diffs = refine_signature_changes(diffs)
+        want_sources = structured_apply or (change_signature
+                                            and signature_matcher is not None)
         sources = (source_maps(self._filter(base), self._filter(right))
-                   if structured_apply else None)
+                   if want_sources else None)
+        if change_signature:
+            diffs = refine_signature_changes(diffs, sources, signature_matcher)
+        if not structured_apply:
+            sources = None
         return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts,
                     sources=sources)
 

@@ -1,0 +1,106 @@
+"""Per-language routing: one merge, several language backends.
+
+The reference's ``semmerge/lang/`` plugin slot implies per-file-type
+dispatch, but its CLI binds a single bridge per run — a mixed
+``.ts``+``.java`` repository semantically merges only one language.
+Here a :class:`MultiBackend` fans the snapshot out to every routed
+backend (each filters to its own extensions internally), concatenates
+the per-language op logs in deterministic backend order, and composes
+the combined log once — so one ``semmerge`` invocation semantically
+merges every enabled language, with the text fallback covering only
+genuinely un-indexed files.
+
+Selected by the CLI when ``.semmerge.toml`` enables languages beyond
+TypeScript (``[languages.java] enabled = true``); the ``[engine]
+backend`` choice (host/tpu) still powers the TypeScript route.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core.ops import Op
+from ..frontend.snapshot import Snapshot
+from .base import BuildAndDiffResult, host_compose
+
+#: ``[languages.<name>]`` config key → registered backend name.
+LANGUAGE_BACKENDS: Dict[str, str] = {
+    "java": "java",
+    "csharp": "cs",
+    "cs": "cs",
+}
+
+
+class MultiBackend:
+    name = "multi"
+
+    def __init__(self, backends: List) -> None:
+        assert backends, "MultiBackend needs at least one backend"
+        self.backends = backends
+        exts: set = set()
+        for b in backends:
+            exts |= set(getattr(b, "extensions", ()) or ())
+        self.extensions = frozenset(exts)
+        # Capability union: device-batched CRDT apply stays on when any
+        # routed backend provides it.
+        self.device_crdt = any(getattr(b, "device_crdt", False)
+                               for b in backends)
+
+    def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
+                       **kwargs) -> BuildAndDiffResult:
+        results = [b.build_and_diff(base, left, right, **kwargs)
+                   for b in self.backends]
+        merged = BuildAndDiffResult(
+            op_log_left=[], op_log_right=[],
+            symbol_maps={"base": [], "left": [], "right": []})
+        for r in results:
+            merged.op_log_left.extend(r.op_log_left)
+            merged.op_log_right.extend(r.op_log_right)
+            for k in merged.symbol_maps:
+                merged.symbol_maps[k].extend(r.symbol_maps.get(k, []))
+            merged.diagnostics.extend(r.diagnostics)
+        return merged
+
+    def diff(self, base: Snapshot, right: Snapshot, **kwargs) -> List[Op]:
+        ops: List[Op] = []
+        for b in self.backends:
+            ops.extend(b.diff(base, right, **kwargs))
+        return ops
+
+    def compose(self, delta_a: List[Op], delta_b: List[Op]):
+        """One composition over the combined multi-language log — chain
+        state and conflict detection see every op, exactly as a single
+        backend would (symbol ids are signature hashes, so languages
+        interleave without a namespace)."""
+        for b in self.backends:
+            compose = getattr(b, "compose", None)
+            if compose is not None:
+                return compose(delta_a, delta_b)
+        return host_compose(delta_a, delta_b)
+
+    def configure(self, config) -> None:
+        for b in self.backends:
+            configure = getattr(b, "configure", None)
+            if configure is not None:
+                configure(config)
+
+    def close(self) -> None:
+        for b in self.backends:
+            b.close()
+
+
+def route_backends(primary, config) -> "MultiBackend | None":
+    """Build the multi-language route from config: the primary backend
+    (TypeScript engine choice) plus one backend per additionally
+    enabled language, in deterministic name order. ``None`` when no
+    extra language is enabled (single-backend fast path)."""
+    from .base import get_backend
+
+    extra: List[str] = []
+    for lang, lcfg in sorted(config.languages.items()):
+        backend_name = LANGUAGE_BACKENDS.get(lang)
+        if backend_name and getattr(lcfg, "enabled", False):
+            if backend_name not in extra:
+                extra.append(backend_name)
+    if not extra:
+        return None
+    return MultiBackend([primary, *[get_backend(n) for n in extra]])

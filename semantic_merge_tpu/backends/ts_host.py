@@ -36,18 +36,23 @@ class HostTSBackend:
                        *, base_rev: str = "base", seed: str = "0",
                        timestamp: str | None = None,
                        change_signature: bool = False,
-                       structured_apply: bool = False) -> BuildAndDiffResult:
+                       structured_apply: bool = False,
+                       signature_matcher=None) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(ts_files(base))
         left_nodes = scan_snapshot(ts_files(left))
         right_nodes = scan_snapshot(ts_files(right))
         diffs_l = diff_nodes(base_nodes, left_nodes)
         diffs_r = diff_nodes(base_nodes, right_nodes)
+        want_sources = structured_apply or (change_signature
+                                            and signature_matcher is not None)
+        src_l = source_maps(ts_files(base), ts_files(left)) if want_sources else None
+        src_r = source_maps(ts_files(base), ts_files(right)) if want_sources else None
         if change_signature:
-            diffs_l = refine_signature_changes(diffs_l)
-            diffs_r = refine_signature_changes(diffs_r)
-        src_l = source_maps(ts_files(base), ts_files(left)) if structured_apply else None
-        src_r = source_maps(ts_files(base), ts_files(right)) if structured_apply else None
+            diffs_l = refine_signature_changes(diffs_l, src_l, signature_matcher)
+            diffs_r = refine_signature_changes(diffs_r, src_r, signature_matcher)
+        if not structured_apply:
+            src_l = src_r = None
         return BuildAndDiffResult(
             op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts,
                              sources=src_l),
@@ -64,14 +69,19 @@ class HostTSBackend:
              *, base_rev: str = "base", seed: str = "0",
              timestamp: str | None = None,
              change_signature: bool = False,
-             structured_apply: bool = False) -> List[Op]:
+             structured_apply: bool = False,
+             signature_matcher=None) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(ts_files(base))
         right_nodes = scan_snapshot(ts_files(right))
         diffs = diff_nodes(base_nodes, right_nodes)
+        want_sources = structured_apply or (change_signature
+                                            and signature_matcher is not None)
+        sources = source_maps(ts_files(base), ts_files(right)) if want_sources else None
         if change_signature:
-            diffs = refine_signature_changes(diffs)
-        sources = source_maps(ts_files(base), ts_files(right)) if structured_apply else None
+            diffs = refine_signature_changes(diffs, sources, signature_matcher)
+        if not structured_apply:
+            sources = None
         return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts,
                     sources=sources)
 

@@ -35,6 +35,9 @@ from .base import BuildAndDiffResult, register_backend, symbol_map
 class TpuTSBackend:
     name = "tpu"
     extensions = frozenset(TS_EXTENSIONS)
+    #: The applier batches CRDT materialization on device for this
+    #: backend (capability flag — survives MultiBackend wrapping).
+    device_crdt = True
 
     def __init__(self, mesh=None) -> None:
         # Probe JAX init at construction so the CLI's host-fallback path
@@ -124,7 +127,8 @@ class TpuTSBackend:
                        *, base_rev: str = "base", seed: str = "0",
                        timestamp: str | None = None,
                        change_signature: bool = False,
-                       structured_apply: bool = False) -> BuildAndDiffResult:
+                       structured_apply: bool = False,
+                       signature_matcher=None) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         self._maybe_reset_interner()
         base_t, base_nodes = self._scan_encode(base)
@@ -133,11 +137,15 @@ class TpuTSBackend:
         t_l, t_r = self._diff_pair_fn()(base_t, left_t, right_t)
         diffs_l = decode_diffs(t_l, base_t, left_t, base_nodes, left_nodes)
         diffs_r = decode_diffs(t_r, base_t, right_t, base_nodes, right_nodes)
+        want_sources = structured_apply or (change_signature
+                                            and signature_matcher is not None)
+        src_l = source_maps(ts_files(base), ts_files(left)) if want_sources else None
+        src_r = source_maps(ts_files(base), ts_files(right)) if want_sources else None
         if change_signature:
-            diffs_l = refine_signature_changes(diffs_l)
-            diffs_r = refine_signature_changes(diffs_r)
-        src_l = source_maps(ts_files(base), ts_files(left)) if structured_apply else None
-        src_r = source_maps(ts_files(base), ts_files(right)) if structured_apply else None
+            diffs_l = refine_signature_changes(diffs_l, src_l, signature_matcher)
+            diffs_r = refine_signature_changes(diffs_r, src_r, signature_matcher)
+        if not structured_apply:
+            src_l = src_r = None
         return BuildAndDiffResult(
             op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts,
                              sources=src_l),
@@ -154,16 +162,21 @@ class TpuTSBackend:
              *, base_rev: str = "base", seed: str = "0",
              timestamp: str | None = None,
              change_signature: bool = False,
-             structured_apply: bool = False) -> List[Op]:
+             structured_apply: bool = False,
+             signature_matcher=None) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         self._maybe_reset_interner()
         base_t, base_nodes = self._scan_encode(base)
         right_t, right_nodes = self._scan_encode(right)
         t = self._diff_fn()(base_t, right_t)
         diffs = decode_diffs(t, base_t, right_t, base_nodes, right_nodes)
+        want_sources = structured_apply or (change_signature
+                                            and signature_matcher is not None)
+        sources = source_maps(ts_files(base), ts_files(right)) if want_sources else None
         if change_signature:
-            diffs = refine_signature_changes(diffs)
-        sources = source_maps(ts_files(base), ts_files(right)) if structured_apply else None
+            diffs = refine_signature_changes(diffs, sources, signature_matcher)
+        if not structured_apply:
+            sources = None
         return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts,
                     sources=sources)
 
@@ -179,6 +192,7 @@ class TpuTSBackend:
               timestamp: str | None = None,
               change_signature: bool = False,
               structured_apply: bool = False,
+              signature_matcher=None,
               phases: Dict | None = None):
         """Full 3-way merge in ONE device round trip when eligible (see
         :mod:`semantic_merge_tpu.ops.fused`): diff, deterministic op
@@ -217,7 +231,8 @@ class TpuTSBackend:
         result = self.build_and_diff(
             base, left, right, base_rev=base_rev, seed=seed, timestamp=ts,
             change_signature=change_signature,
-            structured_apply=structured_apply)
+            structured_apply=structured_apply,
+            signature_matcher=signature_matcher)
         if phases is not None:
             phases["build_and_diff"] = (phases.get("build_and_diff", 0.0)
                                         + time.perf_counter() - t0)

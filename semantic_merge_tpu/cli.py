@@ -49,9 +49,15 @@ def build_parser() -> argparse.ArgumentParser:
     p_diff.add_argument("--json-out", action="store_true", help="Emit JSON instead of a pretty listing")
     p_diff.add_argument("--backend", default=None, help="Language backend (host|tpu)")
     p_diff.add_argument("--trace", action="store_true", help="Write .semmerge-trace.json")
+    p_diff.add_argument("--profile", metavar="DIR", default=None,
+                        help="Capture a JAX profiler trace into DIR "
+                             "(phases annotated for TensorBoard/XProf)")
     p_diff.add_argument("--change-signature", action="store_true",
                         help="Detect changeSignature ops instead of delete+add "
                              "(also [engine].change_signature in .semmerge.toml)")
+    p_diff.add_argument("--signature-matcher", action="store_true",
+                        help="Pair renamed+retyped decls by embedding "
+                             "similarity (also [engine].signature_matcher)")
 
     p_merge = sub.add_parser("semmerge", help="Semantic merge base A B into working tree")
     p_merge.add_argument("base")
@@ -63,10 +69,16 @@ def build_parser() -> argparse.ArgumentParser:
                          help="Flag set when invoked via git merge driver")
     p_merge.add_argument("--backend", default=None, help="Language backend (host|tpu)")
     p_merge.add_argument("--trace", action="store_true", help="Write .semmerge-trace.json")
+    p_merge.add_argument("--profile", metavar="DIR", default=None,
+                         help="Capture a JAX profiler trace into DIR "
+                              "(phases annotated for TensorBoard/XProf)")
     p_merge.add_argument("--seed", default=None, help="Deterministic id seed override")
     p_merge.add_argument("--change-signature", action="store_true",
                          help="Detect changeSignature ops instead of delete+add "
                               "(also [engine].change_signature in .semmerge.toml)")
+    p_merge.add_argument("--signature-matcher", action="store_true",
+                         help="Pair renamed+retyped decls by embedding "
+                              "similarity (also [engine].signature_matcher)")
     p_merge.add_argument("--strict-conflicts", action="store_true",
                          help="Detect all [CFR-002] conflict categories via a "
                               "full symbol join (also [engine].conflict_mode)")
@@ -131,14 +143,37 @@ def _resolve_backend(name_flag: str | None):
             logger.warning("Backend %r unavailable (%s); falling back to host", name, exc)
             return get_backend("host"), config
         raise
+    # Additional enabled languages route through a composite backend:
+    # one run semantically merges every enabled language.
+    from .backends.multi import route_backends
+    try:
+        multi = route_backends(backend, config)
+    except Exception as exc:
+        logger.warning("language routing failed (%s); single backend", exc)
+        multi = None
+    if multi is not None:
+        backend = multi
     configure = getattr(backend, "configure", None)
     if configure is not None:
         configure(config)
     return backend, config
 
 
+def _signature_matcher(args, config, change_sig):
+    """Build the embedding matcher when enabled (CLI flag or config)."""
+    if not change_sig:
+        return None
+    if not (getattr(args, "signature_matcher", False)
+            or config.engine.signature_matcher):
+        return None
+    from .models.signature import EmbeddingSignatureMatcher
+    return EmbeddingSignatureMatcher(
+        threshold=config.engine.signature_threshold,
+        ckpt_dir=config.engine.matcher_ckpt_dir)
+
+
 def cmd_semdiff(args: argparse.Namespace) -> int:
-    tracer = Tracer(enabled=args.trace)
+    tracer = Tracer(enabled=args.trace, profile_dir=args.profile)
     backend, config = _resolve_backend(args.backend)
     change_sig = args.change_signature or config.engine.change_signature
     try:
@@ -149,9 +184,12 @@ def cmd_semdiff(args: argparse.Namespace) -> int:
             ops = backend.diff(base_snap, right_snap,
                                base_rev=resolve_rev(args.rev1),
                                timestamp=commit_timestamp_iso(args.rev2),
-                               change_signature=change_sig)
+                               change_signature=change_sig,
+                               signature_matcher=_signature_matcher(
+                                   args, config, change_sig))
     finally:
         backend.close()
+        tracer.close()
     if args.json_out:
         print(json.dumps([op.to_dict() for op in ops], indent=2))
     else:
@@ -163,7 +201,7 @@ def cmd_semdiff(args: argparse.Namespace) -> int:
 
 def cmd_semmerge(args: argparse.Namespace) -> int:
     logger.info("Starting semantic merge base=%s A=%s B=%s", args.base, args.a, args.b)
-    tracer = Tracer(enabled=args.trace)
+    tracer = Tracer(enabled=args.trace, profile_dir=args.profile)
     backend, config = _resolve_backend(args.backend)
     merged_tree: pathlib.Path | None = None
     try:
@@ -186,6 +224,7 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                       or config.engine.structured_apply)
         strict = (getattr(args, "strict_conflicts", False)
                   or config.engine.conflict_mode == "strict")
+        sig_matcher = _signature_matcher(args, config, change_sig)
         if not strict:
             # The normal path goes through the backend's fused merge
             # entry point — on the TPU backend that is one device
@@ -195,7 +234,8 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                 result, composed, conflicts = run_merge(
                     backend, base_snap, left_snap, right_snap,
                     base_rev=base_rev, seed=seed, timestamp=timestamp,
-                    change_signature=change_sig, structured_apply=structured)
+                    change_signature=change_sig, structured_apply=structured,
+                    signature_matcher=sig_matcher)
         else:
             # Strict conflict detection inspects the raw op logs between
             # diff and compose, so it needs the two-step path.
@@ -203,7 +243,8 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                 result = backend.build_and_diff(
                     base_snap, left_snap, right_snap,
                     base_rev=base_rev, seed=seed, timestamp=timestamp,
-                    change_signature=change_sig, structured_apply=structured)
+                    change_signature=change_sig, structured_apply=structured,
+                    signature_matcher=sig_matcher)
             with tracer.phase("compose"):
                 from .core.strict_conflicts import detect_conflicts_strict
                 ops_left, ops_right, conflicts = detect_conflicts_strict(
@@ -233,7 +274,11 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
             from .runtime.git import extract_tree_to_temp
             base_tree = extract_tree_to_temp(base_tar)
             try:
-                merged_tree = apply_ops(base_tree, composed)
+                # tpu backend: the merge's reorderImports RGA lists
+                # materialize as one batched device program.
+                merged_tree = apply_ops(
+                    base_tree, composed,
+                    device_crdt=getattr(backend, "device_crdt", False))
             finally:
                 _cleanup([base_tree])
             deleted_paths: list = []
@@ -279,6 +324,7 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
         return 0
     finally:
         backend.close()
+        tracer.close()
         if merged_tree is not None:
             _cleanup([merged_tree])
 

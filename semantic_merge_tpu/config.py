@@ -63,6 +63,11 @@ class EngineConfig:
     structured_apply: bool = False
     max_nodes_per_bucket: int = 2048
     mesh_shape: str = "auto"
+    # Model-scored changeSignature pairing for renamed+retyped decls
+    # (reference design architecture.md:145-153; needs change_signature).
+    signature_matcher: bool = False
+    signature_threshold: float = 0.85
+    matcher_ckpt_dir: str | None = None
 
 
 @dataclass
@@ -130,6 +135,12 @@ def load_config(start: pathlib.Path | None = None) -> Config:
             engine.get("max_nodes_per_bucket", config.engine.max_nodes_per_bucket)
         ),
         mesh_shape=str(engine.get("mesh_shape", config.engine.mesh_shape)),
+        signature_matcher=bool(
+            engine.get("signature_matcher", config.engine.signature_matcher)),
+        signature_threshold=float(
+            engine.get("signature_threshold", config.engine.signature_threshold)),
+        matcher_ckpt_dir=(str(engine["matcher_ckpt_dir"])
+                          if engine.get("matcher_ckpt_dir") else None),
     )
 
     for lang, ldata in data.get("languages", {}).items():

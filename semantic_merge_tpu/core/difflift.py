@@ -60,7 +60,8 @@ def diff_nodes(base: List[DeclNode], side: List[DeclNode]) -> List[Diff]:
     return diffs
 
 
-def refine_signature_changes(diffs: List[Diff]) -> List[Diff]:
+def refine_signature_changes(diffs: List[Diff], sources=None,
+                             matcher=None) -> List[Diff]:
     """Fold residual ``delete``+``add`` pairs into ``changeSig`` diffs.
 
     The reference declares a ``changeSig`` diff kind but never produces
@@ -72,12 +73,20 @@ def refine_signature_changes(diffs: List[Diff]) -> List[Diff]:
     ``(file, name, kind)`` (names non-null) are the same declaration
     with a changed signature.
 
+    With ``matcher`` (an
+    :class:`semantic_merge_tpu.models.signature.EmbeddingSignatureMatcher`)
+    and ``sources`` (a :func:`source_maps` pair), a second pass scores
+    the *residual* deletes/adds — declarations that were renamed AND
+    retyped, which no key can pair — by embedding similarity
+    (reference design ``architecture.md:145-153``).
+
     Deterministic pairing: the k-th delete with a given key pairs with
-    the k-th add with that key. The ``changeSig`` takes the delete's
-    position in the stream; the paired add is dropped (later op ids
-    re-index, which is why this pass must run identically in every
-    backend — it is opt-in precisely because parity-with-reference mode
-    must keep the delete+add shape).
+    the k-th add with that key; model pairs break ties by score then
+    stream position. The ``changeSig`` takes the delete's position in
+    the stream; the paired add is dropped (later op ids re-index, which
+    is why this pass must run identically in every backend — it is
+    opt-in precisely because parity-with-reference mode must keep the
+    delete+add shape).
     """
     # Pass 1: pair each eligible delete (stream order) with the next
     # unconsumed eligible add sharing its key.
@@ -94,6 +103,38 @@ def refine_signature_changes(diffs: List[Diff]) -> List[Diff]:
                 add_idx = queue.pop(0)
                 paired[idx] = add_idx
                 consumed.add(add_idx)
+
+    # Pass 1b: model-scored pairing of the residuals.
+    if matcher is not None and sources is not None:
+        base_map, side_map = sources
+        # Candidates are keyed by (kind, file): a changeSignature op's
+        # structured-apply spans are base offsets in the delete's file,
+        # so a cross-file pair could never materialize correctly — a
+        # decl moved AND retyped stays delete+add.
+        res_del: List[int] = []
+        del_items: List[tuple] = []
+        for idx, d in enumerate(diffs):
+            if (d.kind == "delete" and idx not in paired
+                    and d.a is not None and d.a.name):
+                src = base_map.get(d.a.file)
+                if src is not None:
+                    res_del.append(idx)
+                    del_items.append(((d.a.kind, d.a.file),
+                                      src[d.a.pos:d.a.end]))
+        res_add: List[int] = []
+        add_items: List[tuple] = []
+        for idx, d in enumerate(diffs):
+            if (d.kind == "add" and idx not in consumed
+                    and d.b is not None and d.b.name):
+                src = side_map.get(d.b.file)
+                if src is not None:
+                    res_add.append(idx)
+                    add_items.append(((d.b.kind, d.b.file),
+                                      src[d.b.pos:d.b.end]))
+        for di, aj in matcher.pair(del_items, add_items):
+            paired[res_del[di]] = res_add[aj]
+            consumed.add(res_add[aj])
+
     # Pass 2: rebuild the stream.
     out: List[Diff] = []
     for idx, d in enumerate(diffs):

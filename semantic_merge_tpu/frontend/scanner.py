@@ -504,14 +504,28 @@ def _annotation_of(ptoks: List[Token]) -> List[Token]:
     return ptoks[start:] if start is not None else []
 
 
+#: A depth-0 ``{`` after one of these tokens continues the type (an
+#: object-literal type is expected there); after anything else it opens
+#: the declaration body.
+_TYPE_EXPECTED_AFTER = {":", "|", "&", "(", ",", "<", "=>", "extends", "keyof",
+                        "readonly", "?"}
+
+
 def _collect_type_tokens(toks: List[Token], i: int, stop: set[str]) -> tuple[List[Token], int]:
-    """Collect annotation tokens from *i* until a depth-0 stop token."""
+    """Collect annotation tokens from *i* until a depth-0 stop token.
+
+    ``{`` is positional: directly after ``:`` / ``|`` / ``&`` / ``(`` /
+    ``,`` / ``<`` it begins an object-literal *type* (``): { ok:
+    boolean } {``); after a completed type atom it is the declaration
+    body and stops collection — the distinction ``tsc``'s parser makes
+    grammatically."""
     out: List[Token] = []
     depth = 0
     n = len(toks)
+    expecting = True  # start of annotation: a type is expected
     while i < n:
         t = toks[i]
-        if depth == 0 and t.text in stop:
+        if depth == 0 and t.text in stop and not (t.text == "{" and expecting):
             break
         if t.text in ("(", "[", "<", "{"):
             depth += 1
@@ -519,6 +533,7 @@ def _collect_type_tokens(toks: List[Token], i: int, stop: set[str]) -> tuple[Lis
             if depth == 0:
                 break
             depth -= 1
+        expecting = t.text in _TYPE_EXPECTED_AFTER
         out.append(t)
         i += 1
     return out, i
@@ -538,6 +553,8 @@ def _render_type_text(parts: List[str], declared: set[str]) -> str:
     it with no default library loaded: in-snapshot type references keep
     their name, unresolved references collapse to ``any``, primitives as
     written, ``T[]`` arrays, `` | `` / `` & `` spacing."""
+    if not parts:  # e.g. a trailing comma's empty tuple element
+        return "any"
     # Union / intersection at top level.
     for op in ("|", "&"):
         pieces = _split_top(parts, op)
@@ -563,9 +580,32 @@ def _render_type_text(parts: List[str], declared: set[str]) -> str:
     # (including Array/Promise), so it displays as ``any`` unless declared.
     if parts[0] not in _PRIMITIVE_TYPES and len(parts) >= 2 and parts[1] == "<":
         return parts[0] if parts[0] in declared else "any"
-    # Literal object type, tuple, function type, …: not reproduced
-    # structurally; display as written with minimal spacing.
-    return " ".join(parts)
+    # Qualified name ``Ns.Thing``: namespaces are not indexed decl kinds,
+    # so the reference's no-default-lib checker cannot resolve the root
+    # — it displays ``any`` (e.g. ``JSX.Element`` in a bare snapshot).
+    if (len(parts) >= 3 and len(parts) % 2 == 1
+            and all(p == "." for p in parts[1::2])
+            and all(p.isidentifier() for p in parts[::2])):
+        return "any"
+    # Tuple type ``[A, B]``: render element-wise like the checker
+    # (a trailing comma's empty element drops, as tsc displays it).
+    if parts[0] == "[" and parts[-1] == "]":
+        inner = parts[1:-1]
+        if inner:
+            elems = [_render_type_text(p, declared)
+                     for p in _split_top(inner, ",") if p]
+            return f"[{', '.join(elems)}]"
+    # Literal object type, function type, …: not reproduced
+    # structurally; display as written with checker-style punctuation
+    # spacing (no space before ``:,;.)]>``, none after ``([<.``).
+    out: List[str] = []
+    for p in parts:
+        if out and (p in (",", ";", ":", ")", "]", ">", ".")
+                    or out[-1][-1] in "([<."):
+            out[-1] += p
+        else:
+            out.append(p)
+    return " ".join(out)
 
 
 def _split_top(parts: List[str], sep: str) -> List[List[str]]:

@@ -43,11 +43,26 @@ class EncoderConfig:
     # "ring" (K/V chunks rotate via ppermute; O(L/n) memory) or
     # "ulysses" (head/sequence all-to-all; full-L per head subset).
     attn_mode: str = "ring"
+    # FFN mixture mode over the `ep`-sharded expert axis:
+    # "soft"  — expert-sharded dense mixture: every expert computes,
+    #           outputs blend by the gate (static, routing-free);
+    # "topk"  — routed expert parallelism: GShard-style top-k routing
+    #           with capacity-bounded one-hot dispatch/combine; tokens
+    #           move to their experts through the einsum contractions,
+    #           which XLA lowers to all-to-all over `ep`.
+    moe_mode: str = "soft"
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     def __post_init__(self):
         if self.attn_mode not in ("ring", "ulysses"):
             raise ValueError(
                 f"attn_mode must be 'ring' or 'ulysses', got {self.attn_mode!r}")
+        if self.moe_mode not in ("soft", "topk"):
+            raise ValueError(
+                f"moe_mode must be 'soft' or 'topk', got {self.moe_mode!r}")
+        if not (1 <= self.moe_top_k <= self.n_experts):
+            raise ValueError("moe_top_k must be in [1, n_experts]")
 
 
 def init_encoder(rng: jax.Array, cfg: EncoderConfig) -> Dict[str, jax.Array]:
@@ -103,6 +118,62 @@ def _rms_norm(x, scale):
     return (x32 / rms * scale).astype(x.dtype)
 
 
+def _routed_moe(h: jax.Array, gate_logits: jax.Array, w1: jax.Array,
+                w2: jax.Array, cfg: EncoderConfig,
+                mask: jax.Array | None = None) -> jax.Array:
+    """Routed expert parallelism: top-k gating with capacity-bounded
+    one-hot dispatch/combine (the GShard/Switch formulation).
+
+    Every shape is static: each expert owns ``C = ceil(capacity_factor
+    * k * tokens / E)`` slots; a token beyond its expert's capacity is
+    dropped for that pick (its combine weight is zero, so it simply
+    contributes no FFN delta — the residual stream carries it). The
+    ``e`` axis of the dispatched activations inherits the ``ep``
+    sharding of ``w1``/``w2`` through the einsum contractions, which
+    XLA lowers to all-to-all dispatch/combine over the mesh.
+    """
+    import math
+    B, L, D = h.shape
+    E = gate_logits.shape[-1]
+    N = B * L
+    C = max(1, math.ceil(cfg.moe_capacity_factor * cfg.moe_top_k * N / E))
+    hf = h.reshape(N, D)
+    probs = jax.nn.softmax(gate_logits, axis=-1).reshape(N, E)
+    # Padding tokens route nowhere: they must neither consume expert
+    # capacity (displacing real tokens) nor contribute output.
+    maskf = (mask.reshape(N).astype(jnp.float32) if mask is not None
+             else jnp.ones((N,), jnp.float32))
+
+    counts = jnp.zeros((E,), jnp.float32)
+    dispatch = jnp.zeros((N, E, C), jnp.float32)
+    combine = jnp.zeros((N, E, C), jnp.float32)
+    remaining = probs
+    for _ in range(cfg.moe_top_k):
+        choice = jnp.argmax(remaining, axis=-1)                      # [N]
+        prob = jnp.take_along_axis(remaining, choice[:, None], -1)[:, 0]
+        onehot_e = (jax.nn.one_hot(choice, E, dtype=jnp.float32)
+                    * maskf[:, None])                                # [N, E]
+        # Slot index at the chosen expert: earlier tokens this pick,
+        # plus slots consumed by earlier picks.
+        pos = (jnp.cumsum(onehot_e, axis=0) - onehot_e
+               + counts[None, :])                                    # [N, E]
+        slot = jnp.sum(pos * onehot_e, axis=-1)                      # [N]
+        onehot_c = jax.nn.one_hot(slot.astype(jnp.int32), C,
+                                  dtype=jnp.float32)                 # [N, C]
+        mask_ec = onehot_e[:, :, None] * onehot_c[:, None, :]
+        dispatch = dispatch + mask_ec
+        combine = combine + mask_ec * prob[:, None, None]
+        counts = counts + jnp.sum(onehot_e, axis=0)
+        remaining = remaining * (1.0 - onehot_e)
+
+    d16 = dispatch.astype(cfg.dtype)
+    expert_in = jnp.einsum("nec,nd->ecd", d16, hf)                   # [E, C, D]
+    up = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w1))
+    down = jnp.einsum("ecf,efd->ecd", up, w2)
+    out = jnp.einsum("nec,ecd->nd", combine.astype(cfg.dtype), down)
+    return out.reshape(B, L, D)
+
+
 def encoder_forward(params: Dict[str, jax.Array], tokens: jax.Array,
                     mask: jax.Array, cfg: EncoderConfig,
                     mesh: MergeMesh) -> jax.Array:
@@ -123,15 +194,21 @@ def encoder_forward(params: Dict[str, jax.Array], tokens: jax.Array,
         x = x + jnp.einsum("blhk,hkd->bld", attn, lp["wo"].astype(cfg.dtype))
 
         h = _rms_norm(x, lp["ln2"])
-        # Soft-merged MoE: every expert computes, outputs blend by the
-        # gate distribution. Dense on purpose — static shapes, no
-        # data-dependent routing, expert axis shards over `ep`.
-        gate = jax.nn.softmax(
-            jnp.einsum("bld,de->ble", h, lp["gate"].astype(cfg.dtype))
-            .astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        up = jax.nn.gelu(jnp.einsum("bld,edf->blef", h, lp["w1"].astype(cfg.dtype)))
-        down = jnp.einsum("blef,efd->bled", up, lp["w2"].astype(cfg.dtype))
-        x = x + jnp.einsum("bled,ble->bld", down, gate)
+        gate_logits = jnp.einsum(
+            "bld,de->ble", h, lp["gate"].astype(cfg.dtype)).astype(jnp.float32)
+        if cfg.moe_mode == "topk":
+            x = x + _routed_moe(h, gate_logits,
+                                lp["w1"].astype(cfg.dtype),
+                                lp["w2"].astype(cfg.dtype), cfg, mask)
+        else:
+            # Expert-sharded dense mixture ("soft"): every expert
+            # computes, outputs blend by the gate distribution — static
+            # shapes, no data-dependent routing. The expert axis still
+            # shards over `ep`; routed EP is `moe_mode="topk"`.
+            gate = jax.nn.softmax(gate_logits, axis=-1).astype(cfg.dtype)
+            up = jax.nn.gelu(jnp.einsum("bld,edf->blef", h, lp["w1"].astype(cfg.dtype)))
+            down = jnp.einsum("blef,efd->bled", up, lp["w2"].astype(cfg.dtype))
+            x = x + jnp.einsum("bled,ble->bld", down, gate)
         return x, None
 
     layer_params = {k: params[k] for k in

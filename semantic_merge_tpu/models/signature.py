@@ -1,0 +1,144 @@
+"""Embedding-scored changeSignature pairing — the matcher in the product.
+
+The reference *designs* model-assisted signature matching
+(reference ``architecture.md:145-153``) but its live differ reports a
+changed signature as delete+add. The exact-key refinement pass
+(:func:`semantic_merge_tpu.core.difflift.refine_signature_changes`)
+recovers pairs that kept their ``(file, name, kind)``; this module
+recovers the rest — declarations that were renamed *and* retyped — by
+scoring residual (deleted, added) candidates with the contrastive
+matcher's embeddings (:mod:`semantic_merge_tpu.models.matcher`) and
+accepting cosine matches above a configured threshold.
+
+Deterministic by construction: parameters come from the latest orbax
+checkpoint when one exists (``semmerge train-matcher``) or from the
+seeded initializer, candidate order is stream order, and ties break by
+``(score desc, delete idx, add idx)`` — so every backend produces
+identical op logs, which the parity gate requires. Opt-in via
+``[engine] signature_matcher`` (off in parity mode: the reference
+emits delete+add).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.loggingx import logger
+
+
+class EmbeddingSignatureMatcher:
+    """Scores residual delete/add decl pairs by embedding similarity.
+
+    Lazy: jax, the encoder parameters, and the jitted embed function
+    are materialized on first use, so constructing the matcher (e.g.
+    from CLI config) costs nothing if no residual candidates appear.
+    """
+
+    def __init__(self, threshold: float = 0.85, ckpt_dir: str | None = None,
+                 seed: int = 0, seq_len: int = 64,
+                 max_candidates: int = 512) -> None:
+        self.threshold = threshold
+        self.ckpt_dir = ckpt_dir
+        self.seed = seed
+        self.seq_len = seq_len
+        self.max_candidates = max_candidates
+        self._embed = None
+        self._params = None
+        self._cfg = None
+
+    def _ensure(self) -> bool:
+        if self._embed is not None:
+            return True
+        try:
+            import jax
+
+            from ..parallel.mesh import build_mesh
+            from .matcher import MatcherConfig, init_matcher
+            from .matcher import embed as embed_fn
+        except Exception as exc:  # degraded mode: exact-key pairs only
+            logger.warning("signature matcher unavailable (%s); "
+                           "falling back to exact-key pairing", exc)
+            return False
+        cfg = MatcherConfig()
+        mesh = build_mesh()
+        params = None
+        if self.ckpt_dir:
+            try:
+                from .training import TrainConfig, _manager
+                tcfg = TrainConfig(matcher=cfg, ckpt_dir=self.ckpt_dir)
+                manager = _manager(tcfg)
+                latest = manager.latest_step()
+                if latest is not None:
+                    import orbax.checkpoint as ocp
+                    p0, o0 = init_matcher(jax.random.PRNGKey(self.seed), cfg)
+                    restored = manager.restore(
+                        latest, args=ocp.args.StandardRestore(
+                            {"params": p0, "opt_state": o0}))
+                    params = restored["params"]
+            except Exception as exc:
+                logger.warning("matcher checkpoint restore failed (%s); "
+                               "using seeded init", exc)
+        if params is None:
+            params, _ = init_matcher(jax.random.PRNGKey(self.seed), cfg)
+
+        import functools
+
+        @functools.partial(jax.jit)
+        def _embed_batch(p, tokens, mask):
+            return embed_fn(p, tokens, mask, cfg.encoder, mesh)
+
+        self._params = params
+        self._cfg = cfg
+        self._embed = _embed_batch
+        return True
+
+    def _embed_texts(self, texts: Sequence[str]) -> Optional[np.ndarray]:
+        from .features import encode_batch
+        from ..core.encode import bucket_size
+        vocab = self._cfg.encoder.vocab
+        ids, mask = encode_batch(list(texts), vocab, self.seq_len)
+        pad = bucket_size(max(len(texts), 1))  # stable compile shapes
+        ids = np.pad(ids, ((0, pad - len(texts)), (0, 0)))
+        mask = np.pad(mask, ((0, pad - len(texts)), (0, 0)))
+        z = np.asarray(self._embed(self._params, ids, mask))
+        return z[:len(texts)]
+
+    def pair(self, deletes: List[Tuple[object, str]],
+             adds: List[Tuple[object, str]]) -> List[Tuple[int, int]]:
+        """``deletes``/``adds`` are ``(routing_key, source_text)`` in
+        stream order — the routing key is any equatable value (the
+        differ passes ``(kind, file)``); only candidates with equal
+        keys may pair. Returns matched ``(delete_idx, add_idx)`` pairs
+        with cosine similarity above the threshold, each side consumed
+        at most once, ties broken by score then stream position."""
+        if not deletes or not adds:
+            return []
+        if (len(deletes) > self.max_candidates
+                or len(adds) > self.max_candidates):
+            logger.warning("signature matcher: %d/%d residual candidates "
+                           "exceed cap %d; skipping model pairing",
+                           len(deletes), len(adds), self.max_candidates)
+            return []
+        if not self._ensure():
+            return []
+        zd = self._embed_texts([t for _, t in deletes])
+        za = self._embed_texts([t for _, t in adds])
+        scores = zd @ za.T  # cosine: embeddings are L2-normalized
+        candidates = []
+        for i, (dk, _) in enumerate(deletes):
+            for j, (ak, _) in enumerate(adds):
+                if dk == ak and scores[i, j] >= self.threshold:
+                    candidates.append((-float(scores[i, j]), i, j))
+        candidates.sort()
+        used_d: set = set()
+        used_a: set = set()
+        out: List[Tuple[int, int]] = []
+        for _, i, j in candidates:
+            if i in used_d or j in used_a:
+                continue
+            used_d.add(i)
+            used_a.add(j)
+            out.append((i, j))
+        out.sort()
+        return out

@@ -21,11 +21,21 @@ from ..core.ops import Op
 from ..utils.loggingx import logger
 
 
-def apply_ops(base_tree: pathlib.Path, ops: Iterable[Op]) -> pathlib.Path:
+def apply_ops(base_tree: pathlib.Path, ops: Iterable[Op],
+              *, device_crdt: bool = False) -> pathlib.Path:
+    """Apply composed ops to a copy of ``base_tree``.
+
+    With ``device_crdt`` (the tpu backend's path), every
+    ``reorderImports`` op's RGA ordering in the merge resolves in ONE
+    batched device materialization
+    (:func:`semantic_merge_tpu.ops.crdt.materialize_batch`) instead of
+    per-list host insert scans; output is identical (parity-tested).
+    """
     base_tree = pathlib.Path(base_tree)
     out = pathlib.Path(tempfile.mkdtemp(prefix="semmerge_merged_"))
     shutil.copytree(base_tree, out, dirs_exist_ok=True)
     ops = list(ops)
+    resolved_orders = _resolve_reorder_orders(ops, device_crdt)
 
     # Structured-apply span edits (delete/changeSignature carrying
     # effects["decl"] payloads — the designed worker applyOps stage,
@@ -48,6 +58,9 @@ def apply_ops(base_tree: pathlib.Path, ops: Iterable[Op]) -> pathlib.Path:
                 and isinstance(op.effects.get("decl"), dict)
                 and "text" in op.effects["decl"]):
             add_ops.append(op)  # appends run after path-shaping ops
+            continue
+        if op.type == "reorderImports":
+            _apply_reorder_imports(out, op, resolved_orders.get(id(op)))
             continue
         handler = _HANDLERS.get(op.type)
         if handler is None:
@@ -158,15 +171,45 @@ def _apply_modify_import(root: pathlib.Path, op: Op) -> None:
     path.write_text(code.replace(str(old_import), str(new_import)), encoding="utf-8")
 
 
-def _apply_reorder_imports(root: pathlib.Path, op: Op) -> None:
+def _build_rga(order) -> "object":
+    from ..core.crdt import RGA, Key
+    rga = RGA()
+    for entry in order:
+        rga.insert(Key(str(entry.get("anchor", "")), int(entry.get("t", 0)),
+                       str(entry.get("author", "")), str(entry.get("opid", ""))),
+                   str(entry.get("value", "")))
+    return rga
+
+
+def _resolve_reorder_orders(ops, device_crdt: bool) -> dict:
+    """Resolve every reorderImports op's RGA ordering up front — the
+    whole merge's lists in one batched device materialization on the
+    tpu path, per-list host scans otherwise."""
+    items = [op for op in ops
+             if op.type == "reorderImports" and op.params.get("order")]
+    if not items:
+        return {}
+    rgas = [_build_rga(op.params["order"]) for op in items]
+    if device_crdt:
+        try:
+            from ..ops.crdt import materialize_batch
+            ordered_lists = materialize_batch(rgas)
+            return {id(op): lst for op, lst in zip(items, ordered_lists)}
+        except Exception as exc:
+            logger.warning("device CRDT batch failed (%s); host fallback", exc)
+    return {id(op): list(rga.materialize()) for op, rga in zip(items, rgas)}
+
+
+def _apply_reorder_imports(root: pathlib.Path, op: Op,
+                           ordered=None) -> None:
     """Reorder a file's leading import block per the op's CRDT keys.
 
     The op's ``params["order"]`` is a list of ``{value, anchor, t,
     author, opid}`` records; ordering is resolved by the RGA CRDT
     (specified at reference ``requirements.md:71-75`` [CRD-001..004] and
-    ``architecture.md:173-178`` but left dead in the reference)."""
-    from ..core.crdt import RGA, Key
-
+    ``architecture.md:173-178`` but left dead in the reference). The
+    order itself was resolved in the batched pre-pass of
+    :func:`apply_ops`."""
     file_path = op.params.get("file")
     order = op.params.get("order")
     if not file_path or not order:
@@ -178,12 +221,8 @@ def _apply_reorder_imports(root: pathlib.Path, op: Op) -> None:
     import_idx = [i for i, ln in enumerate(lines) if ln.lstrip().startswith("import ")]
     if not import_idx:
         return
-    rga = RGA()
-    for entry in order:
-        rga.insert(Key(str(entry.get("anchor", "")), int(entry.get("t", 0)),
-                       str(entry.get("author", "")), str(entry.get("opid", ""))),
-                   str(entry.get("value", "")))
-    ordered = [v for v in rga.materialize()]
+    if ordered is None:  # direct handler call outside apply_ops
+        ordered = list(_build_rga(order).materialize())
     by_text = {lines[i].strip(): i for i in import_idx}
     new_imports = [lines[by_text[v]] for v in ordered if v in by_text]
     remaining = [lines[i] for i in import_idx if lines[i].strip() not in set(ordered)]

@@ -5,7 +5,12 @@ and per-phase timings (reference ``requirements.md:182`` [NFR-OBS-002];
 ``architecture.md:248-249``) but implements none of it. Here every CLI
 run can carry a :class:`Tracer`; with tracing enabled it writes a
 machine-readable ``.semmerge-trace.json`` artifact containing phase
-wall-times and counters, and can hand phases to the JAX profiler.
+wall-times and counters. With ``profile_dir`` set (CLI ``--profile
+DIR``), the run is additionally captured by the JAX profiler: a
+``jax.profiler.start_trace``/``stop_trace`` session wraps the run and
+every tracer phase annotates the timeline via
+``jax.profiler.TraceAnnotation``, so device kernels line up with
+engine phases in TensorBoard/XProf.
 """
 from __future__ import annotations
 
@@ -27,14 +32,33 @@ class PhaseRecord:
 @dataclass
 class Tracer:
     enabled: bool = False
+    profile_dir: str | None = None
     phases: List[PhaseRecord] = field(default_factory=list)
     counters: Dict[str, Any] = field(default_factory=dict)
+    _profiling: bool = field(default=False, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.profile_dir:
+            try:
+                import jax
+                jax.profiler.start_trace(self.profile_dir)
+                self._profiling = True
+            except Exception:
+                self._profiling = False
 
     @contextlib.contextmanager
     def phase(self, name: str, **meta: Any):
+        annotation = contextlib.nullcontext()
+        if self._profiling:
+            try:
+                import jax
+                annotation = jax.profiler.TraceAnnotation(f"semmerge/{name}")
+            except Exception:
+                pass
         start = time.perf_counter()
         try:
-            yield
+            with annotation:
+                yield
         finally:
             self.phases.append(PhaseRecord(name, time.perf_counter() - start, dict(meta)))
 
@@ -51,7 +75,21 @@ class Tracer:
             "total_seconds": round(sum(p.seconds for p in self.phases), 6),
         }
 
+    def close(self) -> None:
+        """Stop the profiler session if one is open. Idempotent; must
+        run on every exit path (the CLI calls it in ``finally``) or an
+        aborted run loses the capture and poisons later start_trace
+        calls in the same process."""
+        if self._profiling:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._profiling = False
+
     def write(self, path: pathlib.Path | str = ".semmerge-trace.json") -> None:
+        self.close()
         if not self.enabled:
             return
         pathlib.Path(path).write_text(json.dumps(self.to_dict(), indent=2), encoding="utf-8")

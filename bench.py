@@ -161,7 +161,10 @@ def _emit_and_exit_on_watchdog(record: dict, seconds: float):
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--files", type=int, default=512)
+    parser.add_argument("--files", type=int, default=None,
+                        help="Override the workload size (default: the "
+                             "rung-5 preset — BASELINE.json's 10k-file "
+                             "north-star config)")
     parser.add_argument("--decls", type=int, default=6)
     parser.add_argument("--preset", choices=sorted(PRESETS),
                         help="BASELINE.json ladder rung (overrides --files/--decls)")
@@ -171,10 +174,16 @@ def main() -> int:
                         help="seconds before the bench force-emits and exits")
     args = parser.parse_args()
     conflicts_expected = False
+    if args.preset is None and args.files is None:
+        # The headline number is measured where BASELINE.json defines
+        # it: the 10k-file DivergentRename monorepo merge (rung 5).
+        args.preset = "rung5"
     if args.preset:
         p = PRESETS[args.preset]
         args.files, args.decls = p["files"], p["decls"]
         conflicts_expected = p.get("conflicts", False)
+    elif args.files is None:
+        args.files = 512
 
     record = {
         "metric": f"files merged/sec/chip (synthetic 3-way TS merge, "

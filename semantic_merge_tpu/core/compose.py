@@ -109,12 +109,17 @@ def cursor_walk_conflicts(ops_a: List[Op], ops_b: List[Op]
     conflicts: List[Conflict] = []
     dropped_a: set = set()
     dropped_b: set = set()
+    # Keys precomputed once — the loop runs per op over merges that can
+    # hold tens of thousands of ops.
+    keys_a = [op.sort_key()[:2] for op in ops_a]
+    keys_b = [op.sort_key()[:2] for op in ops_b]
+    na, nb = len(ops_a), len(ops_b)
     ia = ib = 0
-    while ia < len(ops_a) or ib < len(ops_b):
-        a_head = ops_a[ia] if ia < len(ops_a) else None
-        b_head = ops_b[ib] if ib < len(ops_b) else None
+    while ia < na or ib < nb:
+        a_head = ops_a[ia] if ia < na else None
+        b_head = ops_b[ib] if ib < nb else None
         take_a = a_head is not None and (
-            b_head is None or a_head.sort_key()[:2] <= b_head.sort_key()[:2]
+            b_head is None or keys_a[ia] <= keys_b[ib]
         )
         op = a_head if take_a else b_head
         other = b_head if take_a else a_head

@@ -118,6 +118,8 @@ def get_backend(name: str) -> Backend:
                 from . import java  # noqa: F401
             elif name in ("cs", "csharp"):
                 from . import cs  # noqa: F401
+            elif name in ("subprocess", "worker"):
+                from . import subproc  # noqa: F401
         except ImportError as exc:
             raise KeyError(f"Backend {name!r} failed to load: {exc}") from exc
     if name not in _REGISTRY:

@@ -1,0 +1,170 @@
+"""Subprocess language backend — the client half of the worker seam.
+
+Speaks the newline JSON-RPC protocol of
+:mod:`semantic_merge_tpu.runtime.worker` to a child process (reference
+``semmerge/lang/ts/bridge.py:21-47`` spawns its Node worker the same
+way). Crash isolation is the point: a dying worker raises a clean
+:class:`WorkerError` here, which the CLI's backend-fallback path turns
+into a host-engine retry instead of a corrupted merge.
+
+The worker command is configurable (``[engine] worker_cmd`` in
+``.semmerge.toml``), so ANY external implementation of the protocol can
+serve a language — including a future Node worker wrapping the real
+TypeScript compiler, which would turn the golden-corpus fixtures into a
+live oracle. Default: this package's own worker over the host engine.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from ..core.conflict import Conflict
+from ..core.ops import Op
+from ..frontend.snapshot import TS_EXTENSIONS, Snapshot
+from .base import BuildAndDiffResult, register_backend
+
+
+class WorkerError(RuntimeError):
+    """The worker died or answered with a protocol error."""
+
+
+class SubprocessBackend:
+    name = "subprocess"
+    extensions = frozenset(TS_EXTENSIONS)
+
+    def __init__(self, worker_cmd: Optional[List[str]] = None) -> None:
+        self._cmd = worker_cmd or [
+            sys.executable, "-m", "semantic_merge_tpu.runtime.worker",
+            "--backend", "host"]
+        self._proc: Optional[subprocess.Popen] = None
+        self._next_id = 0
+
+    def configure(self, config) -> None:
+        cmd = getattr(config.engine, "worker_cmd", None)
+        if cmd:
+            self._cmd = list(cmd)
+            self._shutdown()
+
+    # --- protocol plumbing -------------------------------------------------
+
+    def _ensure_proc(self) -> subprocess.Popen:
+        if self._proc is None or self._proc.poll() is not None:
+            # The default worker imports this package; make that work
+            # from any cwd (the CLI usually runs inside a user repo).
+            import os
+            import pathlib
+            env = dict(os.environ)
+            pkg_root = str(pathlib.Path(__file__).resolve().parents[2])
+            parts = [pkg_root, env.get("PYTHONPATH", "")]
+            env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
+            self._proc = subprocess.Popen(
+                self._cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                text=True, bufsize=1, env=env)
+        return self._proc
+
+    def _call(self, method: str, params: Dict) -> Dict:
+        proc = self._ensure_proc()
+        self._next_id += 1
+        request = {"id": self._next_id, "method": method, "params": params}
+        try:
+            proc.stdin.write(json.dumps(request) + "\n")
+            proc.stdin.flush()
+            line = proc.stdout.readline()
+        except (BrokenPipeError, OSError) as exc:
+            self._shutdown()
+            raise WorkerError(f"worker pipe broke during {method}: {exc}") from exc
+        if not line:
+            code = proc.poll()
+            self._shutdown()
+            raise WorkerError(
+                f"worker exited (rc={code}) without answering {method}")
+        try:
+            response = json.loads(line)
+        except json.JSONDecodeError as exc:
+            self._shutdown()
+            raise WorkerError(f"worker spoke non-JSON: {line[:200]!r}") from exc
+        if response.get("id") != request["id"]:
+            self._shutdown()
+            raise WorkerError(
+                f"worker answered id {response.get('id')} to {request['id']}")
+        if "error" in response:
+            # The worker survived — only this request failed.
+            raise WorkerError(str(response["error"].get("message", "unknown")))
+        return response.get("result", {})
+
+    def _shutdown(self) -> None:
+        proc, self._proc = self._proc, None
+        if proc is not None:
+            try:
+                if proc.poll() is None:
+                    proc.stdin.close()
+                    proc.wait(timeout=5)
+            except Exception:
+                proc.kill()
+
+    # --- Backend protocol --------------------------------------------------
+
+    @staticmethod
+    def _files(snap: Snapshot):
+        return [{"path": f["path"], "content": f["content"]}
+                for f in snap.files]
+
+    def build_and_diff(self, base: Snapshot, left: Snapshot, right: Snapshot,
+                       *, base_rev: str = "base", seed: str = "0",
+                       timestamp: str | None = None,
+                       change_signature: bool = False,
+                       structured_apply: bool = False,
+                       signature_matcher=None) -> BuildAndDiffResult:
+        if signature_matcher is not None:
+            raise WorkerError(
+                "signature_matcher is in-process only; the subprocess "
+                "backend's worker owns its own matcher configuration")
+        result = self._call("buildAndDiff", {
+            "base": self._files(base), "left": self._files(left),
+            "right": self._files(right), "baseRev": base_rev, "seed": seed,
+            "timestamp": timestamp, "changeSignature": change_signature,
+            "structuredApply": structured_apply,
+        })
+        return BuildAndDiffResult(
+            op_log_left=[Op.from_dict(o) for o in result["opLogLeft"]],
+            op_log_right=[Op.from_dict(o) for o in result["opLogRight"]],
+            symbol_maps=result.get("symbolMaps", {}),
+            diagnostics=result.get("diagnostics", []),
+        )
+
+    def diff(self, base: Snapshot, right: Snapshot,
+             *, base_rev: str = "base", seed: str = "0",
+             timestamp: str | None = None,
+             change_signature: bool = False,
+             structured_apply: bool = False,
+             signature_matcher=None) -> List[Op]:
+        result = self._call("diff", {
+            "base": self._files(base), "right": self._files(right),
+            "baseRev": base_rev, "seed": seed, "timestamp": timestamp,
+            "changeSignature": change_signature,
+            "structuredApply": structured_apply,
+        })
+        return [Op.from_dict(o) for o in result["opLog"]]
+
+    def compose(self, delta_a: List[Op], delta_b: List[Op]):
+        result = self._call("compose", {
+            "deltaA": [op.to_dict() for op in delta_a],
+            "deltaB": [op.to_dict() for op in delta_b],
+        })
+        composed = [Op.from_dict(o) for o in result["composed"]]
+        conflicts = [Conflict(**c) for c in result["conflicts"]]
+        return composed, conflicts
+
+    def close(self) -> None:
+        if self._proc is not None:
+            try:
+                self._call("shutdown", {})
+            except WorkerError:
+                pass
+            self._shutdown()
+
+
+register_backend("subprocess", SubprocessBackend)
+register_backend("worker", SubprocessBackend)

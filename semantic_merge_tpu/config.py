@@ -68,6 +68,10 @@ class EngineConfig:
     signature_matcher: bool = False
     signature_threshold: float = 0.85
     matcher_ckpt_dir: str | None = None
+    # Out-of-process worker command for backend = "subprocess" — any
+    # program speaking the runtime.worker JSON-RPC protocol (default:
+    # this package's own worker over the host engine).
+    worker_cmd: List[str] | None = None
 
 
 @dataclass
@@ -141,6 +145,8 @@ def load_config(start: pathlib.Path | None = None) -> Config:
             engine.get("signature_threshold", config.engine.signature_threshold)),
         matcher_ckpt_dir=(str(engine["matcher_ckpt_dir"])
                           if engine.get("matcher_ckpt_dir") else None),
+        worker_cmd=([str(c) for c in _as_list(engine.get("worker_cmd", []))]
+                    or None),
     )
 
     for lang, ldata in data.get("languages", {}).items():

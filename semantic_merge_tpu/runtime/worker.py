@@ -1,0 +1,130 @@
+"""Out-of-process language worker: newline JSON-RPC over stdio.
+
+The reference's L3↔L2 boundary is a child process speaking
+newline-delimited JSON-RPC (reference ``semmerge/lang/ts/bridge.py:80-118``
+request writer/reader; ``workers/ts/src/index.ts:9-51`` dispatch loop):
+a crashing worker cannot take down the CLI, and any external tool that
+speaks the protocol can be a language backend. This module is our side
+of that seam — both halves of it:
+
+- ``python -m semantic_merge_tpu.runtime.worker [--backend host]`` runs
+  a worker process serving the protocol on stdin/stdout, delegating to
+  an in-process backend (so the same engine can be supervised,
+  sandboxed, or scaled per-language);
+- :class:`semantic_merge_tpu.backends.subproc.SubprocessBackend` is the
+  client half, usable with THIS worker or any external implementation
+  (e.g. a Node worker wrapping the real TypeScript compiler — the
+  future live oracle of the golden-corpus fixtures).
+
+Wire protocol (mirrors reference ``workers/ts/src/protocol.ts``):
+
+    → {"id": 1, "method": "buildAndDiff", "params": {"base": [...],
+       "left": [...], "right": [...], "baseRev": "…", "seed": "…",
+       "timestamp": "…", "changeSignature": false,
+       "structuredApply": false}}
+    ← {"id": 1, "result": {"opLogLeft": [...], "opLogRight": [...],
+       "symbolMaps": {...}, "diagnostics": []}}
+
+Errors return ``{"id": n, "error": {"message": "…"}}``; the process
+exits on EOF or a ``shutdown`` request.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict
+
+
+def _snapshot(files) -> "object":
+    from ..frontend.snapshot import Snapshot
+    return Snapshot(files=[{"path": f["path"], "content": f["content"]}
+                           for f in files])
+
+
+def _handle(backend, method: str, params: Dict[str, Any]) -> Dict[str, Any]:
+    if method == "ping":
+        return {"pong": True, "backend": backend.name}
+    if method == "buildAndDiff":
+        result = backend.build_and_diff(
+            _snapshot(params["base"]), _snapshot(params["left"]),
+            _snapshot(params["right"]),
+            base_rev=params.get("baseRev", "base"),
+            seed=params.get("seed", "0"),
+            timestamp=params.get("timestamp"),
+            change_signature=bool(params.get("changeSignature", False)),
+            structured_apply=bool(params.get("structuredApply", False)))
+        return {
+            "opLogLeft": [op.to_dict() for op in result.op_log_left],
+            "opLogRight": [op.to_dict() for op in result.op_log_right],
+            "symbolMaps": result.symbol_maps,
+            "diagnostics": list(result.diagnostics),
+        }
+    if method == "diff":
+        ops = backend.diff(
+            _snapshot(params["base"]), _snapshot(params["right"]),
+            base_rev=params.get("baseRev", "base"),
+            seed=params.get("seed", "0"),
+            timestamp=params.get("timestamp"),
+            change_signature=bool(params.get("changeSignature", False)),
+            structured_apply=bool(params.get("structuredApply", False)))
+        return {"opLog": [op.to_dict() for op in ops]}
+    if method == "compose":
+        from ..core.ops import Op
+        compose = getattr(backend, "compose", None)
+        if compose is None:
+            from ..backends.base import host_compose
+            compose = host_compose
+        composed, conflicts = compose(
+            [Op.from_dict(o) for o in params["deltaA"]],
+            [Op.from_dict(o) for o in params["deltaB"]])
+        return {"composed": [op.to_dict() for op in composed],
+                "conflicts": [c.to_dict() for c in conflicts]}
+    raise ValueError(f"unknown method {method!r}")
+
+
+def serve(backend_name: str = "host",
+          stdin=None, stdout=None) -> int:
+    """Serve the protocol until EOF or ``shutdown``. Any per-request
+    exception becomes an error *response* — the worker survives."""
+    from ..backends.base import get_backend
+
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+    backend = get_backend(backend_name)
+    try:
+        for line in stdin:
+            line = line.strip()
+            if not line:
+                continue
+            req_id = None
+            try:
+                request = json.loads(line)
+                req_id = request.get("id")
+                method = request["method"]
+                if method == "shutdown":
+                    stdout.write(json.dumps({"id": req_id, "result": {}}) + "\n")
+                    stdout.flush()
+                    return 0
+                result = _handle(backend, method, request.get("params", {}))
+                response = {"id": req_id, "result": result}
+            except Exception as exc:  # noqa: BLE001 — becomes the error reply
+                response = {"id": req_id,
+                            "error": {"message": f"{type(exc).__name__}: {exc}"}}
+            stdout.write(json.dumps(response) + "\n")
+            stdout.flush()
+    finally:
+        backend.close()
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(prog="semmerge-worker")
+    parser.add_argument("--backend", default="host",
+                        help="in-process backend the worker delegates to")
+    args = parser.parse_args()
+    return serve(args.backend)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""Out-of-process worker seam (VERDICT r3 Missing #2).
+
+The subprocess backend speaks newline JSON-RPC to a child worker —
+reference ``semmerge/lang/ts/bridge.py:80-118`` / ``workers/ts/src/
+index.ts:9-51``. Tests cover: full-merge parity through the seam,
+crash isolation (a killed worker raises cleanly and a fresh worker
+serves the next call), per-request error isolation, and that an
+EXTERNAL program implementing the protocol can be a backend.
+"""
+import json
+import os
+import pathlib
+import signal
+import sys
+import textwrap
+
+import pytest
+
+from semantic_merge_tpu.backends.base import run_merge, get_backend
+from semantic_merge_tpu.backends.subproc import SubprocessBackend, WorkerError
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+
+
+def snap(files):
+    return Snapshot(files=[{"path": p, "content": c} for p, c in files])
+
+
+BASE = snap([("a.ts", "export function f(x: number): number { return x; }\n")])
+LEFT = snap([("a.ts", "export function g(x: number): number { return x; }\n")])
+RIGHT = snap([("lib/a.ts", "export function f(x: number): number { return x; }\n")])
+
+
+@pytest.fixture()
+def backend():
+    b = SubprocessBackend()
+    yield b
+    b.close()
+
+
+def test_full_merge_parity_through_worker(backend):
+    host = get_backend("host")
+    res_w, comp_w, conf_w = run_merge(backend, BASE, LEFT, RIGHT,
+                                      base_rev="r", seed="s")
+    res_h, comp_h, conf_h = run_merge(host, BASE, LEFT, RIGHT,
+                                      base_rev="r", seed="s")
+    assert [o.to_dict() for o in res_w.op_log_left] == \
+        [o.to_dict() for o in res_h.op_log_left]
+    assert [o.to_dict() for o in comp_w] == [o.to_dict() for o in comp_h]
+    assert [c.to_dict() for c in conf_w] == [c.to_dict() for c in conf_h]
+
+
+def test_worker_crash_recovers_transparently(backend):
+    ops = backend.diff(BASE, LEFT, base_rev="r", seed="s")
+    assert ops
+    # Kill the live worker out from under the backend: calls are
+    # stateless, so the next call spawns a fresh worker and succeeds.
+    proc = backend._proc
+    assert proc is not None
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+    ops2 = backend.diff(BASE, LEFT, base_rev="r", seed="s")
+    assert [o.to_dict() for o in ops2] == [o.to_dict() for o in ops]
+
+
+def test_midcall_death_raises_cleanly(tmp_path):
+    # A worker that reads one request and exits without answering: the
+    # caller gets a WorkerError, not a hang or a corrupted merge.
+    script = tmp_path / "dying_worker.py"
+    script.write_text("import sys\nsys.stdin.readline()\n")
+    backend = SubprocessBackend(worker_cmd=[sys.executable, str(script)])
+    try:
+        with pytest.raises(WorkerError):
+            backend.diff(BASE, LEFT, base_rev="r", seed="s")
+    finally:
+        backend.close()
+
+
+def test_request_error_does_not_kill_worker():
+    import subprocess
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "semantic_merge_tpu.runtime.worker"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, bufsize=1)
+    try:
+        proc.stdin.write(json.dumps({"id": 1, "method": "nope"}) + "\n")
+        proc.stdin.flush()
+        reply = json.loads(proc.stdout.readline())
+        assert reply["id"] == 1 and "error" in reply
+        proc.stdin.write(json.dumps({"id": 2, "method": "ping"}) + "\n")
+        proc.stdin.flush()
+        reply2 = json.loads(proc.stdout.readline())
+        assert reply2["result"]["pong"] is True, \
+            "worker must survive a failed request"
+    finally:
+        proc.kill()
+
+
+def test_external_program_can_implement_the_protocol(tmp_path):
+    # A minimal non-semmerge worker: answers every buildAndDiff with one
+    # canned addDecl op — proof the seam admits external tools.
+    script = tmp_path / "toy_worker.py"
+    script.write_text(textwrap.dedent("""
+        import json, sys
+        OP = {"id": "x"*8, "schemaVersion": 1, "type": "addDecl",
+              "target": {"symbolId": "toy", "addressId": "toy::a::0"},
+              "params": {"file": "toy.ts"}, "guards": {},
+              "effects": {"summary": "add decl"}, "provenance": {}}
+        for line in sys.stdin:
+            req = json.loads(line)
+            if req["method"] == "shutdown":
+                print(json.dumps({"id": req["id"], "result": {}})); break
+            print(json.dumps({"id": req["id"], "result": {
+                "opLogLeft": [OP], "opLogRight": [], "symbolMaps": {}}}))
+            sys.stdout.flush()
+    """))
+    backend = SubprocessBackend(worker_cmd=[sys.executable, str(script)])
+    try:
+        result = backend.build_and_diff(BASE, LEFT, RIGHT)
+        assert len(result.op_log_left) == 1
+        assert result.op_log_left[0].target.symbolId == "toy"
+    finally:
+        backend.close()
+
+
+def test_config_selects_worker_cmd(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / ".semmerge.toml").write_text(
+        '[engine]\nbackend = "subprocess"\n'
+        f'worker_cmd = ["{sys.executable}", "-m", '
+        '"semantic_merge_tpu.runtime.worker", "--backend", "host"]\n')
+    from semantic_merge_tpu.config import load_config
+    config = load_config()
+    assert config.engine.worker_cmd is not None
+    b = get_backend("subprocess")
+    b.configure(config)
+    try:
+        ops = b.diff(BASE, LEFT, base_rev="r", seed="s")
+        assert ops
+    finally:
+        b.close()

@@ -98,13 +98,21 @@ class TpuTSBackend:
     def configure(self, config) -> None:
         """Apply ``.semmerge.toml`` settings (called by the CLI): an
         explicit ``[engine] mesh_shape = "dp=4,tp=2"`` overrides the
-        auto dp mesh."""
+        auto dp mesh, and ``"hybrid:dcn=dp,dp=4,..."`` builds the
+        multi-slice mesh whose ``dcn`` axis crosses slices over DCN
+        while every other axis rides ICI."""
         shape = getattr(config.engine, "mesh_shape", "auto")
-        sizes = None
         try:
-            from ..parallel.mesh import build_mesh, parse_mesh_shape
-            sizes = parse_mesh_shape(shape)
-            if sizes:
+            from ..parallel.mesh import build_mesh, parse_mesh_spec
+            kind, dcn_axis, sizes = parse_mesh_spec(shape)
+            if kind == "hybrid":
+                import jax
+
+                from ..parallel.distributed import build_hybrid_mesh
+                self._mesh = build_hybrid_mesh(jax.devices(),
+                                               dcn_axis=dcn_axis,
+                                               **sizes).mesh
+            elif sizes:
                 import jax
                 self._mesh = build_mesh(jax.devices(), **sizes).mesh
         except ValueError as exc:

@@ -3,7 +3,13 @@
 This is the scale path of the north star (BASELINE.json: "op-log
 sorting, chaining, CRDT reconciliation run as data-parallel segmented
 scans across thousands of files … sharded symbol-ID join … across a
-v4-8"). The single-device kernels (:mod:`semantic_merge_tpu.ops.diff`,
+v4-8"). ``dp`` is the merge kernels' ONLY parallel axis by design:
+their work is integer sort/join/scan over decl and op rows — there is
+no weight matrix whose features could shard over ``tp`` and no layer
+stack for ``pp``; the row axis IS the parallelism, and slicing it over
+more devices is exactly what tp/pp would otherwise buy. (``tp``/
+``pp``/``sp``/``ep`` carry the model half — the matcher encoder.) The
+single-device kernels (:mod:`semantic_merge_tpu.ops.diff`,
 :mod:`semantic_merge_tpu.ops.compose`) stay the fast path for one chip;
 these twins run the same logic under :func:`jax.shard_map` over the
 ``dp`` axis of the framework mesh

@@ -76,6 +76,37 @@ def _factor(n: int, weights: Sequence[int]) -> list[int]:
     return sizes
 
 
+def parse_mesh_spec(spec: str) -> tuple:
+    """Parse ``[engine] mesh_shape`` into ``(kind, dcn_axis, sizes)``.
+
+    - ``"auto"`` / empty → ``("flat", None, {})``;
+    - ``"dp=4,tp=2"`` → ``("flat", None, {...})``;
+    - ``"hybrid:dcn=dp,dp=4,tp=2"`` → ``("hybrid", "dp", {...})`` — the
+      ``dcn`` axis spans slices over DCN
+      (:func:`semantic_merge_tpu.parallel.distributed.build_hybrid_mesh`),
+      every other axis stays within a slice on ICI.
+    """
+    spec = (spec or "").strip()
+    kind = "flat"
+    dcn_axis = None
+    if spec.startswith("hybrid"):
+        kind = "hybrid"
+        _, _, spec = spec.partition(":")
+        parts = []
+        for part in spec.split(","):
+            name, _, value = part.partition("=")
+            if name.strip() == "dcn":
+                dcn_axis = value.strip()
+                if dcn_axis not in MESH_AXES:
+                    raise ValueError(
+                        f"mesh_shape dcn axis {dcn_axis!r} not one of {MESH_AXES}")
+            elif part.strip():
+                parts.append(part)
+        spec = ",".join(parts)
+        dcn_axis = dcn_axis or "dp"
+    return kind, dcn_axis, parse_mesh_shape(spec)
+
+
 def parse_mesh_shape(spec: str) -> Dict[str, int]:
     """Parse a ``.semmerge.toml`` ``[engine] mesh_shape`` value like
     ``"dp=4,tp=2"`` into :func:`build_mesh` axis kwargs. ``"auto"`` (or

@@ -93,3 +93,42 @@ def test_hybrid_mesh_rejects_bad_factor():
     with pytest.raises(ValueError):
         build_hybrid_mesh(devices, slice_ids=slice_ids, dcn_axis="dp",
                           dp=3, sp=1, tp=1, pp=1, ep=1)
+
+
+def test_hybrid_mesh_shape_drives_product_backend():
+    """[engine] mesh_shape = 'hybrid:...' builds the DCN-aware mesh in
+    the real backend path and the sharded merge keeps oracle parity."""
+    import types
+    from semantic_merge_tpu.backends.base import get_backend
+    from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+    from semantic_merge_tpu.frontend.snapshot import Snapshot
+    from semantic_merge_tpu.parallel.mesh import parse_mesh_spec
+
+    kind, dcn, sizes = parse_mesh_spec("hybrid:dcn=dp,dp=8")
+    assert (kind, dcn, sizes) == ("hybrid", "dp", {"dp": 8})
+    assert parse_mesh_spec("dp=4,tp=2") == ("flat", None, {"dp": 4, "tp": 2})
+
+    backend = TpuTSBackend(mesh=False)
+    config = types.SimpleNamespace(engine=types.SimpleNamespace(
+        mesh_shape="hybrid:dcn=dp,dp=8"))
+    backend.configure(config)
+    assert backend._mesh is not None
+    assert backend._mesh.shape["dp"] == 8
+
+    files = [{"path": f"m{i}.ts",
+              "content": f"export function fn{i}(x: number): number "
+                         f"{{ return x + {i}; }}\n"} for i in range(12)]
+    base = Snapshot(files=files)
+    left = Snapshot(files=[dict(f, content=f["content"].replace("fn0", "renamed0"))
+                           for f in files])
+    right = Snapshot(files=[dict(f, path=("lib/" + f["path"]
+                                          if f["path"] == "m1.ts" else f["path"]))
+                            for f in files])
+    rt = backend.build_and_diff(base, left, right, base_rev="r", seed="s",
+                                timestamp="T")
+    host = get_backend("host")
+    rh = host.build_and_diff(base, left, right, base_rev="r", seed="s",
+                             timestamp="T")
+    ops_t, _ = backend.compose(rt.op_log_left, rt.op_log_right)
+    ops_h, _ = host.compose(rh.op_log_left, rh.op_log_right)
+    assert [o.to_dict() for o in ops_t] == [o.to_dict() for o in ops_h]

@@ -1,0 +1,107 @@
+"""Comment/whitespace (trivia) behavior through structured apply.
+
+The reference leaves CST trivia reattachment as a 3-line stub
+(reference ``workers/ts/src/emit.ts:1-3``; design at
+``implementation.md:1173-1185``). This framework's answer is the
+*full-start span* contract: a decl's span starts at the end of the
+previous token (the TS parser's ``node.pos``), so the comments and
+whitespace leading a declaration travel WITH it — deletes remove their
+decl's leading comment, adds carry theirs, and untouched regions stay
+byte-identical. These tests pin that contract end to end.
+"""
+from semantic_merge_tpu.backends.base import get_backend, run_merge
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+from semantic_merge_tpu.runtime.applier import apply_ops
+
+BASE = (
+    "// greets the caller\n"
+    "export function greet(name: string): string {\n"
+    "  return name;\n"
+    "}\n"
+    "// counts things (keep me!)\n"
+    "export function count(xs: number[]): number {\n"
+    "  return xs.length;\n"
+    "}\n"
+)
+
+
+def snap(content, path="a.ts"):
+    return Snapshot(files=[{"path": path, "content": content}])
+
+
+def merge_to_tree(tmp_path, base_c, left_c, right_c):
+    host = get_backend("host")
+    _, composed, conflicts = run_merge(
+        host, snap(base_c), snap(left_c), snap(right_c),
+        base_rev="r", seed="s", structured_apply=True)
+    assert conflicts == []
+    base_tree = tmp_path / "base"
+    base_tree.mkdir()
+    (base_tree / "a.ts").write_text(base_c)
+    return apply_ops(base_tree, composed)
+
+
+def test_deleted_decl_takes_its_leading_comment(tmp_path):
+    left = BASE.replace(
+        "// greets the caller\n"
+        "export function greet(name: string): string {\n"
+        "  return name;\n"
+        "}\n", "")
+    out = merge_to_tree(tmp_path, BASE, left, BASE)
+    text = (out / "a.ts").read_text()
+    assert "greet" not in text
+    assert "// greets the caller" not in text, \
+        "the deleted decl's leading comment must go with it (full start)"
+    assert "// counts things (keep me!)" in text
+    assert "count" in text
+
+
+def test_added_decl_carries_its_leading_comment(tmp_path):
+    right = BASE + (
+        "// freshly added helper\n"
+        "export function added(flag: boolean): boolean {\n"
+        "  return !flag;\n"
+        "}\n")
+    out = merge_to_tree(tmp_path, BASE, BASE, right)
+    text = (out / "a.ts").read_text()
+    assert "// freshly added helper" in text, \
+        "an added decl's span starts at full start: its comment travels too"
+    assert text.index("// freshly added helper") < text.index("function added")
+
+
+def test_untouched_regions_stay_byte_identical(tmp_path):
+    # A pure rename must leave every comment and blank line untouched;
+    # the rename rewrites word-boundary identifier occurrences only
+    # ("greets" in the comment is not the identifier "greet").
+    import re
+    left = re.sub(r"\bgreet\b", "salute", BASE)
+    out = merge_to_tree(tmp_path, BASE, left, BASE)
+    text = (out / "a.ts").read_text()
+    assert text == left
+    assert "// greets the caller" in text
+    assert "// counts things (keep me!)" in text
+
+
+def test_changesignature_replacement_carries_comment(tmp_path):
+    # changeSignature splices the side's full-start span over the
+    # base's: the replacement text includes the side's comment.
+    base = BASE
+    left = BASE.replace(
+        "// greets the caller\n"
+        "export function greet(name: string): string {",
+        "// now louder\n"
+        "export function greet(name: number): string {")
+    host = get_backend("host")
+    _, composed, conflicts = run_merge(
+        host, snap(base), snap(left), snap(base),
+        base_rev="r", seed="s", change_signature=True, structured_apply=True)
+    assert conflicts == []
+    assert any(op.type == "changeSignature" for op in composed)
+    base_tree = tmp_path / "b"
+    base_tree.mkdir()
+    (base_tree / "a.ts").write_text(base)
+    out = apply_ops(base_tree, composed)
+    text = (out / "a.ts").read_text()
+    assert "// now louder" in text
+    assert "// greets the caller" not in text
+    assert "name: number" in text

@@ -63,8 +63,9 @@ class TpuTSBackend:
 
     def _fused_engine(self):
         from ..ops.fused import FusedMergeEngine
-        if self._fused is None or self._fused.interner is not self._interner:
-            self._fused = FusedMergeEngine(self._interner)
+        if (self._fused is None or self._fused.interner is not self._interner
+                or self._fused.mesh is not self._mesh):
+            self._fused = FusedMergeEngine(self._interner, mesh=self._mesh)
         return self._fused
 
     def _scan_encode(self, snapshot: Snapshot):
@@ -205,14 +206,16 @@ class TpuTSBackend:
         """Full 3-way merge in ONE device round trip when eligible (see
         :mod:`semantic_merge_tpu.ops.fused`): diff, deterministic op
         identity, and composition all stay on device; one compact fetch.
-        Ineligible configurations (a mesh is active, changeSignature or
-        structured-apply requested, oversized strings) fall back to the
-        two-program path with identical observable output. Returns
-        ``(BuildAndDiffResult, composed_ops, conflicts)``."""
+        With a mesh active the same program runs dp-sharded (distributed
+        diff sort-join, row-sharded SHA). Ineligible configurations
+        (changeSignature or structured-apply requested, oversized
+        strings) fall back to the two-program path with identical
+        observable output. Returns ``(BuildAndDiffResult, composed_ops,
+        conflicts)``."""
         import time
         ts = timestamp or EPOCH_ISO
         self._maybe_reset_interner()
-        if self._mesh is None and not change_signature and not structured_apply:
+        if not change_signature and not structured_apply:
             t0 = time.perf_counter()
             base_t, base_nodes, base_key = self._scan_encode_keyed(base)
             left_t, left_nodes, left_key = self._scan_encode_keyed(left)

@@ -49,7 +49,7 @@ Replaces the hot path of reference ``workers/ts/src/diff.ts:5-31``,
 from __future__ import annotations
 
 from collections import OrderedDict
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -95,8 +95,9 @@ class DeviceStrings:
 
     WIDTHS = (32, 64, 128, 256)
 
-    def __init__(self, interner: Interner) -> None:
+    def __init__(self, interner: Interner, sharding=None) -> None:
         self.interner = interner
+        self.sharding = sharding  # replicated mesh sharding, or None
         self._encoded: List[bytes] = []
         self.width = self.WIDTHS[0]
         self.cap = 1024
@@ -107,6 +108,10 @@ class DeviceStrings:
         self._dev_bytes = None
         self._dev_lens = None
         self._n_dev = 0  # rows synced to device
+
+    def _put(self, arr):
+        return (jax.device_put(arr, self.sharding) if self.sharding is not None
+                else jax.device_put(arr))
 
     def sync(self) -> Optional[tuple]:
         """Bring the device table up to date with the interner. Returns
@@ -141,8 +146,8 @@ class DeviceStrings:
             for i, b in enumerate(self._encoded):
                 self._host_bytes[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
                 self._host_lens[i] = len(b)
-            self._dev_bytes = jax.device_put(self._host_bytes)
-            self._dev_lens = jax.device_put(self._host_lens)
+            self._dev_bytes = self._put(self._host_bytes)
+            self._dev_lens = self._put(self._host_lens)
             self._n_dev = n
             return self._dev_bytes, self._dev_lens, self.width
         if n > self._n_dev or self._dev_bytes is None:
@@ -152,15 +157,15 @@ class DeviceStrings:
                 self._host_bytes[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
                 self._host_lens[i] = len(b)
             if self._dev_bytes is None:
-                self._dev_bytes = jax.device_put(self._host_bytes)
-                self._dev_lens = jax.device_put(self._host_lens)
+                self._dev_bytes = self._put(self._host_bytes)
+                self._dev_lens = self._put(self._host_lens)
             else:
                 # Ship only the delta, padded to a power-of-two row count
                 # so the update-slice kernel compiles O(log) variants.
                 rows = bucket_size(n - start, minimum=8)
                 if start + rows > self.cap:
-                    self._dev_bytes = jax.device_put(self._host_bytes)
-                    self._dev_lens = jax.device_put(self._host_lens)
+                    self._dev_bytes = self._put(self._host_bytes)
+                    self._dev_lens = self._put(self._host_lens)
                 else:
                     upd_b = self._host_bytes[start:start + rows]
                     upd_l = self._host_lens[start:start + rows]
@@ -217,12 +222,14 @@ def _emit_slots(plan, C: int, nb: int, ns: int):
 
 
 def _op_id_words(kind, a_slot, b_slot, b_cols, s_cols, tab_b, tab_l,
-                 prefix, prefix_len, *, C: int, B: int, W: int):
+                 prefix, prefix_len, *, C: int, B: int, W: int, idx0=0):
     """Assemble each op's id payload bytes and hash them: uint32 [C, 4].
 
     Payload layout (must match ``core.ids.deterministic_op_id``):
     ``<seed>|<rev>|`` (prefix) + decimal op index + ``|<type>|`` +
-    symbolId + ``|`` + aAddr + ``|`` + bAddr.
+    symbolId + ``|`` + aAddr + ``|`` + bAddr. ``idx0`` offsets the
+    decimal op index — the sharded kernel hashes row blocks, so block
+    ``j`` passes ``idx0 = j * rows_per_shard``.
     """
     b_sym, b_addr = b_cols[0], b_cols[1]
     s_sym, s_addr = s_cols[0], s_cols[1]
@@ -242,7 +249,7 @@ def _op_id_words(kind, a_slot, b_slot, b_cols, s_cols, tab_b, tab_l,
 
     sym_len, a_len, b_len = slen(sym_id), slen(a_id), slen(b_id)
 
-    idx = jnp.arange(C, dtype=jnp.int32)
+    idx = idx0 + jnp.arange(C, dtype=jnp.int32)
     pow10 = jnp.asarray([10 ** t for t in range(_DIGIT_CAP)], jnp.int32)
     di = jnp.int32(1) + sum((idx >= pow10[t]).astype(jnp.int32)
                             for t in range(1, _DIGIT_CAP))
@@ -410,13 +417,22 @@ def _fused_merge_kernel(b_cols, l_cols, r_cols, tab_b, tab_l,
                        r_cols[0], r_cols[1], r_cols[2], nb, nr)
     kL, aL, bL, nopsL = _emit_slots(planL, C, nb, nl)
     kR, aR, bR, nopsR = _emit_slots(planR, C, nb, nr)
-    overflow = ((nopsL > C) | (nopsR > C)).astype(jnp.int32)
 
     wL = _op_id_words(kL, aL, bL, b_cols, l_cols, tab_b, tab_l,
                       pre_l, plen_l, C=C, B=B, W=W)
     wR = _op_id_words(kR, aR, bR, b_cols, r_cols, tab_b, tab_l,
                       pre_r, plen_r, C=C, B=B, W=W)
+    return _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
+                             b_cols, l_cols, r_cols, C)
 
+
+def _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
+                      b_cols, l_cols, r_cols, C: int):
+    """Stages shared by the single-device and dp-sharded fused kernels:
+    id ranking, compose columns, canonical sorts, candidate join,
+    speculative merge+scan, and the compact flat packing. Inputs here
+    are full (replicated on every shard in the mesh case)."""
+    overflow = ((nopsL > C) | (nopsR > C)).astype(jnp.int32)
     # Global id ranks: 128-bit big-endian word lexsort over both streams
     # == lexicographic rank of the uuid-formatted id strings.
     inval = jnp.uint32(0xFFFFFFFF)
@@ -453,6 +469,71 @@ def _fused_merge_kernel(b_cols, l_cols, r_cols, tab_b, tab_l,
         a["op_index"], b["op_index"],
         ref, c_addr, c_file, c_name,
     ])
+
+
+def _fused_merge_sharded_core(b_st, l_st, r_st, tab_b, tab_l,
+                              pre_l, plen_l, pre_r, plen_r,
+                              *, nb: int, nl: int, nr: int, C: int, B: int,
+                              W: int, k: int):
+    """Per-shard body of the dp-sharded fused merge.
+
+    The decl axis shards over ``dp``: the diff join runs as the
+    distributed sort-join with the symbol-table all-gather
+    (:func:`semantic_merge_tpu.ops.sharded._sharded_diff_slots`), and
+    SHA-256 — the dominant vector compute — hashes each shard's block
+    of op rows, all-gathering only the 16-byte digests. The compact
+    compose stages run replicated (their row count is the op capacity,
+    orders of magnitude below the decl axis), so the packed output is
+    identical to the single-device kernel's and one host decode serves
+    both.
+    """
+    from jax import lax
+
+    from .sharded import AXIS, _sharded_diff_slots
+
+    b_cols = tuple(b_st[i] for i in range(4))
+    l_cols = tuple(l_st[i] for i in range(4))
+    r_cols = tuple(r_st[i] for i in range(4))
+    kL, aL, bL, nopsL = _sharded_diff_slots(
+        b_cols[0], b_cols[1], b_cols[2], l_cols[0], l_cols[1], l_cols[2],
+        nb, nl, k, C)
+    kR, aR, bR, nopsR = _sharded_diff_slots(
+        b_cols[0], b_cols[1], b_cols[2], r_cols[0], r_cols[1], r_cols[2],
+        nb, nr, k, C)
+
+    # Full decl columns for slot->id gathers (id assembly, compose cols).
+    b_full = tuple(lax.all_gather(c, AXIS, tiled=True) for c in b_cols)
+    l_full = tuple(lax.all_gather(c, AXIS, tiled=True) for c in l_cols)
+    r_full = tuple(lax.all_gather(c, AXIS, tiled=True) for c in r_cols)
+
+    j = lax.axis_index(AXIS)
+    Tc = C // k
+
+    def words_for(kind, a_slot, b_slot, s_full, pre, plen):
+        sl = lambda x: lax.dynamic_slice(x, (j * Tc,), (Tc,))  # noqa: E731
+        w_my = _op_id_words(sl(kind), sl(a_slot), sl(b_slot), b_full, s_full,
+                            tab_b, tab_l, pre, plen, C=Tc, B=B, W=W,
+                            idx0=j * Tc)
+        return lax.all_gather(w_my, AXIS, tiled=True)
+
+    wL = words_for(kL, aL, bL, l_full, pre_l, plen_l)
+    wR = words_for(kR, aR, bR, r_full, pre_r, plen_r)
+    return _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
+                             b_full, l_full, r_full, C)
+
+
+@lru_cache(maxsize=None)
+def _sharded_fn(mesh, nb: int, nl: int, nr: int,
+                C: int, B: int, W: int, k: int):
+    from jax.sharding import PartitionSpec as P
+
+    from .sharded import AXIS
+    decl = P(None, AXIS)
+    return jax.jit(jax.shard_map(
+        partial(_fused_merge_sharded_core, nb=nb, nl=nl, nr=nr,
+                C=C, B=B, W=W, k=k),
+        mesh=mesh, in_specs=(decl, decl, decl, P(), P(), P(), P(), P(), P()),
+        out_specs=P(), check_vma=False))
 
 
 # --------------------------------------------------------------------------
@@ -511,14 +592,30 @@ class FusedMergeEngine:
     identity — warm merges ship zero input bytes), and the learned op
     capacity hint that sizes the compact output."""
 
-    def __init__(self, interner: Interner) -> None:
+    def __init__(self, interner: Interner, mesh=None) -> None:
         self.interner = interner
-        self.strings = DeviceStrings(interner)
+        self.mesh = mesh
+        self._dp = 1
+        self._decl_sharding = None
+        self._repl_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .sharded import AXIS, _dp_size
+            self._dp = _dp_size(mesh)
+            self._decl_sharding = NamedSharding(mesh, P(None, AXIS))
+            self._repl_sharding = NamedSharding(mesh, P())
+        self.strings = DeviceStrings(interner, sharding=self._repl_sharding)
         self._decl_cache: "OrderedDict" = OrderedDict()
         self._cap_hint = 256
 
+    def _bucket(self, n: int) -> int:
+        from ..core.encode import shard_bucket
+        return (shard_bucket(n, self._dp) if self._dp > 1
+                else bucket_size(max(n, 1)))
+
     def _device_decl(self, t: DeclTensor, identity) -> tuple:
-        bucket = bucket_size(max(t.n, 1))
+        bucket = self._bucket(t.n)
         if identity is not None:
             hit = self._decl_cache.get(identity)
             if hit is not None and hit[1] == bucket:
@@ -529,7 +626,10 @@ class FusedMergeEngine:
                             pad_to(t.addr, bucket, null),
                             pad_to(t.name, bucket, null),
                             pad_to(t.file, bucket, null)])
-        entry = (jax.device_put(stacked), bucket)
+        if self._decl_sharding is not None:
+            entry = (jax.device_put(stacked, self._decl_sharding), bucket)
+        else:
+            entry = (jax.device_put(stacked), bucket)
         if identity is not None:
             self._decl_cache[identity] = entry
             while len(self._decl_cache) > 12:
@@ -575,12 +675,18 @@ class FusedMergeEngine:
 
         flat = None
         for _attempt in range(4):
-            C = bucket_size(max(self._cap_hint, 8))
+            C = self._bucket(max(self._cap_hint, 8 * self._dp))
             t0 = time.perf_counter()
-            out_dev = _fused_merge_kernel(
-                dev_b, dev_l, dev_r, tab_b, tab_l,
-                pl, np.int32(len(pre_l)), pr, np.int32(len(pre_r)),
-                nb=nb, nl=nl, nr=nr, C=C, B=B, W=W)
+            if self.mesh is not None:
+                fn = _sharded_fn(self.mesh, nb, nl, nr, C, B, W, self._dp)
+                out_dev = fn(dev_b, dev_l, dev_r, tab_b, tab_l,
+                             pl, np.int32(len(pre_l)),
+                             pr, np.int32(len(pre_r)))
+            else:
+                out_dev = _fused_merge_kernel(
+                    dev_b, dev_l, dev_r, tab_b, tab_l,
+                    pl, np.int32(len(pre_l)), pr, np.int32(len(pre_r)),
+                    nb=nb, nl=nl, nr=nr, C=C, B=B, W=W)
             if phases is not None:
                 out_dev.block_until_ready()
                 phases["kernel"] = (phases.get("kernel", 0.0)

@@ -208,6 +208,85 @@ def _sharded_diff_core(b_sym, b_addr, b_name, b_file,
     return lax.pmax(out, AXIS)
 
 
+def _sharded_diff_slots(b_sym, b_addr, b_name, s_sym, s_addr, s_name,
+                        nb: int, ns: int, k: int, C: int):
+    """Per-shard diff join emitting compact ``(kind, base-slot,
+    side-slot)`` rows — the sharded twin of the fused merge program's
+    emitter (:func:`semantic_merge_tpu.ops.fused._emit_slots`). Slots
+    are GLOBAL decl indices; outputs are replicated via ``pmax`` like
+    the stacked-column variant above. Rows beyond capacity ``C`` drop
+    (the caller checks ``n_ops > C`` and retries bigger)."""
+    j = lax.axis_index(AXIS)
+    Sb, Ss = nb // k, ns // k
+    my_b_idx = j * Sb + jnp.arange(Sb, dtype=jnp.int32)
+    my_s_idx = j * Ss + jnp.arange(Ss, dtype=jnp.int32)
+    b_valid = b_sym != PAD_ID
+    s_valid = s_sym != PAD_ID
+
+    b_srt_l, b_ord_l = _local_sorted_run(b_sym)
+    s_srt_l, s_ord_l = _local_sorted_run(s_sym)
+    b_tab = lax.all_gather(b_srt_l, AXIS)
+    b_tord = lax.all_gather(b_ord_l, AXIS)
+    s_tab = lax.all_gather(s_srt_l, AXIS)
+    s_tord = lax.all_gather(s_ord_l, AXIS)
+    off_b = jnp.arange(k, dtype=jnp.int32) * Sb
+    off_s = jnp.arange(k, dtype=jnp.int32) * Ss
+    b_addr_g = lax.all_gather(b_addr, AXIS, tiled=True)
+    b_name_g = lax.all_gather(b_name, AXIS, tiled=True)
+    s_addr_g = lax.all_gather(s_addr, AXIS, tiled=True)
+    s_name_g = lax.all_gather(s_name, AXIS, tiled=True)
+
+    _, bg_first, bg_last = _run_query(b_tab, b_tord, off_b, Sb, b_sym)
+    emits = b_valid & (bg_first == my_b_idx)
+    bl = jnp.clip(bg_last, 0, nb - 1)
+    b_addr_l = b_addr_g[bl]
+    b_name_l = b_name_g[bl]
+
+    s_found, _, sg_last = _run_query(s_tab, s_tord, off_s, Ss, b_sym)
+    found = s_found & b_valid
+    sr = jnp.clip(sg_last, 0, ns - 1)
+    s_addr_r = s_addr_g[sr]
+    s_name_r = s_name_g[sr]
+
+    is_delete = emits & ~found
+    is_move = emits & found & (b_addr_l != s_addr_r)
+    is_rename = (emits & found & (b_name_l != NULL_ID) & (s_name_r != NULL_ID)
+                 & (b_name_l != s_name_r))
+    in_base, _, _ = _run_query(b_tab, b_tord, off_b, Sb, s_sym)
+    is_add = s_valid & ~in_base
+
+    def global_offsets(count, prior_total):
+        cum = jnp.cumsum(count)
+        totals = lax.all_gather(cum[-1], AXIS)
+        prev = jnp.sum(jnp.where(jnp.arange(k) < j, totals, 0))
+        return prior_total + prev + cum - count, prior_total + jnp.sum(totals)
+
+    base_count = jnp.where(is_delete, 1,
+                           is_move.astype(jnp.int32) + is_rename.astype(jnp.int32))
+    base_off, total_base = global_offsets(base_count, 0)
+    add_off, n_ops = global_offsets(is_add.astype(jnp.int32), total_base)
+
+    neg = jnp.int32(NULL_ID)
+    cols = [jnp.full((C,), neg) for _ in range(3)]
+
+    def scatter(cols, posn, mask, values):
+        posn = jnp.where(mask, posn, C)
+        return [arr.at[posn].set(val, mode="drop")
+                for arr, val in zip(cols, values)]
+
+    full_b = lambda v: jnp.full((Sb,), v, jnp.int32)  # noqa: E731
+    full_s = lambda v: jnp.full((Ss,), v, jnp.int32)  # noqa: E731
+    cols = scatter(cols, base_off, is_delete,
+                   [full_b(KIND_DELETE), bl, full_b(NULL_ID)])
+    cols = scatter(cols, base_off, is_move, [full_b(KIND_MOVE), bl, sr])
+    cols = scatter(cols, base_off + is_move.astype(jnp.int32), is_rename,
+                   [full_b(KIND_RENAME), bl, sr])
+    cols = scatter(cols, add_off, is_add,
+                   [full_s(KIND_ADD), full_s(NULL_ID), my_s_idx])
+    merged = [lax.pmax(c, AXIS) for c in cols]
+    return merged[0], merged[1], merged[2], n_ops
+
+
 @lru_cache(maxsize=None)
 def _sharded_diff_fn(mesh: Mesh, nb: int, ns: int, k: int):
     spec = P(AXIS)

@@ -178,3 +178,47 @@ def test_fused_randomized_fuzz_parity():
         left = snap(sorted(mutate(files, rng).items()))
         right = snap(sorted(mutate(files, rng).items()))
         assert_parity(base, left, right, seed=f"t{trial}")
+
+
+def test_fused_sharded_parity_on_mesh():
+    """The one-fetch fused merge also runs dp-sharded: distributed diff
+    sort-join, row-sharded device SHA with digest all-gather, identical
+    packed output. Parity vs the host oracle on the 8-device mesh,
+    including a conflict workload and a warm repeat."""
+    import jax
+    from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+    from semantic_merge_tpu.parallel.mesh import build_mesh
+    import bench
+
+    mesh = build_mesh(jax.devices(), dp=8, pp=1, sp=1, tp=1, ep=1).mesh
+    tpu = TpuTSBackend(mesh=mesh)
+    host = get_backend("host")
+    for files, divergent in ((60, False), (97, True), (60, False)):
+        base, left, right = bench.synth_repo(files, 3, divergent=divergent)
+        res_t, comp_t, conf_t = run_merge(tpu, base, left, right,
+                                          seed="b", base_rev="b",
+                                          timestamp="2026-01-01T00:00:00Z")
+        res_h, comp_h, conf_h = run_merge(host, base, left, right,
+                                          seed="b", base_rev="b",
+                                          timestamp="2026-01-01T00:00:00Z")
+        assert _dicts(res_t.op_log_left) == _dicts(res_h.op_log_left)
+        assert _dicts(res_t.op_log_right) == _dicts(res_h.op_log_right)
+        assert _dicts(comp_t) == _dicts(comp_h)
+        assert [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_h]
+        if divergent:
+            assert conf_t
+
+
+def test_fused_sharded_parity_non_pow2_mesh():
+    import jax
+    from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+    from semantic_merge_tpu.parallel.mesh import build_mesh
+    import bench
+
+    mesh = build_mesh(jax.devices()[:6], dp=6, pp=1, sp=1, tp=1, ep=1).mesh
+    tpu = TpuTSBackend(mesh=mesh)
+    host = get_backend("host")
+    base, left, right = bench.synth_repo(40, 3)
+    _, comp_t, _ = run_merge(tpu, base, left, right, seed="b", base_rev="b")
+    _, comp_h, _ = run_merge(host, base, left, right, seed="b", base_rev="b")
+    assert _dicts(comp_t) == _dicts(comp_h)

@@ -175,6 +175,18 @@ class TpuTSBackend:
              signature_matcher=None) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         self._maybe_reset_interner()
+        if (self._mesh is None and not change_signature
+                and not structured_apply):
+            base_t, base_nodes, base_key = self._scan_encode_keyed(base)
+            right_t, right_nodes, right_key = self._scan_encode_keyed(right)
+            fused = self._fused_engine().diff(
+                base_t, base_key, base_nodes, right_t, right_key, right_nodes,
+                seed=seed, base_rev=base_rev, timestamp=ts)
+            if fused is not None:
+                return fused
+            t = self._diff_fn()(base_t, right_t)
+            diffs = decode_diffs(t, base_t, right_t, base_nodes, right_nodes)
+            return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts)
         base_t, base_nodes = self._scan_encode(base)
         right_t, right_nodes = self._scan_encode(right)
         t = self._diff_fn()(base_t, right_t)

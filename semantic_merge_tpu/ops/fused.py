@@ -522,6 +522,25 @@ def _fused_merge_sharded_core(b_st, l_st, r_st, tab_b, tab_l,
                              b_full, l_full, r_full, C)
 
 
+@partial(jax.jit, static_argnames=("nb", "ns", "C", "B", "W"))
+def _fused_diff_kernel(b_cols, s_cols, tab_b, tab_l, pre, plen,
+                       nb: int, ns: int, C: int, B: int, W: int):
+    """Two-way variant (the ``semdiff`` path): diff join + device op
+    identity in one program/one fetch; no compose stages."""
+    plan = _diff_plan(b_cols[0], b_cols[1], b_cols[2],
+                      s_cols[0], s_cols[1], s_cols[2], nb, ns)
+    k_, a_, b_, n_ops = _emit_slots(plan, C, nb, ns)
+    w = _op_id_words(k_, a_, b_, b_cols, s_cols, tab_b, tab_l,
+                     pre, plen, C=C, B=B, W=W)
+    overflow = (n_ops > C).astype(jnp.int32)
+    scalars = jnp.stack([n_ops, overflow] + [jnp.int32(0)] * 6)
+    as_i32 = partial(jax.lax.bitcast_convert_type, new_dtype=jnp.int32)
+    return jnp.concatenate([
+        scalars, k_, a_, b_,
+        as_i32(w[:, 0]), as_i32(w[:, 1]), as_i32(w[:, 2]), as_i32(w[:, 3]),
+    ])
+
+
 @lru_cache(maxsize=None)
 def _sharded_fn(mesh, nb: int, nl: int, nr: int,
                 C: int, B: int, W: int, k: int):
@@ -656,6 +675,53 @@ class FusedMergeEngine:
             while len(self._decl_cache) > 12:
                 self._decl_cache.popitem(last=False)
         return entry
+
+    def diff(self, base_t: DeclTensor, base_key, base_nodes,
+             side_t: DeclTensor, side_key, side_nodes,
+             *, seed: str, base_rev: str, timestamp: str
+             ) -> Optional[List[Op]]:
+        """Two-way fused diff (the ``semdiff`` path): one dispatch, one
+        compact fetch, ops materialized with device-hashed ids.
+        ``None`` when ineligible (caller falls back). Single-device
+        only — semdiff latency is dominated by the round trip, which is
+        exactly what this removes."""
+        if self.mesh is not None:
+            return None
+        pre = f"{seed}/R|{base_rev}|".encode("utf-8")
+        if len(pre) > _PREFIX_CAP:
+            return None
+        synced = self.strings.sync()
+        if synced is None:
+            return None
+        tab_b, tab_l, W = synced
+        dev_b, nb = self._device_decl(base_t, base_key)
+        dev_s, ns = self._device_decl(side_t, side_key)
+        pa = np.zeros((_PREFIX_CAP,), np.uint8)
+        pa[:len(pre)] = np.frombuffer(pre, np.uint8)
+        q = lambda x: -(-x // 16) * 16  # noqa: E731
+        B = -(-(q(len(pre)) + _DIGIT_CAP + _TYPE_SEG_CAP
+                + 3 * q(self.strings.max_len) + 2 + 9) // 64)
+        for _attempt in range(4):
+            C = self._bucket(max(self._cap_hint, 8))
+            flat = np.asarray(_fused_diff_kernel(
+                dev_b, dev_s, tab_b, tab_l, pa, np.int32(len(pre)),
+                nb=nb, ns=ns, C=C, B=B, W=W))
+            n_ops = int(flat[0])
+            if not flat[1]:
+                break
+            self._cap_hint = n_ops
+        else:
+            return None
+        off = 8
+        cols = []
+        for _ in range(7):
+            cols.append(flat[off:off + C])
+            off += C
+        kinds, a_sl, b_sl = cols[0][:n_ops], cols[1][:n_ops], cols[2][:n_ops]
+        words = np.stack([c[:n_ops] for c in cols[3:7]], axis=1)
+        return _materialize_stream(kinds, a_sl, b_sl, words,
+                                   base_nodes, side_nodes,
+                                   {"rev": base_rev, "timestamp": timestamp})
 
     def merge(self, base_t: DeclTensor, base_key, base_nodes,
               left_t: DeclTensor, left_key, left_nodes,

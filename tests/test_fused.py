@@ -222,3 +222,18 @@ def test_fused_sharded_parity_non_pow2_mesh():
     _, comp_t, _ = run_merge(tpu, base, left, right, seed="b", base_rev="b")
     _, comp_h, _ = run_merge(host, base, left, right, seed="b", base_rev="b")
     assert _dicts(comp_t) == _dicts(comp_h)
+
+
+def test_fused_two_way_diff_parity():
+    """semdiff's fused one-fetch path: device-hashed ids, same op log
+    as the host oracle, warm repeat included."""
+    import bench
+    tpu = fused_backend()
+    host = get_backend("host")
+    for files in (30, 30, 90):
+        base, left, _ = bench.synth_repo(files, 3)
+        ops_t = tpu.diff(base, left, base_rev="r", seed="s",
+                         timestamp="2026-01-01T00:00:00Z")
+        ops_h = host.diff(base, left, base_rev="r", seed="s",
+                          timestamp="2026-01-01T00:00:00Z")
+        assert _dicts(ops_t) == _dicts(ops_h)

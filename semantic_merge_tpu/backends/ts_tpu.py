@@ -235,19 +235,25 @@ class TpuTSBackend:
             if phases is not None:
                 phases["scan_encode"] = (phases.get("scan_encode", 0.0)
                                          + time.perf_counter() - t0)
+            # symbolMaps are independent host work — build them while
+            # the device executes the fused program (pipeline staging).
+            maps: Dict[str, list] = {}
+
+            def build_symbol_maps():
+                maps["base"] = symbol_map(base_nodes)
+                maps["left"] = symbol_map(left_nodes)
+                maps["right"] = symbol_map(right_nodes)
+
             fused = self._fused_engine().merge(
                 base_t, base_key, base_nodes, left_t, left_key, left_nodes,
                 right_t, right_key, right_nodes,
-                seed=seed, base_rev=base_rev, timestamp=ts, phases=phases)
+                seed=seed, base_rev=base_rev, timestamp=ts,
+                overlap_work=build_symbol_maps, phases=phases)
             if fused is not None:
                 ops_l, ops_r, composed, conflicts = fused
                 result = BuildAndDiffResult(
                     op_log_left=ops_l, op_log_right=ops_r,
-                    symbol_maps={
-                        "base": symbol_map(base_nodes),
-                        "left": symbol_map(left_nodes),
-                        "right": symbol_map(right_nodes),
-                    },
+                    symbol_maps=maps,
                 )
                 return result, composed, conflicts
         t0 = time.perf_counter()

@@ -727,11 +727,18 @@ class FusedMergeEngine:
               left_t: DeclTensor, left_key, left_nodes,
               right_t: DeclTensor, right_key, right_nodes,
               *, seed: str, base_rev: str, timestamp: str,
-              phases: Dict | None = None
+              overlap_work=None, phases: Dict | None = None
               ) -> Optional[Tuple[List[Op], List[Op], List[Op], List[Conflict]]]:
         """Run the one-round-trip merge; ``None`` when ineligible (a
         string exceeds the table width, or the prefix exceeds its cap) —
-        the caller falls back to the two-program path."""
+        the caller falls back to the two-program path.
+
+        ``overlap_work`` (a no-arg callable) runs on the host between
+        the async kernel dispatch and the blocking fetch — the
+        pipeline-staging seam (SURVEY §2.3 PP): the caller's
+        independent host work (e.g. symbolMaps construction) overlaps
+        device compute instead of serializing after it.
+        """
         import time
         pre_l = f"{seed}/L|{base_rev}|".encode("utf-8")
         pre_r = f"{seed}/R|{base_rev}|".encode("utf-8")
@@ -774,6 +781,11 @@ class FusedMergeEngine:
                     dev_b, dev_l, dev_r, tab_b, tab_l,
                     pl, np.int32(len(pre_l)), pr, np.int32(len(pre_r)),
                     nb=nb, nl=nl, nr=nr, C=C, B=B, W=W)
+            if overlap_work is not None:
+                # Dispatch is async: host-side work here rides along
+                # with the device execution.
+                overlap_work()
+                overlap_work = None  # once per merge, not per retry
             if phases is not None:
                 out_dev.block_until_ready()
                 phases["kernel"] = (phases.get("kernel", 0.0)

@@ -36,6 +36,9 @@ def assert_parity(base, left, right, *, seed="s", base_rev="r",
     assert _dicts(res_t.op_log_right) == _dicts(res_h.op_log_right)
     assert _dicts(comp_t) == _dicts(comp_h)
     assert [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_h]
+    # symbolMaps are built on host overlapping the device dispatch —
+    # must still be complete and identical.
+    assert res_t.symbol_maps == res_h.symbol_maps
     return comp_t, conf_t
 
 

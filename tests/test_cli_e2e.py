@@ -184,3 +184,38 @@ def test_config_selects_backend_and_seed(repo):
     git(["checkout", "-q", "main"], repo)
     rc = main(["semmerge", "basebr", "brA", "main"])
     assert rc == 0
+
+
+def test_semrebase_replays_stored_oplog(repo):
+    """semrebase: the op log a merge stored in git notes replays onto a
+    different revision — the [SPEC] flow the readable notes store makes
+    real (reference requirements.md:119-124)."""
+    (repo / "a.ts").write_text(
+        "export function foo(n: number): number {\n  return n;\n}\n")
+    commit_all(repo, "base")
+    git(["branch", "basebr"], repo)
+    git(["checkout", "-qb", "brA"], repo)
+    (repo / "a.ts").write_text(
+        "export function bar(n: number): number {\n  return n;\n}\n")
+    commit_all(repo, "rename")
+    git(["checkout", "-q", "main"], repo)
+    git(["checkout", "-qb", "brB"], repo)
+    (repo / "b.ts").write_text("export function other(): void {}\n")
+    commit_all(repo, "side")
+    git(["checkout", "-q", "main"], repo)
+    # The merge stores brA's op log in semmerge notes.
+    rc = main(["semmerge", "basebr", "brA", "brB", "--backend", "host"])
+    assert rc == 0
+    # Replay brA's note (the rename) onto brB, which still has foo.
+    rc = main(["semrebase", "brA", "brB", "--inplace"])
+    assert rc == 0
+    text = (repo / "a.ts").read_text()
+    assert "bar" in text and "foo" not in text
+    assert (repo / "b.ts").exists(), "brB's own file must survive the replay"
+
+
+def test_semrebase_without_note_fails_cleanly(repo):
+    (repo / "a.ts").write_text("export function foo(): void {}\n")
+    commit_all(repo, "base")
+    rc = main(["semrebase", "HEAD", "HEAD"])
+    assert rc == 1

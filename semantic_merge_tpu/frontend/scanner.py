@@ -214,28 +214,23 @@ def _scan_snapshot_cached(files: Sequence[dict], cache
     declared: set[str] = set().union(*name_sets) if name_sets else set()
     dh = declared_hash(declared)
 
-    # Pass 2 — per-file decl nodes keyed by (path, content, declared set).
-    out_slots: List[List[DeclNode] | None] = [None] * len(files)
-    miss_idx: List[int] = []
-    for idx, f in enumerate(files):
-        key = ("decls", normalize_path(f["path"]), hashes[idx], dh)
-        hit = cache.get(key)
-        if hit is not None:
-            out_slots[idx] = hit
-        else:
-            miss_idx.append(idx)
+    # Pass 2 — per-file decl nodes keyed by (path, content, declared
+    # set). Keys are built exactly once (this loop runs 30k×/snapshot on
+    # the 10k-file rung; redundant tuple/path work showed in profiles).
+    get = cache.get
+    keys = [("decls", normalize_path(f["path"]), h, dh)
+            for f, h in zip(files, hashes)]
+    out_slots: List[List[DeclNode] | None] = [get(k) for k in keys]
+    miss_idx = [i for i, v in enumerate(out_slots) if v is None]
 
     if miss_idx:
         scanned = _scan_subset([files[i] for i in miss_idx], declared,
                                [toks_for.get(i) for i in miss_idx])
         for slot, nodes in zip(miss_idx, scanned):
             out_slots[slot] = nodes
-            cache.put(("decls", normalize_path(files[slot]["path"]),
-                       hashes[slot], dh), nodes)
+            cache.put(keys[slot], nodes)
 
-    return [(("decls", normalize_path(f["path"]), hashes[idx], dh),
-             out_slots[idx] or [])
-            for idx, f in enumerate(files)]
+    return [(k, v or []) for k, v in zip(keys, out_slots)]
 
 
 def _scan_subset(files: Sequence[dict], declared: set[str],

@@ -58,16 +58,38 @@ def _pad_op_tensor(t: OpTensor, size: int) -> Dict[str, np.ndarray]:
     return cols
 
 
+def _sort_perm(*keys):
+    """Stable sort permutation by lexicographic ``keys`` (primary
+    first): one multi-key XLA sort with an iota payload. Returns
+    ``(order, iota)`` — ``keys[i][order]`` is sorted, ties keep
+    original index order; ``iota`` is returned for callers that
+    scatter-invert the permutation."""
+    iota = jnp.arange(keys[0].shape[0], dtype=jnp.int32)
+    *_, order = jax.lax.sort((*keys, iota), num_keys=len(keys))
+    return order, iota
+
+
 def _key_leq(pa, ta, pb, tb):
     """Cross-stream (prec, ts) <= comparison — A wins ties; the op id
     never decides cross-stream order (see module docstring)."""
     return (pa < pb) | ((pa == pb) & (ta <= tb))
 
 
+#: Column order of the encoded op stream (OpTensor fields).
+_STREAM_COLS = ("prec", "ts_rank", "id_rank", "is_rename", "is_move", "sym",
+                "new_name", "chain_name", "new_addr", "chain_file", "op_index")
+
+
 def _sort_stream(cols):
-    """Stage 1: canonical per-stream sort by (prec, ts rank, id rank)."""
-    order = jnp.lexsort((cols["id_rank"], cols["ts_rank"], cols["prec"]))
-    return {k: v[order] for k, v in cols.items()}
+    """Stage 1: canonical per-stream sort by (prec, ts rank, id rank).
+
+    One stable multi-key XLA sort with every other column carried as
+    payload — a k-key ``jnp.lexsort`` lowers to k *sequential* sorts
+    plus k gathers, and sorts dominate the fused kernel's device time
+    (rung-5 TPU phase split)."""
+    out = jax.lax.sort(tuple(cols[k] for k in _STREAM_COLS),
+                       num_keys=3, is_stable=True)
+    return dict(zip(_STREAM_COLS, out))
 
 
 def _rename_pairs(cols, n_real, n_pad):
@@ -83,13 +105,12 @@ def _rename_candidate_tables(a, n_a, na):
     candidate join; replicated across shards in the mesh kernel (the
     symbol-table all-gather of the north star)."""
     a_rsym, a_rname = _rename_pairs(a, n_a, na)
-    a_ord = jnp.argsort(a_rsym, stable=True)
-    srt_sym, srt_name = a_rsym[a_ord], a_rname[a_ord]
     # Sorting by (sym, name) lets a query read the run's min/max name —
     # scanning the ≤2 boundary slots is not enough when one symbol has
-    # several renames with mixed names.
-    name_sorted_key = jnp.lexsort((srt_name, srt_sym))
-    return srt_sym, srt_sym[name_sorted_key], srt_name[name_sorted_key]
+    # several renames with mixed names. The sym column of this one sort
+    # is already the sym-sorted table the membership probe needs.
+    nm_sym, nm_name = jax.lax.sort((a_rsym, a_rname), num_keys=2)
+    return nm_sym, nm_sym, nm_name
 
 
 def _rename_candidate_query(tables, na, b_rsym, b_rname):
@@ -226,9 +247,9 @@ def _merge_and_scan(a, b, n_a, n_b, na: int, nb: int,
     prec, ts, idr = cat("prec"), cat("ts_rank"), cat("id_rank")
     # (prec, ts, side, id): id orders rows only *within* a stream, side
     # breaks cross-stream ties — the merged order of the two-pointer walk.
-    merged_order = jnp.lexsort((idr, side, ts, prec))
-    inv = jnp.argsort(merged_order)  # row → merged position
-    merged_pos = inv.astype(jnp.int32)
+    merged_order, iota = _sort_perm(prec, ts, side, idr)
+    # Inverse of a permutation is a scatter, not another sort.
+    merged_pos = jnp.zeros_like(iota).at[merged_order].set(iota)
 
     sym = cat("sym")
     is_rename = cat("is_rename") == 1
@@ -244,7 +265,7 @@ def _merge_and_scan(a, b, n_a, n_b, na: int, nb: int,
     c_name_val = jnp.where(is_rename & live, new_name, NULL_ID)
 
     # Segmented inclusive last-valid scan over (sym, merged_pos) order.
-    seg_order = jnp.lexsort((merged_pos, sym))
+    seg_order, _ = _sort_perm(sym, merged_pos)
     seg_sym = sym[seg_order]
 
     chain_addr = seg_scan_impl(seg_sym, seg_order, c_addr_val)

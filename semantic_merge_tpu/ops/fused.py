@@ -61,7 +61,7 @@ from ..core.encode import NULL_ID, PAD_ID, DeclTensor, Interner, bucket_size, pa
 from ..core.ops import Op, Target
 from .compose import (_PAD_PREC, _local_seg_scan, _materialize_decoded,
                       _rename_candidate_query, _rename_candidate_tables,
-                      _rename_pairs, _sort_stream)
+                      _rename_pairs, _sort_perm, _sort_stream)
 from .diff import KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME, _diff_plan
 from .sha256 import sha256_device
 
@@ -372,8 +372,8 @@ def _merge_scan_spec(a, b, C: int):
     live = opidx != NULL_ID
 
     prec, ts, idr = cat("prec"), cat("ts_rank"), cat("id_rank")
-    merged_order = jnp.lexsort((idr, side, ts, prec))
-    merged_pos = jnp.argsort(merged_order).astype(jnp.int32)
+    merged_order, iota = _sort_perm(prec, ts, side, idr)
+    merged_pos = jnp.zeros_like(iota).at[merged_order].set(iota)
 
     sym = cat("sym")
     is_rename = cat("is_rename") == 1
@@ -387,7 +387,7 @@ def _merge_scan_spec(a, b, C: int):
     c_file_val = jnp.where(move_live & (file_contrib != NULL_ID), file_contrib, NULL_ID)
     c_name_val = jnp.where(is_rename & live, new_name, NULL_ID)
 
-    seg_order = jnp.lexsort((merged_pos, sym))
+    seg_order, _ = _sort_perm(sym, merged_pos)
     seg_sym = sym[seg_order]
     chain_addr = _local_seg_scan(seg_sym, seg_order, c_addr_val)
     chain_file = _local_seg_scan(seg_sym, seg_order, c_file_val)
@@ -440,10 +440,9 @@ def _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
     validR = (kR >= 0)[:, None]
     all_words = jnp.concatenate([jnp.where(validL, wL, inval),
                                  jnp.where(validR, wR, inval)])
-    order = jnp.lexsort((all_words[:, 3], all_words[:, 2],
-                         all_words[:, 1], all_words[:, 0]))
-    rank = jnp.zeros((2 * C,), jnp.int32).at[order].set(
-        jnp.arange(2 * C, dtype=jnp.int32))
+    order, iota2 = _sort_perm(all_words[:, 0], all_words[:, 1],
+                              all_words[:, 2], all_words[:, 3])
+    rank = jnp.zeros((2 * C,), jnp.int32).at[order].set(iota2)
     id_rank_l, id_rank_r = rank[:C], rank[C:]
 
     colsL = _compose_cols(kL, aL, bL, id_rank_l, b_cols, l_cols, C)

@@ -36,6 +36,7 @@ implementation (:mod:`semantic_merge_tpu.ops.compose`) must match:
 """
 from __future__ import annotations
 
+from bisect import bisect_left, bisect_right
 from typing import Dict, List, Tuple
 
 from .conflict import Conflict, divergent_rename_conflict
@@ -118,15 +119,41 @@ def cursor_walk_conflicts(ops_a: List[Op], ops_b: List[Op]
     while ia < na or ib < nb:
         a_head = ops_a[ia] if ia < na else None
         b_head = ops_b[ib] if ib < nb else None
-        take_a = a_head is not None and (
-            b_head is None or keys_a[ia] <= keys_b[ib]
-        )
+        # A conflict can only fire when BOTH heads are renameSymbol, so
+        # any run of takes against a non-rename (or exhausted) opposite
+        # head is conflict-free and bulk-advances via bisect over the
+        # sorted keys — observably identical to stepping one op at a
+        # time, at O(log run) instead of O(run). On a 10k-file merge
+        # only the rename-vs-rename interleavings walk singly.
+        if b_head is None or b_head.type != "renameSymbol":
+            if a_head is None:  # only B remains; nothing can conflict
+                ib = nb
+            elif b_head is None:
+                ia = na
+            else:
+                # take_a holds while keys_a[ia] <= keys_b[ib].
+                nxt = bisect_right(keys_a, keys_b[ib], ia, na)
+                if nxt == ia:
+                    ib += 1  # A's head outranks B's: single take from B
+                else:
+                    ia = nxt
+            continue
+        if a_head is None or a_head.type != "renameSymbol":
+            if a_head is None:
+                ib = nb
+            else:
+                # take_b holds while keys_b[ib] < keys_a[ia].
+                nxt = bisect_left(keys_b, keys_a[ia], ib, nb)
+                if nxt == ib:
+                    ia += 1  # B's head is not taken next: take from A
+                else:
+                    ib = nxt
+            continue
+        take_a = keys_a[ia] <= keys_b[ib]
         op = a_head if take_a else b_head
         other = b_head if take_a else a_head
-        assert op is not None
         if (
             op.type == "renameSymbol"
-            and other is not None
             and other.type == "renameSymbol"
             and op.target.symbolId == other.target.symbolId
             and op.params.get("newName") != other.params.get("newName")

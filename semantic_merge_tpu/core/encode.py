@@ -43,8 +43,34 @@ class Interner:
     def __init__(self) -> None:
         self._ids: Dict[str, int] = {}
         self.strings: List[str] = []
+        self._obj: np.ndarray | None = None
+        self._obj_n = 0
         self.token = Interner._next_token
         Interner._next_token += 1
+
+    def object_table(self) -> np.ndarray:
+        """Numpy object-array mirror ``[*strings, None]`` with amortized
+        (geometric) growth: fancy-indexing an int32 id column against it
+        wraps ``NULL_ID`` (−1) to the trailing ``None``, so a whole
+        column of interned ids decodes in one vectorized gather instead
+        of a Python loop — and long-lived interners (the device backend
+        keeps one across merges) don't rebuild the mirror per merge.
+
+        The result is a live VIEW of the cached buffer: the next
+        ``intern()`` may overwrite its trailing ``None`` slot. Gather
+        from it immediately; never hold it across interning."""
+        n = len(self.strings)
+        if self._obj is None or n + 1 > len(self._obj):
+            grown = np.empty((max(64, 2 * (n + 1)),), dtype=object)
+            grown[:n] = self.strings
+            self._obj = grown
+            self._obj_n = n
+        elif n > self._obj_n:
+            self._obj[self._obj_n:n] = self.strings[self._obj_n:n]
+            self._obj_n = n
+        view = self._obj[:n + 1]
+        view[n] = None  # reset: growth may have written a string here
+        return view
 
     def intern(self, s: str | None) -> int:
         if s is None:

@@ -67,7 +67,7 @@ _PRIMITIVE_TYPES = {
 }
 
 
-@dataclass
+@dataclass(slots=True)
 class DeclNode:
     """One indexed declaration — the unit the differ joins on.
 

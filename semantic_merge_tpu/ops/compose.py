@@ -348,11 +348,11 @@ def decode_compose_output(out: np.ndarray, delta_a: List[Op], delta_b: List[Op],
     sorted_b = [delta_b[i] for i in b_op_index[:nb].tolist() if i != NULL_ID]
 
     # Columnar decode: one object-array gather resolves every interned
-    # chain id to its string (NULL_ID = -1 indexes the appended None),
+    # chain id to its string (NULL_ID = -1 wraps to the trailing None),
     # and `.tolist()` turns the int32 rows into plain ints once — the
     # per-op numpy-scalar indexing this replaces was the hot loop at the
     # 1k-file rung (VERDICT round 1, Weak #3).
-    strings = np.asarray(interner.strings + [None], dtype=object)
+    strings = interner.object_table()
     sides = out_side[:n_out].tolist()
     rows = out_row[:n_out].tolist()
     addr_s = strings[chain_addr[:n_out]].tolist() if n_out else []

@@ -830,19 +830,15 @@ class FusedMergeEngine:
                                      + time.perf_counter() - t0)
             t0 = time.perf_counter()
 
-        # Direct list indexing, O(n_out): never copy the whole (long-
-        # lived, growing) interner table per merge.
-        strings = self.interner.strings
-
-        def decode_col(col):
-            return [strings[i] if i >= 0 else None for i in col.tolist()]
-
+        # One object-array gather per chain column (NULL_ID wraps to the
+        # mirror's trailing None); the mirror is cached on the interner.
+        table = self.interner.object_table()
         refs = ref[:n_out]
         sides = (refs >> 30).tolist()
         idxs = (refs & ((1 << 30) - 1)).tolist()
-        addr_s = decode_col(c_addr[:n_out])
-        file_s = decode_col(c_file[:n_out])
-        name_s = decode_col(c_name[:n_out])
+        addr_s = table[c_addr[:n_out]].tolist()
+        file_s = table[c_file[:n_out]].tolist()
+        name_s = table[c_name[:n_out]].tolist()
 
         conflicts: List[Conflict] = []
         if has_cand:

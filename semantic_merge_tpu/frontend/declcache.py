@@ -128,9 +128,12 @@ def _attr_values(v):
     d = getattr(v, "__dict__", None)
     if d is not None:
         return d.values()
-    slots = getattr(type(v), "__slots__", None)
-    if slots is not None:
-        return [getattr(v, s, None) for s in slots]
+    names: list = []
+    for klass in type(v).__mro__:  # inherited slots live on base classes
+        slots = klass.__dict__.get("__slots__", ())
+        names.extend((slots,) if isinstance(slots, str) else slots)
+    if names:
+        return [getattr(v, s, None) for s in names]
     return None
 
 

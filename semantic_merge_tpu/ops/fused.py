@@ -48,6 +48,7 @@ Replaces the hot path of reference ``workers/ts/src/diff.ts:5-31``,
 """
 from __future__ import annotations
 
+import os
 from collections import OrderedDict
 from functools import lru_cache, partial
 from typing import Dict, List, Optional, Tuple
@@ -407,10 +408,12 @@ def _merge_scan_spec(a, b, C: int):
             place(chain_name))
 
 
-@partial(jax.jit, static_argnames=("nb", "nl", "nr", "C", "B", "W"))
+@partial(jax.jit,
+         static_argnames=("nb", "nl", "nr", "C", "B", "W", "split"))
 def _fused_merge_kernel(b_cols, l_cols, r_cols, tab_b, tab_l,
                         pre_l, plen_l, pre_r, plen_r,
-                        nb: int, nl: int, nr: int, C: int, B: int, W: int):
+                        nb: int, nl: int, nr: int, C: int, B: int, W: int,
+                        split: bool = False):
     planL = _diff_plan(b_cols[0], b_cols[1], b_cols[2],
                        l_cols[0], l_cols[1], l_cols[2], nb, nl)
     planR = _diff_plan(b_cols[0], b_cols[1], b_cols[2],
@@ -423,15 +426,20 @@ def _fused_merge_kernel(b_cols, l_cols, r_cols, tab_b, tab_l,
     wR = _op_id_words(kR, aR, bR, b_cols, r_cols, tab_b, tab_l,
                       pre_r, plen_r, C=C, B=B, W=W)
     return _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
-                             b_cols, l_cols, r_cols, C)
+                             b_cols, l_cols, r_cols, C, split=split)
 
 
 def _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
-                      b_cols, l_cols, r_cols, C: int):
+                      b_cols, l_cols, r_cols, C: int, split: bool = False):
     """Stages shared by the single-device and dp-sharded fused kernels:
     id ranking, compose columns, canonical sorts, candidate join,
     speculative merge+scan, and the compact flat packing. Inputs here
-    are full (replicated on every shard in the mesh case)."""
+    are full (replicated on every shard in the mesh case).
+
+    ``split=True`` returns ``(head, tail)`` instead of one vector —
+    byte-identical content, but the host can start async copies for
+    both and materialize the op streams (head) while the compose block
+    (tail) is still in flight through the device tunnel."""
     overflow = ((nopsL > C) | (nopsR > C)).astype(jnp.int32)
     # Global id ranks: 128-bit big-endian word lexsort over both streams
     # == lexicographic rank of the uuid-formatted id strings.
@@ -459,21 +467,26 @@ def _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
     scalars = jnp.stack([nopsL, nopsR, n_out, has_cand.astype(jnp.int32),
                          overflow, jnp.int32(0), jnp.int32(0), jnp.int32(0)])
     as_i32 = partial(jax.lax.bitcast_convert_type, new_dtype=jnp.int32)
-    return jnp.concatenate([
+    head = jnp.concatenate([
         scalars,
         kL, aL, bL, as_i32(wL[:, 0]), as_i32(wL[:, 1]),
         as_i32(wL[:, 2]), as_i32(wL[:, 3]),
         kR, aR, bR, as_i32(wR[:, 0]), as_i32(wR[:, 1]),
         as_i32(wR[:, 2]), as_i32(wR[:, 3]),
+    ])
+    tail = jnp.concatenate([
         a["op_index"], b["op_index"],
         ref, c_addr, c_file, c_name,
     ])
+    if split:
+        return head, tail
+    return jnp.concatenate([head, tail])
 
 
 def _fused_merge_sharded_core(b_st, l_st, r_st, tab_b, tab_l,
                               pre_l, plen_l, pre_r, plen_r,
                               *, nb: int, nl: int, nr: int, C: int, B: int,
-                              W: int, k: int):
+                              W: int, k: int, split: bool = False):
     """Per-shard body of the dp-sharded fused merge.
 
     The decl axis shards over ``dp``: the diff join runs as the
@@ -518,7 +531,7 @@ def _fused_merge_sharded_core(b_st, l_st, r_st, tab_b, tab_l,
     wL = words_for(kL, aL, bL, l_full, pre_l, plen_l)
     wR = words_for(kR, aR, bR, r_full, pre_r, plen_r)
     return _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
-                             b_full, l_full, r_full, C)
+                             b_full, l_full, r_full, C, split=split)
 
 
 @partial(jax.jit, static_argnames=("nb", "ns", "C", "B", "W"))
@@ -542,14 +555,14 @@ def _fused_diff_kernel(b_cols, s_cols, tab_b, tab_l, pre, plen,
 
 @lru_cache(maxsize=None)
 def _sharded_fn(mesh, nb: int, nl: int, nr: int,
-                C: int, B: int, W: int, k: int):
+                C: int, B: int, W: int, k: int, split: bool = False):
     from jax.sharding import PartitionSpec as P
 
     from .sharded import AXIS
     decl = P(None, AXIS)
     return jax.jit(jax.shard_map(
         partial(_fused_merge_sharded_core, nb=nb, nl=nl, nr=nr,
-                C=C, B=B, W=W, k=k),
+                C=C, B=B, W=W, k=k, split=split),
         mesh=mesh, in_specs=(decl, decl, decl, P(), P(), P(), P(), P(), P()),
         out_specs=P(), check_vma=False))
 
@@ -766,12 +779,19 @@ class FusedMergeEngine:
         if phases is not None:
             phases["h2d"] = phases.get("h2d", 0.0) + time.perf_counter() - t0
 
-        flat = None
+        # Split-fetch mode: the kernel returns (head, tail) so the host
+        # can materialize the op streams from head while the compose
+        # block is still streaming through the device tunnel. Opt-in —
+        # whether two pipelined fetches beat one packed fetch depends on
+        # the transport (measure on the target link before enabling).
+        split = os.environ.get("SEMMERGE_SPLIT_FETCH", "0") == "1"
+        flat = tail_dev = None
         for _attempt in range(4):
             C = self._bucket(max(self._cap_hint, 8 * self._dp))
             t0 = time.perf_counter()
             if self.mesh is not None:
-                fn = _sharded_fn(self.mesh, nb, nl, nr, C, B, W, self._dp)
+                fn = _sharded_fn(self.mesh, nb, nl, nr, C, B, W, self._dp,
+                                 split)
                 out_dev = fn(dev_b, dev_l, dev_r, tab_b, tab_l,
                              pl, np.int32(len(pre_l)),
                              pr, np.int32(len(pre_r)))
@@ -779,18 +799,27 @@ class FusedMergeEngine:
                 out_dev = _fused_merge_kernel(
                     dev_b, dev_l, dev_r, tab_b, tab_l,
                     pl, np.int32(len(pre_l)), pr, np.int32(len(pre_r)),
-                    nb=nb, nl=nl, nr=nr, C=C, B=B, W=W)
+                    nb=nb, nl=nl, nr=nr, C=C, B=B, W=W, split=split)
+            head_dev, tail_dev = out_dev if split else (out_dev, None)
             if overlap_work is not None:
                 # Dispatch is async: host-side work here rides along
                 # with the device execution.
                 overlap_work()
                 overlap_work = None  # once per merge, not per retry
             if phases is not None:
-                out_dev.block_until_ready()
+                head_dev.block_until_ready()
+                if tail_dev is not None:
+                    tail_dev.block_until_ready()
                 phases["kernel"] = (phases.get("kernel", 0.0)
                                     + time.perf_counter() - t0)
                 t0 = time.perf_counter()
-            flat = np.asarray(out_dev)
+            if split:
+                for d in (head_dev, tail_dev):
+                    try:
+                        d.copy_to_host_async()
+                    except AttributeError:
+                        pass
+            flat = np.asarray(head_dev)
             if phases is not None:
                 phases["fetch"] = (phases.get("fetch", 0.0)
                                    + time.perf_counter() - t0)
@@ -815,9 +844,6 @@ class FusedMergeEngine:
         wL = np.stack([take(C) for _ in range(4)], axis=1)
         kR, aR, bR = take(C), take(C), take(C)
         wR = np.stack([take(C) for _ in range(4)], axis=1)
-        permL, permR = take(C), take(C)
-        ref, c_addr, c_file, c_name = (take(2 * C), take(2 * C),
-                                       take(2 * C), take(2 * C))
 
         ops_l = _materialize_stream(kL[:n_l], aL[:n_l], bL[:n_l], wL[:n_l],
                                     base_nodes, left_nodes,
@@ -829,6 +855,17 @@ class FusedMergeEngine:
             phases["materialize"] = (phases.get("materialize", 0.0)
                                      + time.perf_counter() - t0)
             t0 = time.perf_counter()
+
+        if split:
+            # The tail's device→host copy overlapped materialization.
+            flat, off = np.asarray(tail_dev), 0
+            if phases is not None:
+                phases["fetch"] = (phases.get("fetch", 0.0)
+                                   + time.perf_counter() - t0)
+                t0 = time.perf_counter()
+        permL, permR = take(C), take(C)
+        ref, c_addr, c_file, c_name = (take(2 * C), take(2 * C),
+                                       take(2 * C), take(2 * C))
 
         # One object-array gather per chain column (NULL_ID wraps to the
         # mirror's trailing None); the mirror is cached on the interner.

@@ -240,3 +240,33 @@ def test_fused_two_way_diff_parity():
         ops_h = host.diff(base, left, base_rev="r", seed="s",
                           timestamp="2026-01-01T00:00:00Z")
         assert _dicts(ops_t) == _dicts(ops_h)
+
+
+def test_fused_split_fetch_parity(monkeypatch):
+    """SEMMERGE_SPLIT_FETCH=1 returns the packed result as (head, tail)
+    with pipelined device→host copies — content must be byte-identical
+    to the single-fetch mode, on both the single-device and dp-sharded
+    kernels, including a conflict workload."""
+    import jax
+    import bench
+    from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+    from semantic_merge_tpu.parallel.mesh import build_mesh
+
+    monkeypatch.setenv("SEMMERGE_SPLIT_FETCH", "1")
+    host = get_backend("host")
+    mesh = build_mesh(jax.devices(), dp=8, pp=1, sp=1, tp=1, ep=1).mesh
+    for tpu in (fused_backend(), TpuTSBackend(mesh=mesh)):
+        for files, divergent in ((60, False), (97, True)):
+            base, left, right = bench.synth_repo(files, 3, divergent=divergent)
+            res_t, comp_t, conf_t = run_merge(
+                tpu, base, left, right, seed="b", base_rev="b",
+                timestamp="2026-01-01T00:00:00Z")
+            res_h, comp_h, conf_h = run_merge(
+                host, base, left, right, seed="b", base_rev="b",
+                timestamp="2026-01-01T00:00:00Z")
+            assert _dicts(res_t.op_log_left) == _dicts(res_h.op_log_left)
+            assert _dicts(res_t.op_log_right) == _dicts(res_h.op_log_right)
+            assert _dicts(comp_t) == _dicts(comp_h)
+            assert [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_h]
+            if divergent:
+                assert conf_t

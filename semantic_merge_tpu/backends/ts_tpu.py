@@ -15,6 +15,7 @@ applies to both backends identically.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from functools import partial
 from typing import Dict, List
 
@@ -60,6 +61,14 @@ class TpuTSBackend:
         # shared decl cache (keyed by scan identity + interner token).
         self._interner = Interner()
         self._fused = None
+        # Snapshot-level encode cache: (interner token, per-file scan
+        # keys) → (DeclTensor, flat node list). Repeated merges against
+        # an unchanged tree skip interning + concatenation entirely
+        # (values are treated as immutable downstream). Kept tiny (a
+        # 3-way merge touches 3 snapshots, +1 slack) because entries pin
+        # node lists outside the decl cache's byte budget; cleared on
+        # interner reset.
+        self._snap_cache: "OrderedDict" = OrderedDict()
 
     def _fused_engine(self):
         from ..ops.fused import FusedMergeEngine
@@ -79,6 +88,9 @@ class TpuTSBackend:
         one merge, whose interned ids must share one id space."""
         if len(self._interner) > 4_000_000:
             self._interner = Interner()
+            # Every snapshot-cache entry is keyed by the dead token and
+            # can never hit again — drop them now, not by LRU attrition.
+            self._snap_cache.clear()
 
     def _scan_encode_keyed(self, snapshot: Snapshot):
         """Scan+encode, also returning the snapshot's stable identity
@@ -87,13 +99,22 @@ class TpuTSBackend:
         columns. ``None`` when any file lacks a stable key."""
         from ..frontend.declcache import global_cache
         keyed = scan_snapshot_keyed(ts_files(snapshot))
-        t, nodes = encode_decls_keyed(keyed, self._interner, global_cache())
         identity = None
         keys = [k for k, _ in keyed]
         if keys and all(k is not None for k in keys):
             identity = (self._interner.token, tuple(keys))
         elif not keys:
             identity = (self._interner.token, ())
+        if identity is not None:
+            hit = self._snap_cache.get(identity)
+            if hit is not None:
+                self._snap_cache.move_to_end(identity)
+                return hit[0], hit[1], identity
+        t, nodes = encode_decls_keyed(keyed, self._interner, global_cache())
+        if identity is not None:
+            self._snap_cache[identity] = (t, nodes)
+            while len(self._snap_cache) > 4:
+                self._snap_cache.popitem(last=False)
         return t, nodes, identity
 
     def configure(self, config) -> None:

@@ -30,9 +30,15 @@ class Snapshot:
 
 
 def filter_files(snap: Snapshot, extensions) -> List[Dict[str, str]]:
-    """The subset of a snapshot's files a backend can index."""
-    return [f for f in snap.files
-            if any(f["path"].endswith(ext) for ext in extensions)]
+    """The subset of a snapshot's files a backend can index.
+
+    ``str.endswith`` takes the whole suffix tuple in C — this runs per
+    file per scan (30k×/snapshot at the 10k-file bench rung), where a
+    Python-level ``any(...)`` generator showed up in profiles. Suffix
+    *match* semantics (not exact-extension): ``foo.d.ts`` matches
+    ``.ts``, as in the reference bridge's filter."""
+    suffixes = tuple(extensions)
+    return [f for f in snap.files if f["path"].endswith(suffixes)]
 
 
 def snapshot_tree(root: pathlib.Path) -> Snapshot:

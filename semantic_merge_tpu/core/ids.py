@@ -22,8 +22,11 @@ demands.
 """
 from __future__ import annotations
 
+import functools
 import hashlib
 from typing import Any
+
+from .ops import OP_TYPES
 
 EPOCH_ISO = "1970-01-01T00:00:00Z"
 
@@ -34,9 +37,54 @@ def stable_hash_hex(*parts: Any, n_hex: int = 64) -> str:
     return hashlib.sha256(payload.encode("utf-8")).hexdigest()[:n_hex]
 
 
-def deterministic_op_id(seed: str, *content: Any) -> str:
-    """A UUID-shaped (8-4-4-4-12) deterministic id."""
-    h = stable_hash_hex(seed, *content, n_hex=32)
+#: Stable 1-byte code per schema op type (OP_TYPES is schema-ordered and
+#: append-only). The device diff kinds 0-3 coincide with the first four.
+_TYPE_CODE = {t: i for i, t in enumerate(OP_TYPES)}
+# Load-bearing: the device hashes clip(kind, 0, 3) straight into the id
+# payload (ops/fused._op_id_words), so the KIND_* codes MUST stay equal
+# to these type codes — reordering OP_TYPES would silently fork ids.
+assert [_TYPE_CODE[t] for t in
+        ("renameSymbol", "moveDecl", "addDecl", "deleteDecl")] == [0, 1, 2, 3]
+
+
+@functools.lru_cache(maxsize=4096)
+def op_id_prefix_digest(seed: str, rev: str) -> bytes:
+    """16-byte digest of the (seed, rev) pair — the per-merge-side
+    constant prefix of every op-id payload."""
+    return hashlib.sha256(f"{seed}|{rev}".encode("utf-8")).digest()[:16]
+
+
+@functools.lru_cache(maxsize=262144)
+def value_digest10(s: str) -> bytes:
+    """80-bit value hash of a string (``b"\\0"*10`` for the empty
+    string / absent value). Cached: symbol/address/file strings repeat
+    across the tens of thousands of ops of a large merge, and the
+    device path ships exactly these digests in its hash table."""
+    if not s:
+        return b"\0" * 10
+    return hashlib.sha256(s.encode("utf-8")).digest()[:10]
+
+
+def deterministic_op_id(seed: str, rev: str = "", idx: int = 0,
+                        op_type: str = "", sym: str = "",
+                        a_addr: str = "", b_addr: str = "") -> str:
+    """A UUID-shaped (8-4-4-4-12) deterministic id.
+
+    SHA-256 over ONE fixed 51-byte payload: ``prefix_digest(seed, rev)
+    (16) ‖ idx be32 (4) ‖ type code (1) ‖ h80(sym) ‖ h80(aAddr) ‖
+    h80(bAddr)``. Fixed width keeps the device twin to a single SHA
+    block with no byte-assembly gathers (the variable-length ASCII
+    payload of the v1 scheme was ~2/3 of the fused kernel's compute);
+    the 80-bit string digests keep collision odds negligible at
+    repo-scale string counts. Identity properties are unchanged: ids
+    are pure functions of (seed, rev, index, type, symbol, addresses).
+    """
+    payload = (op_id_prefix_digest(seed, rev)
+               + int(idx).to_bytes(4, "big")
+               + bytes([_TYPE_CODE.get(op_type, 255)])
+               + value_digest10(sym) + value_digest10(a_addr)
+               + value_digest10(b_addr))
+    h = hashlib.sha256(payload).hexdigest()[:32]
     return f"{h[0:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:32]}"
 
 

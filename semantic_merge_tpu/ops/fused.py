@@ -16,11 +16,12 @@ ONE jitted program and ONE compact fetch:
    :mod:`semantic_merge_tpu.ops.diff`, emitting ``(kind, base-slot,
    side-slot)`` rows (slots index the scanned decl lists, so the host
    can materialize ops without any interned-string round trip);
-2. **op identity on device** — each op's deterministic id payload
-   (``seed|rev|idx|type|sym|aAddr|bAddr``, see
-   :mod:`semantic_merge_tpu.core.ids`) is assembled as bytes from a
-   device-resident string table and hashed with the batched SHA-256 of
-   :mod:`semantic_merge_tpu.ops.sha256`;
+2. **op identity on device** — each op's deterministic id payload (a
+   fixed 51-byte block: (seed, rev) prefix digest ‖ index ‖ type code
+   ‖ three 80-bit string value digests, see
+   :mod:`semantic_merge_tpu.core.ids`) is assembled from a
+   device-resident string-hash table and hashed in ONE compression by
+   the batched SHA-256 of :mod:`semantic_merge_tpu.ops.sha256`;
 3. **id ranking** — the composition sort key ranks id *strings*
    (reference ``semmerge/compose.py:16-18``); UUID-formatted hex ids
    with dashes at fixed positions order exactly like their leading
@@ -66,126 +67,79 @@ from .compose import (_PAD_PREC, _local_seg_scan, _materialize_decoded,
 from .diff import KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME, _diff_plan
 from .sha256 import sha256_device
 
-#: Order must match the KIND_* codes (0..3).
-_TYPE_NAMES = ("renameSymbol", "moveDecl", "addDecl", "deleteDecl")
-#: OP_PRECEDENCE of each kind code (core/ops.py).
+#: OP_PRECEDENCE of each KIND_* code (core/ops.py).
 _PREC_BY_KIND = np.asarray([11, 10, 30, 31], dtype=np.int32)
 
-_PREFIX_CAP = 96     # seed|rev| byte capacity (fall back beyond)
-_TYPE_SEG_CAP = 16   # "|renameSymbol|" is 14 bytes
-_DIGIT_CAP = 8       # op index < 10**8 always (capacity is ~2**20)
-
-# "|<type>|" segments, padded to _TYPE_SEG_CAP.
-_TYPE_SEG = np.zeros((4, _TYPE_SEG_CAP), dtype=np.uint8)
-_TYPE_SEG_LEN = np.zeros((4,), dtype=np.int32)
-for _k, _name in enumerate(_TYPE_NAMES):
-    _seg = ("|" + _name + "|").encode("ascii")
-    _TYPE_SEG[_k, :len(_seg)] = np.frombuffer(_seg, dtype=np.uint8)
-    _TYPE_SEG_LEN[_k] = len(_seg)
+#: Byte length of the fixed op-id payload (core.ids.deterministic_op_id):
+#: prefix digest 16 + idx 4 + type code 1 + 3×10-byte string digests.
+_ID_PAYLOAD_LEN = 51
 
 
 class DeviceStrings:
-    """Device-resident byte table for an :class:`Interner`'s strings.
+    """Device-resident 80-bit value-hash table for an
+    :class:`Interner`'s strings.
 
-    The table is append-only (interner ids are stable), so warm merges
-    ship only the *new* strings' bytes — on the tunnel-attached TPU the
-    h2d cost of a repeated merge is a few hundred bytes, not megabytes.
-    Width and capacity grow in buckets (each growth is a full reship +
-    kernel recompile, amortized away by the append-only pattern).
+    One 10-byte ``core.ids.value_digest10`` row per interned string.
+    Append-only (interner ids are stable), so warm merges ship only the
+    *new* strings' digests — on the tunnel-attached TPU the h2d cost of
+    a repeated merge is a few hundred bytes. Fixed row width means no
+    growth-on-long-string geometry changes and no ineligible strings —
+    the fused path never falls back on string content.
     """
-
-    WIDTHS = (32, 64, 128, 256)
 
     def __init__(self, interner: Interner, sharding=None) -> None:
         self.interner = interner
         self.sharding = sharding  # replicated mesh sharding, or None
-        self._encoded: List[bytes] = []
-        self.width = self.WIDTHS[0]
         self.cap = 1024
-        self.max_len = 0  # true max byte length (sizes the SHA blocks)
-        self.disabled = False  # an oversized string disables the table
-        self._host_bytes = np.zeros((self.cap, self.width), dtype=np.uint8)
-        self._host_lens = np.zeros((self.cap,), dtype=np.int32)
-        self._dev_bytes = None
-        self._dev_lens = None
+        self._host = np.zeros((self.cap, 10), dtype=np.uint8)
+        self._n_hashed = 0
+        self._dev = None
         self._n_dev = 0  # rows synced to device
 
     def _put(self, arr):
         return (jax.device_put(arr, self.sharding) if self.sharding is not None
                 else jax.device_put(arr))
 
-    def sync(self) -> Optional[tuple]:
-        """Bring the device table up to date with the interner. Returns
-        ``(dev_bytes, dev_lens, width)`` or ``None`` when some string
-        exceeds the maximum supported width — permanently, since interned
-        strings live as long as the interner (the caller falls back to
-        the two-program path for every merge on this interner)."""
-        if self.disabled:
-            return None
+    def sync(self):
+        """Bring the device hash table up to date with the interner;
+        returns the device array (rows beyond the interned count are
+        zeros, never gathered by valid ids)."""
+        from ..core.ids import value_digest10
         strings = self.interner.strings
         n = len(strings)
-        new_max = 0
-        for s in strings[len(self._encoded):]:
-            b = s.encode("utf-8")
-            self._encoded.append(b)
-            new_max = max(new_max, len(b))
-        if new_max > self.WIDTHS[-1]:
-            self.disabled = True
-            return None
-        self.max_len = max(self.max_len, new_max)
-        width = self.width
-        while new_max > width:
-            width = self.WIDTHS[self.WIDTHS.index(width) + 1]
         cap = self.cap
         while n > cap:
             cap *= 2
-        if width != self.width or cap != self.cap:
-            # Geometry change: rebuild the host mirror, full reship.
-            self.width, self.cap = width, cap
-            self._host_bytes = np.zeros((cap, width), dtype=np.uint8)
-            self._host_lens = np.zeros((cap,), dtype=np.int32)
-            for i, b in enumerate(self._encoded):
-                self._host_bytes[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
-                self._host_lens[i] = len(b)
-            self._dev_bytes = self._put(self._host_bytes)
-            self._dev_lens = self._put(self._host_lens)
+        if cap != self.cap:
+            grown = np.zeros((cap, 10), dtype=np.uint8)
+            grown[:self._n_hashed] = self._host[:self._n_hashed]
+            self._host, self.cap = grown, cap
+            self._dev = None  # geometry change: full reship
+        if n > self._n_hashed:
+            view = self._host
+            for i in range(self._n_hashed, n):
+                view[i] = np.frombuffer(value_digest10(strings[i]), np.uint8)
+            self._n_hashed = n
+        if self._dev is None:
+            self._dev = self._put(self._host)
             self._n_dev = n
-            return self._dev_bytes, self._dev_lens, self.width
-        if n > self._n_dev or self._dev_bytes is None:
-            start = self._n_dev if self._dev_bytes is not None else 0
-            for i in range(start, n):
-                b = self._encoded[i]
-                self._host_bytes[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
-                self._host_lens[i] = len(b)
-            if self._dev_bytes is None:
-                self._dev_bytes = self._put(self._host_bytes)
-                self._dev_lens = self._put(self._host_lens)
+        elif n > self._n_dev:
+            # Ship only the delta, padded to a power-of-two row count
+            # so the update-slice kernel compiles O(log) variants.
+            rows = bucket_size(n - self._n_dev, minimum=8)
+            if self._n_dev + rows > self.cap:
+                self._dev = self._put(self._host)
             else:
-                # Ship only the delta, padded to a power-of-two row count
-                # so the update-slice kernel compiles O(log) variants.
-                rows = bucket_size(n - start, minimum=8)
-                if start + rows > self.cap:
-                    self._dev_bytes = self._put(self._host_bytes)
-                    self._dev_lens = self._put(self._host_lens)
-                else:
-                    upd_b = self._host_bytes[start:start + rows]
-                    upd_l = self._host_lens[start:start + rows]
-                    self._dev_bytes = _dev_update2(self._dev_bytes, upd_b,
-                                                   np.int32(start))
-                    self._dev_lens = _dev_update1(self._dev_lens, upd_l,
-                                                  np.int32(start))
+                upd = self._host[self._n_dev:self._n_dev + rows]
+                self._dev = _dev_update2(self._dev, upd,
+                                         np.int32(self._n_dev))
             self._n_dev = n
-        return self._dev_bytes, self._dev_lens, self.width
+        return self._dev
 
 
 @jax.jit
 def _dev_update2(buf, upd, start):
     return jax.lax.dynamic_update_slice(buf, upd, (start, jnp.int32(0)))
-
-
-@jax.jit
-def _dev_update1(buf, upd, start):
-    return jax.lax.dynamic_update_slice(buf, upd, (start,))
 
 
 # --------------------------------------------------------------------------
@@ -222,15 +176,20 @@ def _emit_slots(plan, C: int, nb: int, ns: int):
     return cols[0], cols[1], cols[2], plan["n_ops"]
 
 
-def _op_id_words(kind, a_slot, b_slot, b_cols, s_cols, tab_b, tab_l,
-                 prefix, prefix_len, *, C: int, B: int, W: int, idx0=0):
-    """Assemble each op's id payload bytes and hash them: uint32 [C, 4].
+def _op_id_words(kind, a_slot, b_slot, b_cols, s_cols, hash_tab,
+                 pre_digest, *, C: int, idx0=0):
+    """Assemble each op's fixed-width id payload and hash it: uint32 [C, 4].
 
-    Payload layout (must match ``core.ids.deterministic_op_id``):
-    ``<seed>|<rev>|`` (prefix) + decimal op index + ``|<type>|`` +
-    symbolId + ``|`` + aAddr + ``|`` + bAddr. ``idx0`` offsets the
-    decimal op index — the sharded kernel hashes row blocks, so block
-    ``j`` passes ``idx0 = j * rows_per_shard``.
+    Payload layout (must match ``core.ids.deterministic_op_id``): the
+    16-byte (seed, rev) prefix digest ‖ op index be32 ‖ type code ‖
+    three 10-byte string value digests gathered from ``hash_tab``
+    (zeros for absent values — ``value_digest10("")``). 51 bytes always,
+    so the SHA runs exactly ONE compression per row with a fixed
+    concatenate instead of variable-length byte compaction (the v1
+    ASCII payload was ~2/3 of the fused kernel's device time). Device
+    kind codes 0-3 equal the ``OP_TYPES`` type codes by construction.
+    ``idx0`` offsets the op index — the sharded kernel hashes row
+    blocks, so block ``j`` passes ``idx0 = j * rows_per_shard``.
     """
     b_sym, b_addr = b_cols[0], b_cols[1]
     s_sym, s_addr = s_cols[0], s_cols[1]
@@ -243,85 +202,25 @@ def _op_id_words(kind, a_slot, b_slot, b_cols, s_cols, tab_b, tab_l,
     b_id = jnp.where((kind == KIND_MOVE) | (kind == KIND_RENAME) | is_add,
                      s_addr[b_sl], NULL_ID)
 
-    cap = tab_l.shape[0]
+    cap = hash_tab.shape[0]
 
-    def slen(sid):
-        return jnp.where(sid >= 0, tab_l[jnp.clip(sid, 0, cap - 1)], 0)
-
-    sym_len, a_len, b_len = slen(sym_id), slen(a_id), slen(b_id)
+    def hrows(sid):
+        row = hash_tab[jnp.clip(sid, 0, cap - 1)]
+        return jnp.where((sid >= 0)[:, None], row, jnp.uint8(0))
 
     idx = idx0 + jnp.arange(C, dtype=jnp.int32)
-    pow10 = jnp.asarray([10 ** t for t in range(_DIGIT_CAP)], jnp.int32)
-    di = jnp.int32(1) + sum((idx >= pow10[t]).astype(jnp.int32)
-                            for t in range(1, _DIGIT_CAP))
-
-    kc = jnp.clip(kind, 0, 3)
-    ttab = jnp.asarray(_TYPE_SEG)
-    tlen = jnp.asarray(_TYPE_SEG_LEN)[kc]
-
-    one = jnp.ones((C,), jnp.int32)
-    o1 = jnp.full((C,), prefix_len, jnp.int32)
-    o2 = o1 + di
-    o3 = o2 + tlen
-    o4 = o3 + sym_len
-    o5 = o4 + one
-    o6 = o5 + a_len
-    o7 = o6 + one
-    msg_len = o7 + b_len
-
-    # Two-step assembly, built for cheap gathers: elementwise 2D gathers
-    # are pathological on both XLA CPU and TPU, so (1) every variable
-    # part lands in a per-row STAGING buffer at a *static* column offset
-    # via whole-row gathers (table rows, type rows), then (2) one
-    # elementwise gather compacts staging into the contiguous message
-    # using an affine per-segment index map.
-    pcap = prefix.shape[0]
-    s_dig = pcap
-    s_typ = s_dig + _DIGIT_CAP
-    s_sym = s_typ + _TYPE_SEG_CAP
-    s_p1 = s_sym + W
-    s_a = s_p1 + 1
-    s_p2 = s_a + W
-    s_b = s_p2 + 1
-
-    k = jnp.arange(_DIGIT_CAP, dtype=jnp.int32)[None, :]
-    e = jnp.clip(di[:, None] - 1 - k, 0, _DIGIT_CAP - 1)
-    digit_block = (48 + (idx[:, None] // pow10[e]) % 10).astype(jnp.uint8)
-
-    def rows(sid):
-        return tab_b[jnp.clip(sid, 0, cap - 1)]
-
-    pipe_col = jnp.full((C, 1), 124, jnp.uint8)  # '|'
-    staging = jnp.concatenate([
-        jnp.broadcast_to(prefix[None, :], (C, pcap)),
-        digit_block,
-        ttab[kc],
-        rows(sym_id),
-        pipe_col,
-        rows(a_id),
-        pipe_col,
-        rows(b_id),
+    idx_be = jnp.stack([idx >> 24, idx >> 16, idx >> 8, idx],
+                       axis=1).astype(jnp.uint8)
+    kc = jnp.clip(kind, 0, 3).astype(jnp.uint8)[:, None]
+    msg = jnp.concatenate([
+        jnp.broadcast_to(pre_digest[None, :], (C, 16)),
+        idx_be,
+        kc,
+        hrows(sym_id), hrows(a_id), hrows(b_id),
+        jnp.zeros((C, 64 - _ID_PAYLOAD_LEN), jnp.uint8),
     ], axis=1)
-
-    MSG = B * 64
-    j = jnp.arange(MSG, dtype=jnp.int32)[None, :]
-
-    def seg(src_idx, start, stage_off):
-        return jnp.where(j >= start[:, None],
-                         stage_off + (j - start[:, None]), src_idx)
-
-    src_idx = j  # prefix segment at staging offset 0
-    src_idx = seg(src_idx, o1, s_dig)
-    src_idx = seg(src_idx, o2, s_typ)
-    src_idx = seg(src_idx, o3, s_sym)
-    src_idx = seg(src_idx, o4, s_p1)
-    src_idx = seg(src_idx, o5, s_a)
-    src_idx = seg(src_idx, o6, s_p2)
-    src_idx = seg(src_idx, o7, s_b)
-    src_idx = jnp.clip(src_idx, 0, staging.shape[1] - 1)
-    out = jnp.take_along_axis(staging, src_idx, axis=1)
-
-    return sha256_device(out, jnp.where(valid, msg_len, 9), n_words=4)
+    return sha256_device(msg, jnp.full((C,), _ID_PAYLOAD_LEN, jnp.int32),
+                         n_words=4)
 
 
 def _compose_cols(kind, a_slot, b_slot, id_rank, b_cols, s_cols, C: int):
@@ -408,11 +307,9 @@ def _merge_scan_spec(a, b, C: int):
             place(chain_name))
 
 
-@partial(jax.jit,
-         static_argnames=("nb", "nl", "nr", "C", "B", "W", "split"))
-def _fused_merge_kernel(b_cols, l_cols, r_cols, tab_b, tab_l,
-                        pre_l, plen_l, pre_r, plen_r,
-                        nb: int, nl: int, nr: int, C: int, B: int, W: int,
+@partial(jax.jit, static_argnames=("nb", "nl", "nr", "C", "split"))
+def _fused_merge_kernel(b_cols, l_cols, r_cols, hash_tab, dig_l, dig_r,
+                        nb: int, nl: int, nr: int, C: int,
                         split: bool = False):
     planL = _diff_plan(b_cols[0], b_cols[1], b_cols[2],
                        l_cols[0], l_cols[1], l_cols[2], nb, nl)
@@ -421,10 +318,8 @@ def _fused_merge_kernel(b_cols, l_cols, r_cols, tab_b, tab_l,
     kL, aL, bL, nopsL = _emit_slots(planL, C, nb, nl)
     kR, aR, bR, nopsR = _emit_slots(planR, C, nb, nr)
 
-    wL = _op_id_words(kL, aL, bL, b_cols, l_cols, tab_b, tab_l,
-                      pre_l, plen_l, C=C, B=B, W=W)
-    wR = _op_id_words(kR, aR, bR, b_cols, r_cols, tab_b, tab_l,
-                      pre_r, plen_r, C=C, B=B, W=W)
+    wL = _op_id_words(kL, aL, bL, b_cols, l_cols, hash_tab, dig_l, C=C)
+    wR = _op_id_words(kR, aR, bR, b_cols, r_cols, hash_tab, dig_r, C=C)
     return _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
                              b_cols, l_cols, r_cols, C, split=split)
 
@@ -483,10 +378,9 @@ def _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
     return jnp.concatenate([head, tail])
 
 
-def _fused_merge_sharded_core(b_st, l_st, r_st, tab_b, tab_l,
-                              pre_l, plen_l, pre_r, plen_r,
-                              *, nb: int, nl: int, nr: int, C: int, B: int,
-                              W: int, k: int, split: bool = False):
+def _fused_merge_sharded_core(b_st, l_st, r_st, hash_tab, dig_l, dig_r,
+                              *, nb: int, nl: int, nr: int, C: int,
+                              k: int, split: bool = False):
     """Per-shard body of the dp-sharded fused merge.
 
     The decl axis shards over ``dp``: the diff join runs as the
@@ -521,29 +415,27 @@ def _fused_merge_sharded_core(b_st, l_st, r_st, tab_b, tab_l,
     j = lax.axis_index(AXIS)
     Tc = C // k
 
-    def words_for(kind, a_slot, b_slot, s_full, pre, plen):
+    def words_for(kind, a_slot, b_slot, s_full, dig):
         sl = lambda x: lax.dynamic_slice(x, (j * Tc,), (Tc,))  # noqa: E731
         w_my = _op_id_words(sl(kind), sl(a_slot), sl(b_slot), b_full, s_full,
-                            tab_b, tab_l, pre, plen, C=Tc, B=B, W=W,
-                            idx0=j * Tc)
+                            hash_tab, dig, C=Tc, idx0=j * Tc)
         return lax.all_gather(w_my, AXIS, tiled=True)
 
-    wL = words_for(kL, aL, bL, l_full, pre_l, plen_l)
-    wR = words_for(kR, aR, bR, r_full, pre_r, plen_r)
+    wL = words_for(kL, aL, bL, l_full, dig_l)
+    wR = words_for(kR, aR, bR, r_full, dig_r)
     return _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
                              b_full, l_full, r_full, C, split=split)
 
 
-@partial(jax.jit, static_argnames=("nb", "ns", "C", "B", "W"))
-def _fused_diff_kernel(b_cols, s_cols, tab_b, tab_l, pre, plen,
-                       nb: int, ns: int, C: int, B: int, W: int):
+@partial(jax.jit, static_argnames=("nb", "ns", "C"))
+def _fused_diff_kernel(b_cols, s_cols, hash_tab, dig,
+                       nb: int, ns: int, C: int):
     """Two-way variant (the ``semdiff`` path): diff join + device op
     identity in one program/one fetch; no compose stages."""
     plan = _diff_plan(b_cols[0], b_cols[1], b_cols[2],
                       s_cols[0], s_cols[1], s_cols[2], nb, ns)
     k_, a_, b_, n_ops = _emit_slots(plan, C, nb, ns)
-    w = _op_id_words(k_, a_, b_, b_cols, s_cols, tab_b, tab_l,
-                     pre, plen, C=C, B=B, W=W)
+    w = _op_id_words(k_, a_, b_, b_cols, s_cols, hash_tab, dig, C=C)
     overflow = (n_ops > C).astype(jnp.int32)
     scalars = jnp.stack([n_ops, overflow] + [jnp.int32(0)] * 6)
     as_i32 = partial(jax.lax.bitcast_convert_type, new_dtype=jnp.int32)
@@ -555,15 +447,15 @@ def _fused_diff_kernel(b_cols, s_cols, tab_b, tab_l, pre, plen,
 
 @lru_cache(maxsize=None)
 def _sharded_fn(mesh, nb: int, nl: int, nr: int,
-                C: int, B: int, W: int, k: int, split: bool = False):
+                C: int, k: int, split: bool = False):
     from jax.sharding import PartitionSpec as P
 
     from .sharded import AXIS
     decl = P(None, AXIS)
     return jax.jit(jax.shard_map(
         partial(_fused_merge_sharded_core, nb=nb, nl=nl, nr=nr,
-                C=C, B=B, W=W, k=k, split=split),
-        mesh=mesh, in_specs=(decl, decl, decl, P(), P(), P(), P(), P(), P()),
+                C=C, k=k, split=split),
+        mesh=mesh, in_specs=(decl, decl, decl, P(), P(), P()),
         out_specs=P(), check_vma=False))
 
 
@@ -699,25 +591,16 @@ class FusedMergeEngine:
         exactly what this removes."""
         if self.mesh is not None:
             return None
-        pre = f"{seed}/R|{base_rev}|".encode("utf-8")
-        if len(pre) > _PREFIX_CAP:
-            return None
-        synced = self.strings.sync()
-        if synced is None:
-            return None
-        tab_b, tab_l, W = synced
+        from ..core.ids import op_id_prefix_digest
+        hash_tab = self.strings.sync()
+        dig = np.frombuffer(op_id_prefix_digest(seed + "/R", base_rev),
+                            np.uint8)
         dev_b, nb = self._device_decl(base_t, base_key)
         dev_s, ns = self._device_decl(side_t, side_key)
-        pa = np.zeros((_PREFIX_CAP,), np.uint8)
-        pa[:len(pre)] = np.frombuffer(pre, np.uint8)
-        q = lambda x: -(-x // 16) * 16  # noqa: E731
-        B = -(-(q(len(pre)) + _DIGIT_CAP + _TYPE_SEG_CAP
-                + 3 * q(self.strings.max_len) + 2 + 9) // 64)
         for _attempt in range(4):
             C = self._bucket(max(self._cap_hint, 8))
             flat = np.asarray(_fused_diff_kernel(
-                dev_b, dev_s, tab_b, tab_l, pa, np.int32(len(pre)),
-                nb=nb, ns=ns, C=C, B=B, W=W))
+                dev_b, dev_s, hash_tab, dig, nb=nb, ns=ns, C=C))
             n_ops = int(flat[0])
             if not flat[1]:
                 break
@@ -741,9 +624,11 @@ class FusedMergeEngine:
               *, seed: str, base_rev: str, timestamp: str,
               overlap_work=None, phases: Dict | None = None
               ) -> Optional[Tuple[List[Op], List[Op], List[Op], List[Conflict]]]:
-        """Run the one-round-trip merge; ``None`` when ineligible (a
-        string exceeds the table width, or the prefix exceeds its cap) —
-        the caller falls back to the two-program path.
+        """Run the one-round-trip merge; ``None`` only when the op
+        capacity retries exhaust — the caller falls back to the
+        two-program path. (The v1 byte-table scheme could also be
+        ineligible on oversized strings; the fixed-width hash-table ids
+        removed that class of fallback.)
 
         ``overlap_work`` (a no-arg callable) runs on the host between
         the async kernel dispatch and the blocking fetch — the
@@ -752,30 +637,17 @@ class FusedMergeEngine:
         device compute instead of serializing after it.
         """
         import time
-        pre_l = f"{seed}/L|{base_rev}|".encode("utf-8")
-        pre_r = f"{seed}/R|{base_rev}|".encode("utf-8")
-        if max(len(pre_l), len(pre_r)) > _PREFIX_CAP:
-            return None
 
+        from ..core.ids import op_id_prefix_digest
         t0 = time.perf_counter()
-        synced = self.strings.sync()
-        if synced is None:
-            return None
-        tab_b, tab_l, W = synced
+        hash_tab = self.strings.sync()
+        dig_l = np.frombuffer(op_id_prefix_digest(seed + "/L", base_rev),
+                              np.uint8)
+        dig_r = np.frombuffer(op_id_prefix_digest(seed + "/R", base_rev),
+                              np.uint8)
         dev_b, nb = self._device_decl(base_t, base_key)
         dev_l, nl = self._device_decl(left_t, left_key)
         dev_r, nr = self._device_decl(right_t, right_key)
-        pl = np.zeros((_PREFIX_CAP,), np.uint8)
-        pl[:len(pre_l)] = np.frombuffer(pre_l, np.uint8)
-        pr = np.zeros((_PREFIX_CAP,), np.uint8)
-        pr[:len(pre_r)] = np.frombuffer(pre_r, np.uint8)
-        # SHA block count from the *actual* max message length, not the
-        # table width cap — halves hash work in the common case. Inputs
-        # quantized to 16 so B only changes on real growth (a recompile).
-        q = lambda x: -(-x // 16) * 16  # noqa: E731
-        max_msg = (q(max(len(pre_l), len(pre_r))) + _DIGIT_CAP
-                   + _TYPE_SEG_CAP + 3 * q(self.strings.max_len) + 2 + 9)
-        B = -(-max_msg // 64)
         if phases is not None:
             phases["h2d"] = phases.get("h2d", 0.0) + time.perf_counter() - t0
 
@@ -790,16 +662,12 @@ class FusedMergeEngine:
             C = self._bucket(max(self._cap_hint, 8 * self._dp))
             t0 = time.perf_counter()
             if self.mesh is not None:
-                fn = _sharded_fn(self.mesh, nb, nl, nr, C, B, W, self._dp,
-                                 split)
-                out_dev = fn(dev_b, dev_l, dev_r, tab_b, tab_l,
-                             pl, np.int32(len(pre_l)),
-                             pr, np.int32(len(pre_r)))
+                fn = _sharded_fn(self.mesh, nb, nl, nr, C, self._dp, split)
+                out_dev = fn(dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r)
             else:
                 out_dev = _fused_merge_kernel(
-                    dev_b, dev_l, dev_r, tab_b, tab_l,
-                    pl, np.int32(len(pre_l)), pr, np.int32(len(pre_r)),
-                    nb=nb, nl=nl, nr=nr, C=C, B=B, W=W, split=split)
+                    dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
+                    nb=nb, nl=nl, nr=nr, C=C, split=split)
             head_dev, tail_dev = out_dev if split else (out_dev, None)
             if overlap_work is not None:
                 # Dispatch is async: host-side work here rides along

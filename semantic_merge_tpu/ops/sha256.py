@@ -1,7 +1,9 @@
 """Batched SHA-256 on device.
 
-The engine's deterministic op identity is ``sha256(seed|rev|idx|type|
-sym|aAddr|bAddr)`` (:mod:`semantic_merge_tpu.core.ids`, replacing the
+The engine's deterministic op identity is SHA-256 over a fixed
+51-byte payload — (seed, rev) prefix digest ‖ op index ‖ type code ‖
+three 80-bit string value digests (see
+:func:`semantic_merge_tpu.core.ids.deterministic_op_id`, replacing the
 reference's ``crypto.randomUUID()`` at reference
 ``workers/ts/src/lift.ts:5-9``) — and the composition sort key *ranks
 those ids* (reference ``semmerge/compose.py:16-18``). So a merge

@@ -270,3 +270,26 @@ def test_fused_split_fetch_parity(monkeypatch):
             assert [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_h]
             if divergent:
                 assert conf_t
+
+
+def test_snapshot_encode_cache_no_stale_hits():
+    """The backend-level snapshot encode cache is keyed by content
+    identity: mutating a file between merges on the SAME backend
+    instance must change the result (no stale tensor reuse)."""
+    tpu = fused_backend()
+    host = get_backend("host")
+    base = snap([("a.ts", "export function f(x: number): number { return x; }\n")])
+    left1 = snap([("a.ts", "export function g(x: number): number { return x; }\n")])
+    right = snap([("a.ts", "export function f(x: number): number { return x; }\n")])
+    _, comp1, _ = run_merge(tpu, base, left1, right, seed="s", base_rev="r",
+                            timestamp="2026-01-01T00:00:00Z")
+    assert any(o.type == "renameSymbol" for o in comp1)
+    # Second merge with a DIFFERENT rename on the same backend.
+    left2 = snap([("a.ts", "export function h(x: number): number { return x; }\n")])
+    _, comp2, _ = run_merge(tpu, base, left2, right, seed="s", base_rev="r",
+                            timestamp="2026-01-01T00:00:00Z")
+    _, comp2h, _ = run_merge(host, base, left2, right, seed="s", base_rev="r",
+                             timestamp="2026-01-01T00:00:00Z")
+    assert _dicts(comp2) == _dicts(comp2h)
+    renames = [o for o in comp2 if o.type == "renameSymbol"]
+    assert renames and renames[0].params["newName"] == "h"

@@ -215,6 +215,12 @@ def main() -> int:
     base, left, right = synth_repo(args.files, args.decls,
                                    divergent=conflicts_expected)
 
+    # Same GC posture as the CLI entry point (utils/gctune): default
+    # thresholds cost ~40% of warm merge wall at the 5k rung. Applied
+    # before the parity/warm runs so BOTH paths are measured under it.
+    from semantic_merge_tpu.utils.gctune import tune_for_merge
+    tune_for_merge()
+
     try:
         tpu = get_backend("tpu")
     except Exception as exc:  # in-process init can still fail post-probe

@@ -107,6 +107,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.command != "train-matcher":
+        # One-shot merge-shaped commands only: a training loop must
+        # keep normal collection cadence (see utils/gctune docstring).
+        from .utils.gctune import tune_for_merge
+        tune_for_merge()
     try:
         if args.command == "semdiff":
             return cmd_semdiff(args)

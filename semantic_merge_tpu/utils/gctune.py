@@ -1,0 +1,26 @@
+"""GC tuning for merge-sized batch work.
+
+A 10k-file merge materializes ~10^5 short-lived-looking but actually
+retained record objects (DeclNodes, Ops, dicts); CPython's default
+gen-0 threshold (700 allocations) makes the collector re-scan the
+growing object graph dozens of times during one merge — measured ~40%
+of warm wall time at the 5k-file bench rung (331 → 202 ms with the
+tuning below). For a batch CLI process that performs one merge and
+exits, freezing startup objects out of the young generations and
+raising the thresholds is the standard production posture.
+
+Called explicitly by entry points (CLI, bench) — never on library
+import: a host application embedding the library owns its own GC
+policy.
+"""
+from __future__ import annotations
+
+import gc
+
+
+def tune_for_merge() -> None:
+    """Freeze everything allocated so far into the permanent generation
+    and raise collection thresholds. Idempotent; cheap to call again."""
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(100_000, 50, 50)

@@ -210,22 +210,32 @@ def pad_to(arr: np.ndarray, size: int, fill) -> np.ndarray:
 
 
 def bucket_size(n: int, minimum: int = 8) -> int:
-    """Next power of two ≥ n — bounds the set of compiled shapes."""
+    """Smallest of ``{2^k, 3·2^(k-1)}`` ≥ ``n`` (and ≥ ``minimum``).
+
+    Still logarithmically many compiled shapes, but the half-step
+    ladder caps padding waste at 1/3 instead of 1/2: a 23k-op stream
+    pads to 24 576 rather than 32 768, and every device sort, scan,
+    hash and fetch over the axis shrinks proportionally (~25% at the
+    10k-file bench rung, where both the decl and op axes land just
+    above a power of two)."""
     size = minimum
     while size < n:
+        half = size + size // 2
+        if half >= n and size % 2 == 0:
+            return half
         size *= 2
     return size
 
 
 def shard_bucket(n: int, k: int = 1) -> int:
-    """Bucket that divides evenly into ``k`` shards: ``k`` × a power of
-    two ≥ ceil(n/k), at least 8 rows total. For ``k = 1`` this equals
+    """Bucket that divides evenly into ``k`` shards: ``k`` × a ladder
+    value ≥ ceil(n/k), at least 8 rows total. For ``k = 1`` this equals
     :func:`bucket_size`; for any ``k`` (including non-powers-of-two,
     e.g. a 6-device mesh) the padded axis is divisible by ``k`` while
-    the set of compiled shapes stays logarithmic in ``n``."""
-    per = bucket_size(max((n + k - 1) // k, 1), minimum=1)
-    while k * per < 8:
-        per *= 2
+    the set of compiled shapes stays logarithmic in ``n``. The ≥8-row
+    floor is folded into the ladder lookup so the result is always an
+    on-ladder multiple of ``k`` and monotonic in ``n``."""
+    per = bucket_size(max((n + k - 1) // k, (8 + k - 1) // k), minimum=1)
     return k * per
 
 

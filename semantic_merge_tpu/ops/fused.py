@@ -124,7 +124,7 @@ class DeviceStrings:
             self._dev = self._put(self._host)
             self._n_dev = n
         elif n > self._n_dev:
-            # Ship only the delta, padded to a power-of-two row count
+            # Ship only the delta, padded to a bucket-ladder row count
             # so the update-slice kernel compiles O(log) variants.
             rows = bucket_size(n - self._n_dev, minimum=8)
             if self._n_dev + rows > self.cap:

@@ -79,3 +79,21 @@ def test_divergent_rename_conflict_shape():
     assert [s["id"] for s in conf.suggestions] == ["keepA", "keepB"]
     assert "Rename to x" == conf.suggestions[0]["label"]
     json.dumps(conf.to_dict())  # serializable
+
+
+def test_bucket_ladder_invariants():
+    """Half-step shape buckets: on-ladder, monotonic, >= n; shard
+    buckets additionally divisible by k, >= 8 rows, and equal to
+    bucket_size for k = 1."""
+    from semantic_merge_tpu.core.encode import bucket_size, shard_bucket
+
+    assert [bucket_size(n) for n in (1, 8, 9, 12, 13, 17, 23000)] == \
+        [8, 8, 12, 12, 16, 24, 24576]
+    for k in (1, 2, 6, 8):
+        prev = 0
+        for n in range(1, 2000):
+            b = shard_bucket(n, k)
+            assert b >= max(n, 8) and b % k == 0 and b >= prev
+            prev = b
+    for n in range(1, 2000):
+        assert shard_bucket(n, 1) == bucket_size(n)

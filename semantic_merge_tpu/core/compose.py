@@ -94,7 +94,8 @@ def compose_oplogs(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List
     return out, conflicts
 
 
-def cursor_walk_conflicts(ops_a: List[Op], ops_b: List[Op]
+def cursor_walk_conflicts(ops_a: List[Op], ops_b: List[Op],
+                          keys_a=None, keys_b=None
                           ) -> Tuple[List[Conflict], set, set]:
     """The head-vs-head DivergentRename walk alone, over *already
     canonically sorted* streams: returns ``(conflicts, dropped_a,
@@ -106,14 +107,27 @@ def cursor_walk_conflicts(ops_a: List[Op], ops_b: List[Op]
     oracle on host only when its parallel candidate join fired, and
     patches the affected symbols. Same quirks as
     :func:`compose_oplogs`: detection only when both heads surface
-    simultaneously, both ops dropped, interleavings can mask."""
+    simultaneously, both ops dropped, interleavings can mask.
+
+    ``keys_a``/``keys_b`` optionally inject the per-op cross-stream
+    comparison keys (any ordered type, same semantics as
+    ``op.sort_key()[:2]``). The fused caller derives them vectorized
+    from its device kind columns — every op of one fused merge shares
+    one timestamp, so the key collapses to the precedence int and the
+    ~50k Python ``sort_key`` calls disappear."""
     conflicts: List[Conflict] = []
     dropped_a: set = set()
     dropped_b: set = set()
     # Keys precomputed once — the loop runs per op over merges that can
     # hold tens of thousands of ops.
-    keys_a = [op.sort_key()[:2] for op in ops_a]
-    keys_b = [op.sort_key()[:2] for op in ops_b]
+    if (keys_a is None) != (keys_b is None):
+        raise ValueError("inject both keys_a and keys_b or neither "
+                         "(mixed key types do not compare)")
+    if keys_a is None:
+        keys_a = [op.sort_key()[:2] for op in ops_a]
+        keys_b = [op.sort_key()[:2] for op in ops_b]
+    elif len(keys_a) != len(ops_a) or len(keys_b) != len(ops_b):
+        raise ValueError("injected keys must align 1:1 with the sorted streams")
     na, nb = len(ops_a), len(ops_b)
     ia = ib = 0
     while ia < na or ib < nb:

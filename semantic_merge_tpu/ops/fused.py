@@ -747,10 +747,17 @@ class FusedMergeEngine:
 
         conflicts: List[Conflict] = []
         if has_cand:
-            sorted_a = [ops_l[i] for i in permL[:n_l].tolist()]
-            sorted_b = [ops_r[i] for i in permR[:n_r].tolist()]
+            pL, pR = permL[:n_l], permR[:n_r]
+            sorted_a = [ops_l[i] for i in pL.tolist()]
+            sorted_b = [ops_r[i] for i in pR.tolist()]
+            # All ops of one fused merge share a single timestamp, so
+            # the walk's (prec, ts) keys collapse to precedence ints —
+            # derived vectorized from the fetched kind columns.
+            keys_a = _PREC_BY_KIND[kL[:n_l][pL]].tolist()
+            keys_b = _PREC_BY_KIND[kR[:n_r][pR]].tolist()
             from ..core.compose import cursor_walk_conflicts
-            conflicts, da, db = cursor_walk_conflicts(sorted_a, sorted_b)
+            conflicts, da, db = cursor_walk_conflicts(
+                sorted_a, sorted_b, keys_a=keys_a, keys_b=keys_b)
         if conflicts:
             composed = _compose_with_drops(
                 sides, idxs, addr_s, file_s, name_s, ops_l, ops_r,

@@ -9,6 +9,13 @@ worker (`workers/ts/src/{sast,diff,lift}.ts` + `semmerge/compose.py`),
 which cannot run here (no Node in the image). ``vs_baseline`` is the
 TPU-path speedup over that host path on the identical workload.
 
+Since round 5 the timed unit runs merge → fully-materialized composed
+op sequence (what the CLI's applier iterates) → notes op-log JSON
+payloads (the CLI's persisted deliverable) on BOTH paths, so the
+number cannot be gamed by returning lazy objects: the device path must
+realize every composed op and serialize its columnar views to the same
+bytes the host path produces from its Op lists.
+
 Usage: ``python bench.py [--files N] [--decls N] [--json-only]``
 """
 from __future__ import annotations
@@ -100,11 +107,43 @@ def run_merge(backend, base, left, right, phases=None):
                timestamp="2026-01-01T00:00:00Z", phases=phases)
 
 
+def serialize_payload(result) -> int:
+    """Produce the notes op-log JSON payloads — the CLI's deliverable
+    for a merge (cli.py cmd_semmerge → notes_put). Timed as part of
+    every merge since round 5: the device path serializes columnar
+    (ops/oplog_view.py, no Op objects), the host path from its Op
+    lists — both are measured producing identical bytes, so
+    ``vs_baseline`` compares output-to-output, not object-to-object."""
+    from semantic_merge_tpu.core.ops import OpLog
+    return (len(OpLog(result.op_log_left).to_json())
+            + len(OpLog(result.op_log_right).to_json()))
+
+
+def run_merge_to_payload(backend, base, left, right, phases=None):
+    result, composed, conflicts = run_merge(backend, base, left, right,
+                                            phases=phases)
+    t0 = time.perf_counter()
+    # Consume the composed stream the way the CLI's applier does
+    # (apply_ops iterates every op): on the device path this
+    # materializes the lazy ComposedOpView, so BOTH paths pay for a
+    # fully-realized composed op sequence inside the timed window.
+    composed = list(composed)
+    if phases is not None:
+        phases["compose_materialize"] = (phases.get("compose_materialize", 0.0)
+                                         + time.perf_counter() - t0)
+        t0 = time.perf_counter()
+    n_bytes = serialize_payload(result)
+    if phases is not None:
+        phases["serialize"] = (phases.get("serialize", 0.0)
+                               + time.perf_counter() - t0)
+    return result, composed, conflicts, n_bytes
+
+
 def time_merge(backend, base, left, right, *, repeats: int = 3) -> float:
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        run_merge(backend, base, left, right)
+        run_merge_to_payload(backend, base, left, right)
         best = min(best, time.perf_counter() - t0)
     return best
 
@@ -129,12 +168,66 @@ def probe_roundtrip_ms(repeats: int = 5) -> float:
     return sorted(times)[len(times) // 2] * 1e3
 
 
+def synth_repo_sparse(n_files: int, decls_per_file: int, n_changed: int):
+    """A large tree where only ``n_changed`` files differ — the
+    reference's own budget scenario (its perf budgets assume ≤200
+    changed files of a 1M-LOC monorepo, reference
+    ``architecture.md:311-313``). Changed files alternate between a
+    left-side rename and a right-side cross-file move."""
+    total = n_files * decls_per_file
+    n_digits = 1
+    while len(_SIG_TYPES) ** n_digits < total:
+        n_digits += 1
+    step = max(1, n_files // n_changed)
+    base, left, right = [], [], []
+    for i in range(n_files):
+        path = f"src/mod{i:05d}.ts"
+        decls = []
+        for d in range(decls_per_file):
+            params = _unique_params(i * decls_per_file + d, n_digits)
+            decls.append(f"export function fn{i}_{d}({params}): number {{ return {d}; }}")
+        content = "\n".join(decls) + "\n"
+        base.append({"path": path, "content": content})
+        k = i // step
+        is_changed = (i % step == 0) and k < n_changed
+        if is_changed and k % 2 == 0:
+            left.append({"path": path,
+                         "content": content.replace(f"function fn{i}_0(",
+                                                    f"function renamed{i}_0(")})
+        else:
+            left.append({"path": path, "content": content})
+        if is_changed and k % 2 == 1:
+            right.append({"path": f"lib/mod{i:05d}.ts", "content": content})
+        else:
+            right.append({"path": path, "content": content})
+    return Snapshot(files=base), Snapshot(files=left), Snapshot(files=right)
+
+
+def changed_paths(base, left, right) -> set:
+    """The merge scope, computed the way the CLI's ``git diff
+    --name-only`` union sees it: every path whose content differs (or
+    exists on only one side) between base and either side."""
+    base_m = {f["path"]: f["content"] for f in base.files}
+    scope: set = set()
+    for side in (left, right):
+        side_m = {f["path"]: f["content"] for f in side.files}
+        for p, c in side_m.items():
+            if base_m.get(p) != c:
+                scope.add(p)
+        for p in base_m:
+            if p not in side_m:
+                scope.add(p)
+    return scope
+
+
 # BASELINE.json measurement ladder (rung 1 is the e2e pytest scenario).
+# rung5i is the incremental scenario: repo-scale tree, change-scale work.
 PRESETS = {
     "rung2": {"files": 100, "decls": 6},
     "rung3": {"files": 1000, "decls": 6},
     "rung4": {"files": 5000, "decls": 4},
     "rung5": {"files": 10000, "decls": 4, "conflicts": True},
+    "rung5i": {"files": 10000, "decls": 4, "changed": 200},
 }
 
 
@@ -159,6 +252,85 @@ def _emit_and_exit_on_watchdog(record: dict, seconds: float):
     return t
 
 
+def run_incremental_bench(record: dict, args, n_changed: int,
+                          json_only: bool = False) -> int:
+    """The rung5i scenario: a 10k-file tree where only ``n_changed``
+    files differ. Times three protocols, each on a FRESH backend per
+    repeat (cold interner/decl/snapshot caches, warm jit — the shape a
+    new merge arriving at a long-lived worker sees):
+
+    - device path, scope-restricted snapshots (what the CLI does with
+      ``[engine] incremental = true``, the default);
+    - device path, full-tree snapshots (the round-4 behavior);
+    - host oracle, full-tree snapshots (the baseline denominator).
+
+    Parity gate: the restricted device merge must produce op logs and
+    composed ops byte-identical to the full-scan host oracle."""
+    import gc
+
+    from semantic_merge_tpu.backends.base import get_backend
+
+    base, left, right = synth_repo_sparse(args.files, args.decls, n_changed)
+    scope = changed_paths(base, left, right)
+    base_r, left_r, right_r = (base.restrict(scope), left.restrict(scope),
+                               right.restrict(scope))
+
+    # Parity gate (also warms every jit variant the timed runs need).
+    res_t, comp_t, conf_t = run_merge(get_backend("tpu"), base_r, left_r, right_r)
+    res_h, comp_h, conf_h = run_merge(get_backend("host"), base, left, right)
+    parity = (
+        [o.to_dict() for o in res_t.op_log_left] == [o.to_dict() for o in res_h.op_log_left]
+        and [o.to_dict() for o in res_t.op_log_right] == [o.to_dict() for o in res_h.op_log_right]
+        and [o.to_dict() for o in comp_t] == [o.to_dict() for o in comp_h]
+        and [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_h]
+    )
+    run_merge(get_backend("tpu"), base, left, right)  # warm full-scan shapes
+
+    def time_cold(name, b, l, r, repeats=3):
+        best = float("inf")
+        for _ in range(repeats):
+            bk = get_backend(name)
+            gc.collect()
+            t0 = time.perf_counter()
+            run_merge_to_payload(bk, b, l, r)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_inc = time_cold("tpu", base_r, left_r, right_r)
+    t_full_dev = time_cold("tpu", base, left, right)
+    t_full_host = time_cold("host", base, left, right)
+
+    phases: dict = {}
+    run_merge_to_payload(get_backend("tpu"), base_r, left_r, right_r,
+                         phases=phases)
+
+    import jax
+    platform = jax.devices()[0].platform
+    files_per_sec = args.files / t_inc
+    record["metric"] = (
+        f"files merged/sec/chip (synthetic 3-way TS merge, {args.files} "
+        f"files x {args.decls} decls, {n_changed} changed, incremental "
+        f"scope, parity={'ok' if parity else 'FAIL'}, platform={platform})")
+    record["value"] = round(files_per_sec, 2)
+    record["vs_baseline"] = round(t_full_host / t_inc, 3)
+    record["vs_full_scan_device"] = round(t_full_dev / t_inc, 3)
+    record["incremental_ms"] = round(t_inc * 1e3, 1)
+    record["full_scan_device_ms"] = round(t_full_dev * 1e3, 1)
+    record["full_scan_host_ms"] = round(t_full_host * 1e3, 1)
+    record["phases_ms"] = {k: round(v * 1e3, 1) for k, v in phases.items()}
+    if not json_only:
+        print(f"# incremental ({len(scope)} files in scope): "
+              f"{t_inc*1e3:8.1f} ms", file=sys.stderr)
+        print(f"# full-scan device: {t_full_dev*1e3:8.1f} ms "
+              f"({t_full_dev/t_inc:.1f}x slower)", file=sys.stderr)
+        print(f"# full-scan host:   {t_full_host*1e3:8.1f} ms", file=sys.stderr)
+        print("# phases: " + "  ".join(f"{k}={v*1e3:.1f}ms"
+                                       for k, v in phases.items()),
+              file=sys.stderr)
+    print(json.dumps(record), flush=True)
+    return 0 if parity else 1
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--files", type=int, default=None,
@@ -174,6 +346,7 @@ def main() -> int:
                         help="seconds before the bench force-emits and exits")
     args = parser.parse_args()
     conflicts_expected = False
+    n_changed = None
     if args.preset is None and args.files is None:
         # The headline number is measured where BASELINE.json defines
         # it: the 10k-file DivergentRename monorepo merge (rung 5).
@@ -182,6 +355,7 @@ def main() -> int:
         p = PRESETS[args.preset]
         args.files, args.decls = p["files"], p["decls"]
         conflicts_expected = p.get("conflicts", False)
+        n_changed = p.get("changed")
     elif args.files is None:
         args.files = 512
 
@@ -212,8 +386,9 @@ def main() -> int:
 
     from semantic_merge_tpu.backends.base import get_backend
 
-    base, left, right = synth_repo(args.files, args.decls,
-                                   divergent=conflicts_expected)
+    if n_changed is None:
+        base, left, right = synth_repo(args.files, args.decls,
+                                       divergent=conflicts_expected)
 
     # Same GC posture as the CLI entry point (utils/gctune): default
     # thresholds cost ~40% of warm merge wall at the 5k rung. Applied
@@ -228,6 +403,10 @@ def main() -> int:
         record["error"] = f"tpu backend init failed in-process: {exc}"
         tpu = get_backend("tpu")
     host = get_backend("host")
+
+    if n_changed is not None:
+        return run_incremental_bench(record, args, n_changed,
+                                     json_only=args.json_only)
 
     # Parity gate: the bench number is meaningless if the device path
     # diverges from the oracle. Also warms compiles and the fused
@@ -245,9 +424,9 @@ def main() -> int:
     # path. The fused device path reports scan_encode/h2d/kernel/fetch/
     # materialize/compose_decode; the host path build_and_diff/compose.
     tpu_phases: dict = {}
-    run_merge(tpu, base, left, right, phases=tpu_phases)
+    run_merge_to_payload(tpu, base, left, right, phases=tpu_phases)
     host_phases: dict = {}
-    run_merge(host, base, left, right, phases=host_phases)
+    run_merge_to_payload(host, base, left, right, phases=host_phases)
 
     tpu_s = time_merge(tpu, base, left, right)
     host_s = time_merge(host, base, left, right)

@@ -1021,7 +1021,7 @@ static void parallel_for(int n, const std::function<void(int)>& fn) {
 
 extern "C" {
 
-int smn_abi_version() { return 3; }
+int smn_abi_version() { return 4; }
 
 // Scan a snapshot: two passes exactly like scan_snapshot() — collect
 // declared type names across all files, then scan each file in snapshot
@@ -1135,6 +1135,158 @@ char* smn_scan_with_names(const char** paths, const char** contents, int n_files
     }
   }
   out += "]}";
+  char* buf = static_cast<char*>(malloc(out.size() + 1));
+  memcpy(buf, out.data(), out.size() + 1);
+  return buf;
+}
+
+// Columnar op-log serializer — the native twin of
+// semantic_merge_tpu/ops/oplog_view.py OpStreamView.to_json(). The
+// fused device path fetches op streams as int32 columns; this renders
+// the canonical op-log JSON (the reference parity surface,
+// semmerge/ops.py:106-121 shape) straight from those columns plus two
+// node string tables, byte-identical to the Python serializer
+// (fuzz-tested in tests/test_oplog_view.py).
+//
+//   kind   : n int32 diff kinds (0 rename, 1 move, 2 add, 3 delete)
+//   a_slot : n int32 indices into the base node table (rename/move/delete)
+//   b_slot : n int32 indices into the side node table (rename/move/add)
+//   words  : n*4 uint32 op-id digest words; uuid hex = the words
+//            rendered big-endian in order, dashes at 8/12/16/20
+//   *_blob/*_offs: node tables — per node, 4 UTF-8 fields (symbolId,
+//            addressId, name, file) as [offs[4i+k], offs[4i+k+1])
+//            byte ranges of blob; offsets int64, 4*m+1 entries
+//   prov   : the pre-rendered provenance JSON object (shared per stream)
+
+static const char HEXD[] = "0123456789abcdef";
+
+static inline void append_uuid(const uint32_t* w, std::string* out) {
+  char buf[36];
+  char hex[32];
+  for (int k = 0; k < 4; k++) {
+    uint32_t v = w[k];
+    for (int j = 7; j >= 0; j--) { hex[k * 8 + j] = HEXD[v & 0xF]; v >>= 4; }
+  }
+  int p = 0;
+  for (int i = 0; i < 32; i++) {
+    if (i == 8 || i == 12 || i == 16 || i == 20) buf[p++] = '-';
+    buf[p++] = hex[i];
+  }
+  out->append(buf, 36);
+}
+
+struct NodeTab {
+  const char* blob;
+  const int64_t* offs;
+};
+
+static inline void append_field(const NodeTab& t, int64_t node, int field,
+                                std::string* out) {
+  int64_t a = t.offs[node * 4 + field], b = t.offs[node * 4 + field + 1];
+  const char* s = t.blob + a;
+  int64_t len = b - a;
+  // Fast path: no byte needs escaping (the overwhelming case for
+  // identifiers/paths); single scan, bulk append.
+  bool clean = true;
+  for (int64_t i = 0; i < len; i++) {
+    unsigned char c = (unsigned char)s[i];
+    if (c < 0x20 || c == '"' || c == '\\') { clean = false; break; }
+  }
+  if (clean) { out->append(s, (size_t)len); return; }
+  std::string tmp(s, (size_t)len);
+  json_escape(tmp, out);
+}
+
+char* smn_oplog_json(int n,
+                     const int32_t* kind, const int32_t* a_slot,
+                     const int32_t* b_slot, const uint32_t* words,
+                     const char* base_blob, const int64_t* base_offs,
+                     const char* side_blob, const int64_t* side_offs,
+                     const char* prov_json, int64_t* out_len) {
+  NodeTab bt{base_blob, base_offs};
+  NodeTab st{side_blob, side_offs};
+  std::string prov(prov_json);
+  std::string out;
+  out.reserve((size_t)n * 420 + 2);
+  out += "[";
+  for (int i = 0; i < n; i++) {
+    if (i) out += ",";
+    out += "{\"id\":\"";
+    append_uuid(words + (size_t)i * 4, &out);
+    out += "\",\"schemaVersion\":1,\"type\":\"";
+    int k = kind[i];
+    int64_t a = a_slot[i], b = b_slot[i];
+    switch (k) {
+      case 0: {  // renameSymbol
+        out += "renameSymbol\",\"target\":{\"symbolId\":\"";
+        append_field(bt, a, 0, &out);
+        out += "\",\"addressId\":\"";
+        append_field(bt, a, 1, &out);
+        out += "\"},\"params\":{\"oldName\":\"";
+        append_field(bt, a, 2, &out);
+        out += "\",\"newName\":\"";
+        append_field(st, b, 2, &out);
+        out += "\",\"file\":\"";
+        append_field(st, b, 3, &out);
+        out += "\"},\"guards\":{\"exists\":true,\"addressMatch\":\"";
+        append_field(bt, a, 1, &out);
+        out += "\"},\"effects\":{\"summary\":\"rename ";
+        append_field(bt, a, 2, &out);
+        out += "\xe2\x86\x92";  // U+2192 →
+        append_field(st, b, 2, &out);
+        out += "\"},\"provenance\":";
+        break;
+      }
+      case 1: {  // moveDecl
+        out += "moveDecl\",\"target\":{\"symbolId\":\"";
+        append_field(bt, a, 0, &out);
+        out += "\",\"addressId\":\"";
+        append_field(bt, a, 1, &out);
+        out += "\"},\"params\":{\"oldAddress\":\"";
+        append_field(bt, a, 1, &out);
+        out += "\",\"newAddress\":\"";
+        append_field(st, b, 1, &out);
+        out += "\",\"oldFile\":\"";
+        append_field(bt, a, 3, &out);
+        out += "\",\"newFile\":\"";
+        append_field(st, b, 3, &out);
+        out += "\"},\"guards\":{\"exists\":true,\"addressMatch\":\"";
+        append_field(bt, a, 1, &out);
+        out += "\"},\"effects\":{\"summary\":\"move ";
+        append_field(bt, a, 1, &out);
+        out += "\xe2\x86\x92";
+        append_field(st, b, 1, &out);
+        out += "\"},\"provenance\":";
+        break;
+      }
+      case 2: {  // addDecl
+        out += "addDecl\",\"target\":{\"symbolId\":\"";
+        append_field(st, b, 0, &out);
+        out += "\",\"addressId\":\"";
+        append_field(st, b, 1, &out);
+        out += "\"},\"params\":{\"file\":\"";
+        append_field(st, b, 3, &out);
+        out += "\"},\"guards\":{},\"effects\":{\"summary\":\"add decl\"},"
+               "\"provenance\":";
+        break;
+      }
+      default: {  // deleteDecl
+        out += "deleteDecl\",\"target\":{\"symbolId\":\"";
+        append_field(bt, a, 0, &out);
+        out += "\",\"addressId\":\"";
+        append_field(bt, a, 1, &out);
+        out += "\"},\"params\":{\"file\":\"";
+        append_field(bt, a, 3, &out);
+        out += "\"},\"guards\":{},\"effects\":{\"summary\":\"delete decl\"},"
+               "\"provenance\":";
+        break;
+      }
+    }
+    out += prov;
+    out += "}";
+  }
+  out += "]";
+  *out_len = (int64_t)out.size();
   char* buf = static_cast<char*>(malloc(out.size() + 1));
   memcpy(buf, out.data(), out.size() + 1);
   return buf;

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-5 TPU window watcher: probe the relay every 5 min; the moment a
+# real accelerator initialises, run the bench ladder (rung5/4/3) and save
+# BENCH_tpu_r5_<rung>.json + append raw output to BENCHLOG_tpu_r5.txt.
+# Exits after a successful ladder capture.
+cd /root/repo || exit 1
+OUT=/root/repo/BENCHLOG_tpu_r5.txt
+while true; do
+  # Relay-wedge avoidance (see .claude/skills/verify): killing a jax
+  # process mid-init under CPU contention can wedge the relay for
+  # hours. Skip the probe entirely while tests/benches are running.
+  if pgrep -f "pytest|bench\.py" >/dev/null 2>&1; then
+    echo "[$(date -u +%H:%M:%S)] busy (pytest/bench running); skipping probe" >> "$OUT"
+    sleep 300
+    continue
+  fi
+  echo "[$(date -u +%H:%M:%S)] probing relay..." >> "$OUT"
+  if timeout 600 python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform != 'cpu', d
+print('PLATFORM', d[0].platform)
+" >> "$OUT" 2>&1; then
+    echo "[$(date -u +%H:%M:%S)] accelerator up — running ladder" >> "$OUT"
+    ok=1
+    for rung in rung5 rung4 rung3; do
+      echo "=== $rung $(date -u +%H:%M:%S) ===" >> "$OUT"
+      if timeout 1200 python bench.py --preset "$rung" >> "$OUT" 2>&1; then
+        # copy the last JSON line to a per-rung artifact
+        grep -h '^{' "$OUT" | tail -1 > "BENCH_tpu_r5_${rung}.json"
+        # a cpu-fallback run does not count as a capture
+        if grep -q '"platform=cpu"\|platform=cpu' "BENCH_tpu_r5_${rung}.json"; then
+          ok=0
+        fi
+      else
+        ok=0
+      fi
+    done
+    if [ "$ok" = "1" ]; then
+      echo "[$(date -u +%H:%M:%S)] ladder captured — watcher done" >> "$OUT"
+      exit 0
+    fi
+    echo "[$(date -u +%H:%M:%S)] ladder incomplete; will retry" >> "$OUT"
+  fi
+  sleep 300
+done

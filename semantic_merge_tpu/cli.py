@@ -107,9 +107,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Sequence[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    if args.command != "train-matcher":
-        # One-shot merge-shaped commands only: a training loop must
-        # keep normal collection cadence (see utils/gctune docstring).
+    if args.command in ("semdiff", "semmerge", "semrebase"):
+        # Explicit allowlist of one-shot merge-shaped commands: anything
+        # long-running or embedded (train-matcher today, future servers)
+        # must keep normal collection cadence (see utils/gctune).
         from .utils.gctune import tune_for_merge
         tune_for_merge()
     try:
@@ -183,8 +184,14 @@ def cmd_semdiff(args: argparse.Namespace) -> int:
     change_sig = args.change_signature or config.engine.change_signature
     try:
         with tracer.phase("snapshot"):
-            base_snap = snapshot_rev(args.rev1)
-            right_snap = snapshot_rev(args.rev2)
+            from .runtime.git import (archive_bytes, diff_scope,
+                                      snapshot_from_bytes)
+            scope = (diff_scope(args.rev1, args.rev2)
+                     if config.engine.incremental else None)
+            base_snap = snapshot_from_bytes(archive_bytes(args.rev1),
+                                            paths=scope)
+            right_snap = snapshot_from_bytes(archive_bytes(args.rev2),
+                                             paths=scope)
         with tracer.phase("diff"):
             ops = backend.diff(base_snap, right_snap,
                                base_rev=resolve_rev(args.rev1),
@@ -211,13 +218,21 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
     merged_tree: pathlib.Path | None = None
     try:
         with tracer.phase("snapshot"):
-            from .runtime.git import archive_bytes, snapshot_from_bytes
+            from .runtime.git import (archive_bytes, merge_scope,
+                                      snapshot_from_bytes)
             base_tar = archive_bytes(args.base)
             left_tar = archive_bytes(args.a)
             right_tar = archive_bytes(args.b)
-            base_snap = snapshot_from_bytes(base_tar)
-            left_snap = snapshot_from_bytes(left_tar)
-            right_snap = snapshot_from_bytes(right_tar)
+            # Incremental scope: scan/diff only files either side
+            # touched; the full tars still feed apply + text fallback,
+            # so non-indexed and unchanged files keep exact semantics.
+            scope = (merge_scope(args.base, args.a, args.b)
+                     if config.engine.incremental else None)
+            base_snap = snapshot_from_bytes(base_tar, paths=scope)
+            left_snap = snapshot_from_bytes(left_tar, paths=scope)
+            right_snap = snapshot_from_bytes(right_tar, paths=scope)
+            if scope is not None:
+                tracer.count("scope_files", len(scope))
         base_rev = resolve_rev(args.base)
         seed = args.seed or config.core.deterministic_seed
         if seed == "auto":

@@ -60,6 +60,10 @@ class EngineConfig:
     change_signature: bool = False
     conflict_mode: str = "parity"
     text_fallback: bool = True
+    # Scope scanning/diffing to files either side changed vs base
+    # (reference architecture.md:202-204; see runtime.git.merge_scope
+    # for the collision caveat that motivates the off switch).
+    incremental: bool = True
     structured_apply: bool = False
     max_nodes_per_bucket: int = 2048
     mesh_shape: str = "auto"

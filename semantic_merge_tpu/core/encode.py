@@ -56,9 +56,11 @@ class Interner:
         of a Python loop — and long-lived interners (the device backend
         keeps one across merges) don't rebuild the mirror per merge.
 
-        The result is a live VIEW of the cached buffer: the next
-        ``intern()`` may overwrite its trailing ``None`` slot. Gather
-        from it immediately; never hold it across interning."""
+        The result is a read-only VIEW of the cached buffer: the next
+        ``intern()`` may overwrite its trailing ``None`` slot (and
+        later slots). Gather from it immediately; never hold it across
+        interning. Writes through the view raise — callers that need a
+        mutable decode must copy."""
         n = len(self.strings)
         if self._obj is None or n + 1 > len(self._obj):
             grown = np.empty((max(64, 2 * (n + 1)),), dtype=object)
@@ -68,8 +70,9 @@ class Interner:
         elif n > self._obj_n:
             self._obj[self._obj_n:n] = self.strings[self._obj_n:n]
             self._obj_n = n
+        self._obj[n] = None  # reset: growth may have written a string here
         view = self._obj[:n + 1]
-        view[n] = None  # reset: growth may have written a string here
+        view.flags.writeable = False
         return view
 
     def intern(self, s: str | None) -> int:

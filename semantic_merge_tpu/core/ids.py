@@ -43,15 +43,26 @@ _TYPE_CODE = {t: i for i, t in enumerate(OP_TYPES)}
 # Load-bearing: the device hashes clip(kind, 0, 3) straight into the id
 # payload (ops/fused._op_id_words), so the KIND_* codes MUST stay equal
 # to these type codes — reordering OP_TYPES would silently fork ids.
-assert [_TYPE_CODE[t] for t in
-        ("renameSymbol", "moveDecl", "addDecl", "deleteDecl")] == [0, 1, 2, 3]
+# Checked unconditionally (not `assert`): `python -O` must not strip it.
+if [_TYPE_CODE[t] for t in
+        ("renameSymbol", "moveDecl", "addDecl", "deleteDecl")] != [0, 1, 2, 3]:
+    raise AssertionError(
+        "OP_TYPES order changed: device KIND_* codes no longer match the "
+        "first four op-type codes; op ids would silently fork")
 
 
 @functools.lru_cache(maxsize=4096)
 def op_id_prefix_digest(seed: str, rev: str) -> bytes:
     """16-byte digest of the (seed, rev) pair — the per-merge-side
-    constant prefix of every op-id payload."""
-    return hashlib.sha256(f"{seed}|{rev}".encode("utf-8")).digest()[:16]
+    constant prefix of every op-id payload.
+
+    Length-prefixing the seed makes the encoding injective: the v1
+    ``f"{seed}|{rev}"`` form collided ("a|b","c") with ("a","b|c").
+    This is id scheme v2 (changes every op id vs v1; nothing pins v1
+    hex values — parity is host↔device, and both call this)."""
+    seed_b = seed.encode("utf-8")
+    payload = len(seed_b).to_bytes(4, "big") + seed_b + rev.encode("utf-8")
+    return hashlib.sha256(payload).digest()[:16]
 
 
 @functools.lru_cache(maxsize=262144)

@@ -213,6 +213,12 @@ class OpLog:
     ops: List[Op] = field(default_factory=list)
 
     def to_json(self) -> str:
+        # Columnar op-log views (ops/oplog_view.py) serialize straight
+        # from their device columns — byte-identical output, no Op
+        # materialization (the notes payload is the hot consumer).
+        fast = getattr(self.ops, "to_json", None)
+        if fast is not None:
+            return fast()
         return dumps_canonical([o.to_dict() for o in self.ops])
 
     @staticmethod

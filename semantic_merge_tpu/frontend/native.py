@@ -35,7 +35,7 @@ from .scanner import DeclNode
 
 _NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
 _LIB_PATH = _NATIVE_DIR / "libsemmerge_native.so"
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
@@ -102,6 +102,14 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.smn_scan_with_names.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
         ctypes.c_int,
+    ]
+    lib.smn_oplog_json.restype = ctypes.c_void_p
+    lib.smn_oplog_json.argtypes = [
+        ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
     ]
     lib.smn_free.argtypes = [ctypes.c_void_p]
     _lib = lib
@@ -206,3 +214,31 @@ def try_scan_snapshot(files: Sequence[dict]) -> Optional[List[DeclNode]]:
         )
         for r in records
     ]
+
+
+def try_oplog_json(n: int, kind, a_slot, b_slot, words,
+                   base_blob: bytes, base_offs, side_blob: bytes, side_offs,
+                   prov_json: str) -> Optional[str]:
+    """Render an op stream's canonical JSON from its device columns via
+    the native serializer (``smn_oplog_json``); ``None`` → caller uses
+    the Python columnar serializer. Arrays must be C-contiguous int32
+    (columns) / int64 (table offsets)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_len = ctypes.c_int64(0)
+    ptr = lib.smn_oplog_json(
+        n,
+        kind.ctypes.data_as(ctypes.c_void_p),
+        a_slot.ctypes.data_as(ctypes.c_void_p),
+        b_slot.ctypes.data_as(ctypes.c_void_p),
+        words.ctypes.data_as(ctypes.c_void_p),
+        base_blob, base_offs.ctypes.data_as(ctypes.c_void_p),
+        side_blob, side_offs.ctypes.data_as(ctypes.c_void_p),
+        prov_json.encode("utf-8"), ctypes.byref(out_len))
+    if not ptr:
+        return None
+    try:
+        return ctypes.string_at(ptr, out_len.value).decode("utf-8")
+    finally:
+        lib.smn_free(ptr)

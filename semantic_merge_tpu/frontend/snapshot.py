@@ -28,6 +28,17 @@ class Snapshot:
     def to_dict(self) -> dict:
         return {"files": self.files, "project": self.project}
 
+    def restrict(self, paths) -> "Snapshot":
+        """The sub-snapshot of files whose path is in ``paths`` —
+        the incremental-merge scope (reference ``architecture.md:202-204``
+        prunes to changed files the same way). File order is preserved,
+        so per-file scan keys, decl emission order, and therefore op
+        ids are identical to the full snapshot's for every op the
+        restricted merge can produce."""
+        keep = set(paths)
+        return Snapshot(files=[f for f in self.files if f["path"] in keep],
+                        project=self.project)
+
 
 def filter_files(snap: Snapshot, extensions) -> List[Dict[str, str]]:
     """The subset of a snapshot's files a backend can index.

@@ -44,6 +44,7 @@ from ..core.encode import (NULL_ID, PAD_ID, Interner, OpTensor,
                            build_rank_tables, encode_oplog, pad_to,
                            shard_bucket)
 from ..core.ops import Op, Target
+from .oplog_view import _materialize_decoded
 
 _PAD_PREC = np.int32(2**30)  # sorts after every real precedence
 
@@ -370,37 +371,3 @@ def decode_compose_output(out: np.ndarray, delta_a: List[Op], delta_b: List[Op],
         conflicts.append(divergent_rename_conflict(
             sorted_a[int(conf_a[k])], sorted_b[int(conf_b[k])]))
     return composed, conflicts
-
-
-def _materialize_decoded(op: Op, new_addr: str | None, new_file: str | None,
-                         rename_ctx: str | None) -> Op:
-    if new_addr is None and new_file is None and (
-            rename_ctx is None or op.type == "renameSymbol"):
-        # No chain rewrite applies: reuse the input op. A renameSymbol
-        # never receives renameContext, so its own chain_name value is
-        # not a rewrite — the host composer skips the clone here too
-        # (core.compose._materialize's early return).
-        return op
-    # Rewrite copy, specialized for this decode path: only params and
-    # target are ever rewritten, so they are copied; guards/effects/
-    # provenance are shared with the (immutable, JSON-scalar-valued)
-    # stream op. JSON-observable output is identical to a deep clone —
-    # this replaced ~46k deep clones per 10k-file merge.
-    cloned = Op(id=op.id, schemaVersion=op.schemaVersion, type=op.type,
-                target=op.target, params=dict(op.params),
-                guards=op.guards, effects=op.effects,
-                provenance=op.provenance)
-    if new_addr is not None or new_file is not None:
-        if cloned.type == "moveDecl":
-            if new_addr is not None:
-                cloned.params["newAddress"] = new_addr
-            if new_file is not None:
-                cloned.params["newFile"] = new_file
-        if new_addr is not None:
-            cloned.target = Target(symbolId=cloned.target.symbolId, addressId=new_addr)
-        if cloned.type == "renameSymbol" and new_file is not None:
-            cloned.params["newFile"] = new_file
-            cloned.params["file"] = new_file
-    if rename_ctx is not None and cloned.type != "renameSymbol":
-        cloned.params["renameContext"] = rename_ctx
-    return cloned

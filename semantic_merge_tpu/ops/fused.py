@@ -58,13 +58,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.conflict import Conflict
+from ..core.conflict import Conflict, divergent_rename_conflict
 from ..core.encode import NULL_ID, PAD_ID, DeclTensor, Interner, bucket_size, pad_to
-from ..core.ops import Op, Target
-from .compose import (_PAD_PREC, _local_seg_scan, _materialize_decoded,
+from ..core.ops import Op
+from .compose import (_PAD_PREC, _local_seg_scan,
                       _rename_candidate_query, _rename_candidate_tables,
                       _rename_pairs, _sort_perm, _sort_stream)
 from .diff import KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME, _diff_plan
+from .oplog_view import (ComposedOpView, OpStreamView,
+                         cursor_walk_conflicts_columnar)
 from .sha256 import sha256_device
 
 #: OP_PRECEDENCE of each KIND_* code (core/ops.py).
@@ -460,74 +462,12 @@ def _sharded_fn(mesh, nb: int, nl: int, nr: int,
 
 
 # --------------------------------------------------------------------------
-# Host side: decode, materialize, conflict patch
+# Host side: decode, lazy views, conflict patch
 # --------------------------------------------------------------------------
-
-def _format_ids(words: np.ndarray) -> List[str]:
-    """int32-bitcast digest words [n, 4] → uuid-shaped id strings, one
-    bulk hex conversion for the whole batch."""
-    hx = np.ascontiguousarray(words).view(np.uint32).astype(">u4").tobytes().hex()
-    return [f"{s[:8]}-{s[8:12]}-{s[12:16]}-{s[16:20]}-{s[20:]}"
-            for s in (hx[32 * i:32 * i + 32] for i in range(len(words)))]
-
-
-def _materialize_stream(kind: np.ndarray, a_slot: np.ndarray,
-                        b_slot: np.ndarray, words: np.ndarray,
-                        base_nodes, side_nodes, prov: Dict) -> List[Op]:
-    """Compact device rows → the same ``Op`` records ``core.difflift.lift``
-    builds, ids taken from the device digests (parity property-tested
-    against the host lift). ``prov`` is shared across the stream's ops —
-    ops are immutable downstream and ``Op.clone`` copies it.
-
-    One tight loop per op kind (indices pre-split with numpy) instead
-    of per-row branching — this materializes tens of thousands of ops
-    per 10k-file merge, straight after the single device fetch."""
-    ids = _format_ids(words)
-    n = len(ids)
-    ops: List[Op] = [None] * n  # type: ignore[list-item]
-    kinds = kind
-    for k in (KIND_RENAME, KIND_MOVE, KIND_ADD, KIND_DELETE):
-        idxs = np.nonzero(kinds == k)[0]
-        if not len(idxs):
-            continue
-        ai = a_slot[idxs].tolist()
-        bi = b_slot[idxs].tolist()
-        where = idxs.tolist()
-        if k == KIND_RENAME:
-            for i, x, y in zip(where, ai, bi):
-                a, b = base_nodes[x], side_nodes[y]
-                ops[i] = Op(ids[i], 1, "renameSymbol",
-                            Target(a.symbolId, a.addressId),
-                            {"oldName": a.name, "newName": b.name,
-                             "file": b.file},
-                            {"exists": True, "addressMatch": a.addressId},
-                            {"summary": f"rename {a.name}→{b.name}"}, prov)
-        elif k == KIND_MOVE:
-            for i, x, y in zip(where, ai, bi):
-                a, b = base_nodes[x], side_nodes[y]
-                ops[i] = Op(ids[i], 1, "moveDecl",
-                            Target(a.symbolId, a.addressId),
-                            {"oldAddress": a.addressId,
-                             "newAddress": b.addressId,
-                             "oldFile": a.file, "newFile": b.file},
-                            {"exists": True, "addressMatch": a.addressId},
-                            {"summary":
-                             f"move {a.addressId}→{b.addressId}"}, prov)
-        elif k == KIND_ADD:
-            for i, y in zip(where, bi):
-                b = side_nodes[y]
-                ops[i] = Op(ids[i], 1, "addDecl",
-                            Target(b.symbolId, b.addressId),
-                            {"file": b.file}, {},
-                            {"summary": "add decl"}, prov)
-        else:  # KIND_DELETE
-            for i, x in zip(where, ai):
-                a = base_nodes[x]
-                ops[i] = Op(ids[i], 1, "deleteDecl",
-                            Target(a.symbolId, a.addressId),
-                            {"file": a.file}, {},
-                            {"summary": "delete decl"}, prov)
-    return ops
+# Op-object materialization lives in ops/oplog_view.py now: the fused
+# path returns columnar OpStreamView / ComposedOpView sequences whose
+# JSON serialization never allocates Op objects (VERDICT r4 #2 — the
+# eager loops here were the largest host phase of the rung-5 merge).
 
 
 class FusedMergeEngine:
@@ -551,6 +491,9 @@ class FusedMergeEngine:
             self._repl_sharding = NamedSharding(mesh, P())
         self.strings = DeviceStrings(interner, sharding=self._repl_sharding)
         self._decl_cache: "OrderedDict" = OrderedDict()
+        # Per-snapshot node string tables for the native op-log
+        # serializer, keyed by the same scan identity as _decl_cache.
+        self._tbl_cache: "OrderedDict" = OrderedDict()
         self._cap_hint = 256
 
     def _bucket(self, n: int) -> int:
@@ -614,9 +557,11 @@ class FusedMergeEngine:
             off += C
         kinds, a_sl, b_sl = cols[0][:n_ops], cols[1][:n_ops], cols[2][:n_ops]
         words = np.stack([c[:n_ops] for c in cols[3:7]], axis=1)
-        return _materialize_stream(kinds, a_sl, b_sl, words,
-                                   base_nodes, side_nodes,
-                                   {"rev": base_rev, "timestamp": timestamp})
+        return OpStreamView(kinds, a_sl, b_sl, words,
+                            base_nodes, side_nodes,
+                            {"rev": base_rev, "timestamp": timestamp},
+                            base_tbl_ref=(self._tbl_cache, base_key),
+                            side_tbl_ref=(self._tbl_cache, side_key))
 
     def merge(self, base_t: DeclTensor, base_key, base_nodes,
               left_t: DeclTensor, left_key, left_nodes,
@@ -713,19 +658,23 @@ class FusedMergeEngine:
         kR, aR, bR = take(C), take(C), take(C)
         wR = np.stack([take(C) for _ in range(4)], axis=1)
 
-        ops_l = _materialize_stream(kL[:n_l], aL[:n_l], bL[:n_l], wL[:n_l],
-                                    base_nodes, left_nodes,
-                                    {"rev": base_rev, "timestamp": timestamp})
-        ops_r = _materialize_stream(kR[:n_r], aR[:n_r], bR[:n_r], wR[:n_r],
-                                    base_nodes, right_nodes,
-                                    {"rev": base_rev, "timestamp": timestamp})
+        prov = {"rev": base_rev, "timestamp": timestamp}
+        base_ref = (self._tbl_cache, base_key)
+        ops_l = OpStreamView(kL[:n_l], aL[:n_l], bL[:n_l], wL[:n_l],
+                             base_nodes, left_nodes, prov,
+                             base_tbl_ref=base_ref,
+                             side_tbl_ref=(self._tbl_cache, left_key))
+        ops_r = OpStreamView(kR[:n_r], aR[:n_r], bR[:n_r], wR[:n_r],
+                             base_nodes, right_nodes, prov,
+                             base_tbl_ref=base_ref,
+                             side_tbl_ref=(self._tbl_cache, right_key))
         if phases is not None:
             phases["materialize"] = (phases.get("materialize", 0.0)
                                      + time.perf_counter() - t0)
             t0 = time.perf_counter()
 
         if split:
-            # The tail's device→host copy overlapped materialization.
+            # The tail's device→host copy overlapped the head decode.
             flat, off = np.asarray(tail_dev), 0
             if phases is not None:
                 phases["fetch"] = (phases.get("fetch", 0.0)
@@ -739,62 +688,84 @@ class FusedMergeEngine:
         # mirror's trailing None); the mirror is cached on the interner.
         table = self.interner.object_table()
         refs = ref[:n_out]
-        sides = (refs >> 30).tolist()
-        idxs = (refs & ((1 << 30) - 1)).tolist()
-        addr_s = table[c_addr[:n_out]].tolist()
-        file_s = table[c_file[:n_out]].tolist()
-        name_s = table[c_name[:n_out]].tolist()
+        sides_np = refs >> 30
+        idxs_np = refs & ((1 << 30) - 1)
+        addr_o = table[c_addr[:n_out]]
+        file_o = table[c_file[:n_out]]
+        name_o = table[c_name[:n_out]]
 
         conflicts: List[Conflict] = []
         if has_cand:
+            # Columnar cursor walk: the reference's head-vs-head
+            # DivergentRename walk reads only (precedence, is-rename,
+            # symbolId, newName), all derivable as int columns — the
+            # interner makes int equality string equality, and every op
+            # of one fused merge shares a single timestamp, so the
+            # (prec, ts) keys collapse to precedence ints. No Op
+            # objects materialize unless a conflict actually fires.
             pL, pR = permL[:n_l], permR[:n_r]
-            sorted_a = [ops_l[i] for i in pL.tolist()]
-            sorted_b = [ops_r[i] for i in pR.tolist()]
-            # All ops of one fused merge share a single timestamp, so
-            # the walk's (prec, ts) keys collapse to precedence ints —
-            # derived vectorized from the fetched kind columns.
-            keys_a = _PREC_BY_KIND[kL[:n_l][pL]].tolist()
-            keys_b = _PREC_BY_KIND[kR[:n_r][pR]].tolist()
-            from ..core.compose import cursor_walk_conflicts
-            conflicts, da, db = cursor_walk_conflicts(
-                sorted_a, sorted_b, keys_a=keys_a, keys_b=keys_b)
-        if conflicts:
-            composed = _compose_with_drops(
-                sides, idxs, addr_s, file_s, name_s, ops_l, ops_r,
-                {permL[i] for i in da}, {permR[j] for j in db})
-        else:
-            composed = [
-                _materialize_decoded((ops_l if side == 0 else ops_r)[i],
-                                     na_, nf_, nn_)
-                for side, i, na_, nf_, nn_ in zip(sides, idxs, addr_s,
-                                                  file_s, name_s)]
+            kLr, kRr = kL[:n_l], kR[:n_r]
+
+            def raw_cols(k_raw, a_raw, b_raw, side_t):
+                a_cl = np.maximum(a_raw, 0)
+                b_cl = np.maximum(b_raw, 0)
+                sym = np.where(k_raw == KIND_ADD,
+                               side_t.sym[b_cl], base_t.sym[a_cl])
+                name = np.where(k_raw == KIND_RENAME,
+                                side_t.name[b_cl], NULL_ID)
+                return sym, name
+
+            symL_raw, nameL_raw = raw_cols(kLr, aL[:n_l], bL[:n_l], left_t)
+            symR_raw, nameR_raw = raw_cols(kRr, aR[:n_r], bR[:n_r], right_t)
+            pairs, da, db = cursor_walk_conflicts_columnar(
+                _PREC_BY_KIND[kLr[pL]].tolist(),
+                (kLr[pL] == KIND_RENAME).tolist(),
+                symL_raw[pL].tolist(), nameL_raw[pL].tolist(),
+                _PREC_BY_KIND[kRr[pR]].tolist(),
+                (kRr[pR] == KIND_RENAME).tolist(),
+                symR_raw[pR].tolist(), nameR_raw[pR].tolist())
+            conflicts = [divergent_rename_conflict(ops_l[int(pL[ia])],
+                                                   ops_r[int(pR[ib])])
+                         for ia, ib in pairs]
+            if pairs:
+                # Patch the speculative composition columnar-ly:
+                # dropped renames leave the stream, and the rename
+                # chains of *affected symbols only* are replayed in
+                # composed order (drops are always renames, so the
+                # addr/file chains from the device scan remain exact).
+                droppedL = np.asarray(sorted(int(pL[i]) for i in da))
+                droppedR = np.asarray(sorted(int(pR[j]) for j in db))
+                drop_mask = (((sides_np == 0)
+                              & np.isin(idxs_np, droppedL))
+                             | ((sides_np == 1)
+                                & np.isin(idxs_np, droppedR)))
+                il = np.minimum(idxs_np, max(n_l - 1, 0))
+                ir = np.minimum(idxs_np, max(n_r - 1, 0))
+                sym_row = np.where(sides_np == 0,
+                                   symL_raw[il], symR_raw[ir])
+                aff = np.asarray(sorted({int(symL_raw[i])
+                                         for i in droppedL.tolist()}
+                                        | {int(symR_raw[j])
+                                           for j in droppedR.tolist()}))
+                aff_mask = np.isin(sym_row, aff) & ~drop_mask
+                kind_row = np.where(sides_np == 0, kLr[il], kRr[ir])
+                newname_row = np.where(sides_np == 0,
+                                       nameL_raw[il], nameR_raw[ir])
+                ctx: Dict[int, object] = {}
+                for i in np.nonzero(aff_mask)[0].tolist():
+                    sym = int(sym_row[i])
+                    if kind_row[i] == KIND_RENAME:
+                        ctx[sym] = table[newname_row[i]]
+                    name_o[i] = ctx.get(sym)
+                keep = np.nonzero(~drop_mask)[0]
+                sides_np, idxs_np = sides_np[keep], idxs_np[keep]
+                addr_o, file_o = addr_o[keep], file_o[keep]
+                name_o = name_o[keep]
+
+        composed = ComposedOpView(sides_np.tolist(), idxs_np.tolist(),
+                                  addr_o.tolist(), file_o.tolist(),
+                                  name_o.tolist(), ops_l, ops_r)
         if phases is not None:
             phases["compose_decode"] = (phases.get("compose_decode", 0.0)
                                         + time.perf_counter() - t0)
         return ops_l, ops_r, composed, conflicts
-
-
-def _compose_with_drops(sides, idxs, addr_s, file_s, name_s, ops_l, ops_r,
-                        dropped_l: set, dropped_r: set) -> List[Op]:
-    """Patch the speculative composition after the host cursor walk
-    found real DivergentRename conflicts: dropped renames leave the
-    stream, and the rename chains of *affected symbols only* are
-    replayed in composed order (drops are always renames, so the
-    addr/file chains from the device scan remain exact)."""
-    aff = {ops_l[i].target.symbolId for i in dropped_l}
-    aff |= {ops_r[j].target.symbolId for j in dropped_r}
-    ctx: Dict[str, str] = {}
-    out: List[Op] = []
-    for side, i, na_, nf_, nn_ in zip(sides, idxs, addr_s, file_s, name_s):
-        dropped = dropped_l if side == 0 else dropped_r
-        op = (ops_l if side == 0 else ops_r)[i]
-        if i in dropped:
-            continue
-        sym = op.target.symbolId
-        if sym in aff:
-            if op.type == "renameSymbol":
-                ctx[sym] = str(op.params.get("newName"))
-            out.append(_materialize_decoded(op, na_, nf_, ctx.get(sym)))
-        else:
-            out.append(_materialize_decoded(op, na_, nf_, nn_))
-    return out

@@ -1,0 +1,522 @@
+"""Columnar op-log views — op logs without Op objects.
+
+Round-4 profiling showed the fused merge's two largest host costs were
+``materialize`` (building ~90k Python :class:`Op`/:class:`Target`
+objects straight off the device fetch) and ``compose_decode`` (cloning
+them again for the composed stream) — 455 ms + 359 ms of a 1,474 ms
+rung-5 merge, more than the device kernel itself. The CLI then
+immediately re-serializes those objects to the notes op-log JSON
+(``cli.py`` → ``runtime/notes.py``), so the object layer existed only
+to be flattened back out.
+
+These views keep the fetched int32/digest columns as the source of
+truth and materialize on three paths, lazily:
+
+- ``to_json()`` — the notes/op-log payload, synthesized directly from
+  the columns: one bulk hex conversion for the ids, f-string rows with
+  cached JSON escaping. Byte-identical to
+  ``OpLog([...]).to_json()`` over the materialized ops
+  (fuzz-tested in ``tests/test_oplog_view.py``); the JSON shape is the
+  reference parity surface (reference ``semmerge/ops.py:106-121``).
+- ``view[i]`` — one op, built on demand and cached: the conflict
+  constructors and spot inspections touch a handful of ops, not 90k.
+- ``iter(view)`` — bulk materialization with the per-kind tight loops
+  (same cost as the old eager path), for consumers that genuinely need
+  every op as an object (the applier's handler dispatch, parity tests).
+
+The DivergentRename cursor walk gets a columnar twin here too: the
+reference's head-vs-head walk (reference ``semmerge/compose.py:51-112``)
+only ever reads ``(precedence, is-rename, symbolId, newName)`` and the
+interner makes string equality equal int equality, so the walk runs on
+int rows and materializes nothing.
+"""
+from __future__ import annotations
+
+import re
+from bisect import bisect_left, bisect_right
+from json.encoder import encode_basestring
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.ops import Op, Target, dumps_canonical
+
+#: Device diff kinds (ops/diff.py) — re-declared to avoid a JAX import
+#: in this pure-host module; pinned by tests against the real values.
+KIND_RENAME, KIND_MOVE, KIND_ADD, KIND_DELETE = 0, 1, 2, 3
+
+_OP_TYPE_BY_KIND = ("renameSymbol", "moveDecl", "addDecl", "deleteDecl")
+
+#: Characters canonical JSON must escape (json.encoder.ESCAPE), given
+#: ensure_ascii=False: quote, backslash, C0 controls.
+_ESC_RE = re.compile(r'["\\\x00-\x1f]')
+
+
+def _esc(s: str) -> str:
+    """The exact string token ``json.dumps(s, ensure_ascii=False)``
+    emits, quotes included — fast path for clean strings."""
+    if _ESC_RE.search(s) is None:
+        return f'"{s}"'
+    return encode_basestring(s)
+
+
+def _esc_body(s: str) -> str:
+    """The escaped *body* of a JSON string token (no quotes). Escaping
+    is per-character, so concatenating bodies with literal ASCII equals
+    the body of the concatenation — summaries assemble from cached
+    bodies without ever running the escape regex on the joined text."""
+    if _ESC_RE.search(s) is None:
+        return s
+    return encode_basestring(s)[1:-1]
+
+
+def format_ids(words: np.ndarray) -> List[str]:
+    """int32-bitcast digest words [n, 4] → uuid-shaped id strings, one
+    bulk hex conversion for the whole batch."""
+    hx = np.ascontiguousarray(words).view(np.uint32).astype(">u4").tobytes().hex()
+    return [f"{s[:8]}-{s[8:12]}-{s[12:16]}-{s[16:20]}-{s[20:]}"
+            for s in (hx[32 * i:32 * i + 32] for i in range(len(words)))]
+
+
+def _node_table(nodes) -> Tuple[bytes, np.ndarray]:
+    """Marshal a node list for the native serializer: one UTF-8 blob of
+    the 4 per-node fields (symbolId, addressId, name, file) plus int64
+    byte offsets (``4*m+1`` entries). NUL-safe: fields are byte ranges,
+    never C strings."""
+    fields = [x for nd in nodes
+              for x in (nd.symbolId, nd.addressId, nd.name or "", nd.file)]
+    joined = "".join(fields)
+    if joined.isascii():
+        lens = np.fromiter(map(len, fields), np.int64, count=len(fields))
+        blob = joined.encode("ascii")
+    else:  # rare: per-field encode so offsets stay byte-accurate
+        enc = [f.encode("utf-8") for f in fields]
+        lens = np.fromiter(map(len, enc), np.int64, count=len(enc))
+        blob = b"".join(enc)
+    offs = np.zeros(len(fields) + 1, np.int64)
+    np.cumsum(lens, out=offs[1:])
+    return blob, offs
+
+
+def _get_table(ref, nodes) -> Tuple[bytes, np.ndarray]:
+    """Node table via the engine's per-snapshot cache when a stable
+    identity exists (``ref = (cache, key)``), else built fresh."""
+    cache = key = None
+    if ref is not None:
+        cache, key = ref
+        if key is not None:
+            hit = cache.get(key)
+            if hit is not None and hit[2] == len(nodes):
+                cache.move_to_end(key)
+                return hit[0], hit[1]
+    tbl = _node_table(nodes)
+    if cache is not None and key is not None:
+        cache[key] = (tbl[0], tbl[1], len(nodes))
+        while len(cache) > 8:
+            cache.popitem(last=False)
+    return tbl
+
+
+class OpStreamView(Sequence):
+    """One side's op log as fetched columns; a lazy ``Sequence[Op]``.
+
+    Rows are ``(kind, a_slot, b_slot, digest_words)`` where the slots
+    index the scanned decl node lists. Construction does no per-row
+    work at all."""
+
+    __slots__ = ("kind", "a_slot", "b_slot", "words",
+                 "base_nodes", "side_nodes", "prov",
+                 "base_tbl_ref", "side_tbl_ref",
+                 "_ids", "_ops", "_all_done")
+
+    def __init__(self, kind: np.ndarray, a_slot: np.ndarray,
+                 b_slot: np.ndarray, words: np.ndarray,
+                 base_nodes, side_nodes, prov: Dict,
+                 base_tbl_ref=None, side_tbl_ref=None) -> None:
+        self.kind = kind
+        self.a_slot = a_slot
+        self.b_slot = b_slot
+        self.words = words
+        self.base_nodes = base_nodes
+        self.side_nodes = side_nodes
+        self.prov = prov
+        # Optional (cache, identity) pairs for the native serializer's
+        # node tables — the fused engine shares them across merges.
+        self.base_tbl_ref = base_tbl_ref
+        self.side_tbl_ref = side_tbl_ref
+        self._ids: Optional[List[str]] = None
+        self._ops: Optional[List[Optional[Op]]] = None
+        self._all_done = False
+
+    # -- sequence protocol -------------------------------------------------
+    def __len__(self) -> int:
+        return int(self.kind.shape[0])
+
+    def ids(self) -> List[str]:
+        if self._ids is None:
+            self._ids = format_ids(self.words)
+        return self._ids
+
+    def _build_one(self, i: int) -> Op:
+        k = int(self.kind[i])
+        op_id = self.ids()[i]
+        prov = self.prov
+        if k == KIND_RENAME:
+            a = self.base_nodes[int(self.a_slot[i])]
+            b = self.side_nodes[int(self.b_slot[i])]
+            return Op(op_id, 1, "renameSymbol",
+                      Target(a.symbolId, a.addressId),
+                      {"oldName": a.name, "newName": b.name, "file": b.file},
+                      {"exists": True, "addressMatch": a.addressId},
+                      {"summary": f"rename {a.name}→{b.name}"}, prov)
+        if k == KIND_MOVE:
+            a = self.base_nodes[int(self.a_slot[i])]
+            b = self.side_nodes[int(self.b_slot[i])]
+            return Op(op_id, 1, "moveDecl",
+                      Target(a.symbolId, a.addressId),
+                      {"oldAddress": a.addressId, "newAddress": b.addressId,
+                       "oldFile": a.file, "newFile": b.file},
+                      {"exists": True, "addressMatch": a.addressId},
+                      {"summary": f"move {a.addressId}→{b.addressId}"}, prov)
+        if k == KIND_ADD:
+            b = self.side_nodes[int(self.b_slot[i])]
+            return Op(op_id, 1, "addDecl", Target(b.symbolId, b.addressId),
+                      {"file": b.file}, {}, {"summary": "add decl"}, prov)
+        a = self.base_nodes[int(self.a_slot[i])]
+        return Op(op_id, 1, "deleteDecl", Target(a.symbolId, a.addressId),
+                  {"file": a.file}, {}, {"summary": "delete decl"}, prov)
+
+    def __getitem__(self, i: int) -> Op:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        if self._ops is None:
+            self._ops = [None] * n
+        op = self._ops[i]
+        if op is None:
+            op = self._ops[i] = self._build_one(i)
+        return op
+
+    def materialize(self) -> List[Op]:
+        """Every op as an object, built with per-kind tight loops (the
+        cost profile of the old eager path, paid only when a consumer
+        actually iterates)."""
+        if self._all_done:
+            return self._ops  # type: ignore[return-value]
+        ids = self.ids()
+        n = len(self)
+        ops: List[Optional[Op]] = self._ops if self._ops is not None else [None] * n
+        prov = self.prov
+        base_nodes, side_nodes = self.base_nodes, self.side_nodes
+        kinds = self.kind
+        for k in (KIND_RENAME, KIND_MOVE, KIND_ADD, KIND_DELETE):
+            idxs = np.nonzero(kinds == k)[0]
+            if not len(idxs):
+                continue
+            ai = self.a_slot[idxs].tolist()
+            bi = self.b_slot[idxs].tolist()
+            where = idxs.tolist()
+            if k == KIND_RENAME:
+                for i, x, y in zip(where, ai, bi):
+                    if ops[i] is not None:
+                        continue
+                    a, b = base_nodes[x], side_nodes[y]
+                    ops[i] = Op(ids[i], 1, "renameSymbol",
+                                Target(a.symbolId, a.addressId),
+                                {"oldName": a.name, "newName": b.name,
+                                 "file": b.file},
+                                {"exists": True, "addressMatch": a.addressId},
+                                {"summary": f"rename {a.name}→{b.name}"}, prov)
+            elif k == KIND_MOVE:
+                for i, x, y in zip(where, ai, bi):
+                    if ops[i] is not None:
+                        continue
+                    a, b = base_nodes[x], side_nodes[y]
+                    ops[i] = Op(ids[i], 1, "moveDecl",
+                                Target(a.symbolId, a.addressId),
+                                {"oldAddress": a.addressId,
+                                 "newAddress": b.addressId,
+                                 "oldFile": a.file, "newFile": b.file},
+                                {"exists": True, "addressMatch": a.addressId},
+                                {"summary":
+                                 f"move {a.addressId}→{b.addressId}"}, prov)
+            elif k == KIND_ADD:
+                for i, y in zip(where, bi):
+                    if ops[i] is not None:
+                        continue
+                    b = side_nodes[y]
+                    ops[i] = Op(ids[i], 1, "addDecl",
+                                Target(b.symbolId, b.addressId),
+                                {"file": b.file}, {},
+                                {"summary": "add decl"}, prov)
+            else:
+                for i, x in zip(where, ai):
+                    if ops[i] is not None:
+                        continue
+                    a = base_nodes[x]
+                    ops[i] = Op(ids[i], 1, "deleteDecl",
+                                Target(a.symbolId, a.addressId),
+                                {"file": a.file}, {},
+                                {"summary": "delete decl"}, prov)
+        self._ops = ops
+        self._all_done = True
+        return ops  # type: ignore[return-value]
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    # -- columnar serialization --------------------------------------------
+    def to_json(self) -> str:
+        """The canonical op-log JSON, straight from the columns — no
+        ``Op`` allocation. Byte-identical to
+        ``dumps_canonical([op.to_dict() for op in self])``.
+
+        Prefers the native C renderer (``smn_oplog_json``): node string
+        tables + int32 columns in, JSON bytes out (~20× the Python
+        row loop); falls back to the Python serializer when the native
+        library is unavailable."""
+        if len(self) > 0:
+            native = self._to_json_native()
+            if native is not None:
+                return native
+        return self._to_json_py()
+
+    def _to_json_native(self) -> Optional[str]:
+        from ..frontend.native import try_oplog_json
+        base_tbl = _get_table(self.base_tbl_ref, self.base_nodes)
+        side_tbl = _get_table(self.side_tbl_ref, self.side_nodes)
+        return try_oplog_json(
+            len(self),
+            np.ascontiguousarray(self.kind, np.int32),
+            np.ascontiguousarray(self.a_slot, np.int32),
+            np.ascontiguousarray(self.b_slot, np.int32),
+            np.ascontiguousarray(self.words, np.int32),
+            base_tbl[0], base_tbl[1], side_tbl[0], side_tbl[1],
+            dumps_canonical(self.prov))
+
+    def _to_json_py(self) -> str:
+        ids = self.ids()
+        n = len(self)
+        rows: List[Optional[str]] = [None] * n
+        prov = dumps_canonical(self.prov)
+        base_nodes, side_nodes = self.base_nodes, self.side_nodes
+        kinds = self.kind
+        # Escaped-body cache: every string is escape-checked at most
+        # once per call (files repeat per decl, addressIds per row) and
+        # summaries concatenate cached bodies — zero regex on the
+        # composed text.
+        bc: Dict[str, str] = {}
+        bc_get = bc.get
+
+        def body(s: str) -> str:
+            r = bc_get(s)
+            if r is None:
+                r = bc[s] = _esc_body(s)
+            return r
+
+        for k in (KIND_RENAME, KIND_MOVE, KIND_ADD, KIND_DELETE):
+            idxs = np.nonzero(kinds == k)[0]
+            if not len(idxs):
+                continue
+            ai = self.a_slot[idxs].tolist()
+            bi = self.b_slot[idxs].tolist()
+            where = idxs.tolist()
+            if k == KIND_RENAME:
+                for i, x, y in zip(where, ai, bi):
+                    a, b = base_nodes[x], side_nodes[y]
+                    ea = body(a.addressId)
+                    an, bn = body(a.name), body(b.name)
+                    rows[i] = (
+                        f'{{"id":"{ids[i]}","schemaVersion":1,'
+                        f'"type":"renameSymbol","target":{{"symbolId":'
+                        f'"{body(a.symbolId)}","addressId":"{ea}"}},"params":'
+                        f'{{"oldName":"{an}","newName":"{bn}",'
+                        f'"file":"{body(b.file)}"}},"guards":{{"exists":true,'
+                        f'"addressMatch":"{ea}"}},"effects":{{"summary":'
+                        f'"rename {an}→{bn}"}},'
+                        f'"provenance":{prov}}}')
+            elif k == KIND_MOVE:
+                for i, x, y in zip(where, ai, bi):
+                    a, b = base_nodes[x], side_nodes[y]
+                    ea = body(a.addressId)
+                    eb = body(b.addressId)
+                    rows[i] = (
+                        f'{{"id":"{ids[i]}","schemaVersion":1,'
+                        f'"type":"moveDecl","target":{{"symbolId":'
+                        f'"{body(a.symbolId)}","addressId":"{ea}"}},"params":'
+                        f'{{"oldAddress":"{ea}","newAddress":"{eb}","oldFile":'
+                        f'"{body(a.file)}","newFile":"{body(b.file)}"}},'
+                        f'"guards":{{"exists":true,"addressMatch":"{ea}"}},'
+                        f'"effects":{{"summary":"move {ea}→{eb}"}},'
+                        f'"provenance":{prov}}}')
+            elif k == KIND_ADD:
+                for i, y in zip(where, bi):
+                    b = side_nodes[y]
+                    rows[i] = (
+                        f'{{"id":"{ids[i]}","schemaVersion":1,'
+                        f'"type":"addDecl","target":{{"symbolId":'
+                        f'"{body(b.symbolId)}","addressId":"{body(b.addressId)}"}},'
+                        f'"params":{{"file":"{body(b.file)}"}},"guards":{{}},'
+                        f'"effects":{{"summary":"add decl"}},'
+                        f'"provenance":{prov}}}')
+            else:
+                for i, x in zip(where, ai):
+                    a = base_nodes[x]
+                    rows[i] = (
+                        f'{{"id":"{ids[i]}","schemaVersion":1,'
+                        f'"type":"deleteDecl","target":{{"symbolId":'
+                        f'"{body(a.symbolId)}","addressId":"{body(a.addressId)}"}},'
+                        f'"params":{{"file":"{body(a.file)}"}},"guards":{{}},'
+                        f'"effects":{{"summary":"delete decl"}},'
+                        f'"provenance":{prov}}}')
+        return "[" + ",".join(rows) + "]"  # type: ignore[arg-type]
+
+
+class ComposedOpView(Sequence):
+    """The composed stream as references into the two side views plus
+    per-row chain overrides — a lazy ``Sequence[Op]``.
+
+    ``sides``/``idxs`` index raw (unsorted) stream positions;
+    ``addr_s``/``file_s``/``name_s`` carry the decoded chain-override
+    strings (``None`` = no override), exactly the arguments the eager
+    path fed :func:`_materialize_decoded`."""
+
+    __slots__ = ("sides", "idxs", "addr_s", "file_s", "name_s",
+                 "left", "right", "_all")
+
+    def __init__(self, sides: List[int], idxs: List[int],
+                 addr_s: List[Optional[str]], file_s: List[Optional[str]],
+                 name_s: List[Optional[str]],
+                 left: OpStreamView, right: OpStreamView) -> None:
+        self.sides = sides
+        self.idxs = idxs
+        self.addr_s = addr_s
+        self.file_s = file_s
+        self.name_s = name_s
+        self.left = left
+        self.right = right
+        self._all: Optional[List[Op]] = None
+
+    def __len__(self) -> int:
+        return len(self.sides)
+
+    def __getitem__(self, i: int) -> Op:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        if self._all is not None:
+            return self._all[i]
+        src = self.left if self.sides[i] == 0 else self.right
+        return _materialize_decoded(src[self.idxs[i]], self.addr_s[i],
+                                    self.file_s[i], self.name_s[i])
+
+    def materialize(self) -> List[Op]:
+        if self._all is None:
+            ops_l = self.left.materialize()
+            ops_r = self.right.materialize()
+            self._all = [
+                _materialize_decoded(
+                    (ops_l if side == 0 else ops_r)[i], na, nf, nn)
+                for side, i, na, nf, nn in zip(self.sides, self.idxs,
+                                               self.addr_s, self.file_s,
+                                               self.name_s)]
+        return self._all
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+
+def _materialize_decoded(op: Op, new_addr: Optional[str],
+                         new_file: Optional[str],
+                         rename_ctx: Optional[str]) -> Op:
+    """Apply a row's decoded chain overrides to its stream op (shared
+    with the eager two-program decode; observable output identical to
+    the host composer's deep clone — see ``core.compose._materialize``)."""
+    if new_addr is None and new_file is None and (
+            rename_ctx is None or op.type == "renameSymbol"):
+        return op
+    cloned = Op(id=op.id, schemaVersion=op.schemaVersion, type=op.type,
+                target=op.target, params=dict(op.params),
+                guards=op.guards, effects=op.effects,
+                provenance=op.provenance)
+    if new_addr is not None or new_file is not None:
+        if cloned.type == "moveDecl":
+            if new_addr is not None:
+                cloned.params["newAddress"] = new_addr
+            if new_file is not None:
+                cloned.params["newFile"] = new_file
+        if new_addr is not None:
+            cloned.target = Target(symbolId=cloned.target.symbolId,
+                                   addressId=new_addr)
+        if cloned.type == "renameSymbol" and new_file is not None:
+            cloned.params["newFile"] = new_file
+            cloned.params["file"] = new_file
+    if rename_ctx is not None and cloned.type != "renameSymbol":
+        cloned.params["renameContext"] = rename_ctx
+    return cloned
+
+
+def cursor_walk_conflicts_columnar(
+        prec_a: List[int], ren_a: List[bool], sym_a: List[int],
+        name_a: List[int],
+        prec_b: List[int], ren_b: List[bool], sym_b: List[int],
+        name_b: List[int]) -> Tuple[List[Tuple[int, int]], set, set]:
+    """The reference's head-vs-head DivergentRename walk on int rows.
+
+    Same algorithm (including the bisect bulk-advance) as
+    :func:`semantic_merge_tpu.core.compose.cursor_walk_conflicts`, but
+    the per-op reads — type, symbolId, newName — come from int columns:
+    the interner is injective, so int equality IS string equality.
+    Returns ``(pairs, dropped_a, dropped_b)`` where ``pairs`` are
+    ``(ia, ib)`` sorted-stream positions of each conflict, in the
+    walk's emission order. Parity with the Op-object walk is
+    property-tested in ``tests/test_oplog_view.py``."""
+    pairs: List[Tuple[int, int]] = []
+    dropped_a: set = set()
+    dropped_b: set = set()
+    na, nb = len(prec_a), len(prec_b)
+    ia = ib = 0
+    while ia < na or ib < nb:
+        if ib >= nb or not ren_b[ib]:
+            if ia >= na:
+                ib = nb
+            elif ib >= nb:
+                ia = na
+            else:
+                nxt = bisect_right(prec_a, prec_b[ib], ia, na)
+                if nxt == ia:
+                    ib += 1
+                else:
+                    ia = nxt
+            continue
+        if ia >= na or not ren_a[ia]:
+            if ia >= na:
+                ib = nb
+            else:
+                nxt = bisect_left(prec_b, prec_a[ia], ib, nb)
+                if nxt == ib:
+                    ia += 1
+                else:
+                    ib = nxt
+            continue
+        take_a = prec_a[ia] <= prec_b[ib]
+        if sym_a[ia] == sym_b[ib] and name_a[ia] != name_b[ib]:
+            pairs.append((ia, ib))
+            dropped_a.add(ia)
+            dropped_b.add(ib)
+            ia += 1
+            ib += 1
+            continue
+        if take_a:
+            ia += 1
+        else:
+            ib += 1
+    return pairs, dropped_a, dropped_b

@@ -219,3 +219,66 @@ def test_semrebase_without_note_fails_cleanly(repo):
     commit_all(repo, "base")
     rc = main(["semrebase", "HEAD", "HEAD"])
     assert rc == 1
+
+
+def test_semmerge_incremental_matches_full_scan(repo):
+    """Incremental scoping (engine.incremental, the default) must
+    produce the same op logs and merged tree as a full-tree scan —
+    unchanged files can contribute no diff rows and restriction
+    preserves emission order, so op ids are identical
+    (runtime/git.py merge_scope)."""
+    (repo / "src").mkdir()
+
+    def decl(i, name):
+        # Unique param count per decl: symbolId hashes the structural
+        # signature only, so same-shape decls would collide (the
+        # reference's JS-Map quirk this test must avoid).
+        params = ", ".join(f"p{k}: number" for k in range(i + 1))
+        return f"export function {name}({params}): number {{\n  return {i};\n}}\n"
+
+    for i in range(12):
+        (repo / f"src/m{i}.ts").write_text(decl(i, f"fn{i}"))
+    commit_all(repo, "base")
+    git(["branch", "basebr"], repo)
+
+    git(["checkout", "-qb", "brA"], repo)
+    (repo / "src/m0.ts").write_text(decl(0, "renamed0"))
+    commit_all(repo, "rename in m0")
+
+    git(["checkout", "-q", "main"], repo)
+    git(["checkout", "-qb", "brB"], repo)
+    (repo / "lib").mkdir()
+    (repo / "src/m3.ts").rename(repo / "lib/m3.ts")
+    commit_all(repo, "move m3")
+    git(["checkout", "-q", "main"], repo)
+
+    from semantic_merge_tpu.runtime.git import merge_scope
+    scope = merge_scope("basebr", "brA", "brB", cwd=repo)
+    assert scope == {"src/m0.ts", "src/m3.ts", "lib/m3.ts"}
+
+    def notes(rev):
+        return subprocess.run(
+            ["git", "notes", "--ref", "semmerge", "show", rev],
+            cwd=repo, stdout=subprocess.PIPE, text=True, check=True).stdout
+
+    rc = main(["semmerge", "basebr", "brA", "brB",
+               "--inplace", "--backend", "host"])
+    assert rc == 0
+    inc_notes = (notes("brA"), notes("brB"))
+    inc_tree = {p.relative_to(repo).as_posix(): p.read_text()
+                for p in sorted(repo.rglob("*.ts"))}
+
+    git(["checkout", "-q", "--", "."], repo)
+    git(["clean", "-qfd", "--", "src", "lib"], repo)
+    (repo / ".semmerge.toml").write_text(
+        "[engine]\nincremental = false\n")
+    rc = main(["semmerge", "basebr", "brA", "brB",
+               "--inplace", "--backend", "host"])
+    assert rc == 0
+    assert (notes("brA"), notes("brB")) == inc_notes
+    full_tree = {p.relative_to(repo).as_posix(): p.read_text()
+                 for p in sorted(repo.rglob("*.ts"))}
+    assert full_tree == inc_tree
+    # The merge itself behaved: rename landed, move landed.
+    assert "renamed0" in (repo / "src/m0.ts").read_text()
+    assert (repo / "lib/m3.ts").exists()

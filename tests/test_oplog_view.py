@@ -1,0 +1,180 @@
+"""Columnar op-log view parity (ops/oplog_view.py).
+
+The views must be observably identical to the eager Op-object path
+they replaced: same ops from ``__getitem__``/iteration, byte-identical
+``to_json()``, and a columnar DivergentRename cursor walk that agrees
+with the host oracle walk (``core/compose.py:97``) on arbitrary
+streams. End-to-end fused-vs-host parity (which now exercises these
+views on every merge) lives in ``tests/test_fused.py``.
+"""
+from __future__ import annotations
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from semantic_merge_tpu.core.ids import deterministic_op_id
+from semantic_merge_tpu.core.ops import Op, OpLog, Target, dumps_canonical
+from semantic_merge_tpu.frontend.scanner import DeclNode
+from semantic_merge_tpu.ops.oplog_view import (
+    KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME,
+    ComposedOpView, OpStreamView, cursor_walk_conflicts_columnar, _esc)
+
+
+def test_kind_codes_match_device_diff():
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from semantic_merge_tpu.ops import diff
+    assert (KIND_RENAME, KIND_MOVE, KIND_ADD, KIND_DELETE) == (
+        diff.KIND_RENAME, diff.KIND_MOVE, diff.KIND_ADD, diff.KIND_DELETE)
+
+
+# Strings that stress the JSON fast path: quotes, backslashes, control
+# chars, non-ASCII (must stay raw — ensure_ascii=False), emptiness.
+_NASTY = ['plain', 'with "quotes"', 'back\\slash', 'tab\there',
+          'new\nline', 'null\x00char', 'unicode→é漢', '', ' spaced ',
+          'a/b.ts', "src/mod.ts::fn::12"]
+
+
+def _node(i: int, rng: random.Random) -> DeclNode:
+    name = rng.choice(_NASTY) + str(i)
+    file = rng.choice(_NASTY) + f"{i}.ts"
+    return DeclNode(symbolId=f"{i:016x}", addressId=f"{file}::{name}::{i}",
+                    kind="function", name=name, file=file, pos=i, end=i + 1,
+                    signature=f"sig{i}")
+
+
+def _random_view(n: int, seed: int = 0):
+    rng = random.Random(seed)
+    base_nodes = [_node(i, rng) for i in range(n + 4)]
+    side_nodes = [_node(1000 + i, rng) for i in range(n + 4)]
+    kind = np.asarray([rng.choice([KIND_RENAME, KIND_MOVE, KIND_ADD,
+                                   KIND_DELETE]) for _ in range(n)],
+                      np.int32)
+    a_slot = np.asarray([rng.randrange(len(base_nodes)) for _ in range(n)],
+                        np.int32)
+    b_slot = np.asarray([rng.randrange(len(side_nodes)) for _ in range(n)],
+                        np.int32)
+    words = np.asarray([[rng.getrandbits(31) for _ in range(4)]
+                        for _ in range(n)], np.int32)
+    prov = {"rev": "r", "timestamp": "2026-01-01T00:00:00Z"}
+    return OpStreamView(kind, a_slot, b_slot, words, base_nodes,
+                        side_nodes, prov)
+
+
+def test_esc_matches_json_dumps():
+    for s in _NASTY:
+        assert _esc(s) == json.dumps(s, ensure_ascii=False)
+
+
+def test_stream_view_getitem_iter_parity():
+    view = _random_view(64, seed=1)
+    # Single-item materialization must equal bulk materialization.
+    spot = [view[i].to_dict() for i in (0, 5, 63, -1)]
+    bulk = [op.to_dict() for op in view]
+    assert len(bulk) == 64
+    assert spot == [bulk[0], bulk[5], bulk[63], bulk[63]]
+    # Cache coherence: repeated access returns the same object.
+    assert view[5] is list(view)[5]
+
+
+def test_stream_view_to_json_byte_parity():
+    for seed in range(5):
+        view = _random_view(48, seed=seed)
+        expect = dumps_canonical([op.to_dict() for op in view])
+        assert view.to_json() == expect
+        # And through the OpLog seam the CLI/notes actually use.
+        assert OpLog(view).to_json() == expect
+
+
+def test_stream_view_to_json_empty():
+    view = _random_view(0)
+    assert view.to_json() == "[]"
+    assert list(view) == []
+
+
+def test_composed_view_applies_overrides():
+    view = _random_view(8, seed=3)
+    n = len(view)
+    sides = [0] * n
+    idxs = list(range(n))
+    addr_s = [None, "A::1", None, None, "A::2", None, None, None]
+    file_s = [None, "f.ts", "g.ts", None, None, None, None, None]
+    name_s = [None, None, None, "nn", None, None, None, None]
+    comp = ComposedOpView(sides, idxs, addr_s, file_s, name_s, view, view)
+    from semantic_merge_tpu.ops.oplog_view import _materialize_decoded
+    expect = [_materialize_decoded(view[i], addr_s[i], file_s[i], name_s[i])
+              for i in range(n)]
+    got = list(comp)
+    assert [o.to_dict() for o in got] == [o.to_dict() for o in expect]
+    assert comp[1].to_dict() == expect[1].to_dict()
+    # Rows without overrides share the stream op (no clone).
+    assert comp[5] is view[5]
+
+
+def _rand_sorted_streams(rng: random.Random, n: int):
+    """Random canonically-sorted op streams plus aligned int columns —
+    ops and columns describe the same stream, so both walks see the
+    same data."""
+    prec_pool = [10, 11, 11, 11, 30, 31]  # rename-heavy, with ties
+    ops, prec, ren, sym, name = [], [], [], [], []
+    rows = []
+    for _ in range(n):
+        p = rng.choice(prec_pool)
+        is_ren = p == 11
+        s = rng.randrange(6)
+        nm = rng.randrange(4)
+        rows.append((p, is_ren, s, nm))
+    rows.sort(key=lambda r: r[0])
+    for i, (p, is_ren, s, nm) in enumerate(rows):
+        t = "renameSymbol" if is_ren else ("moveDecl" if p == 10 else
+                                           ("addDecl" if p == 30 else
+                                            "deleteDecl"))
+        op = Op.new(t, Target(f"sym{s}", f"addr{i}"),
+                    params={"newName": f"name{nm}"} if is_ren else {},
+                    op_id=deterministic_op_id("s", "r", i, t),
+                    provenance={"timestamp": "1970-01-01T00:00:00Z"})
+        ops.append(op)
+        prec.append(p)
+        ren.append(is_ren)
+        sym.append(s if is_ren else -1 - i)  # non-renames never match
+        name.append(nm)
+    return ops, prec, ren, sym, name
+
+
+def test_columnar_walk_matches_oracle_walk():
+    from semantic_merge_tpu.core.compose import cursor_walk_conflicts
+    rng = random.Random(7)
+    for trial in range(60):
+        na, nb = rng.randrange(0, 14), rng.randrange(0, 14)
+        ops_a, pa, ra, sa, nma = _rand_sorted_streams(rng, na)
+        ops_b, pb, rb, sb, nmb = _rand_sorted_streams(rng, nb)
+        keys_a = [(p, "1970-01-01T00:00:00Z") for p in pa]
+        keys_b = [(p, "1970-01-01T00:00:00Z") for p in pb]
+        want_conf, want_da, want_db = cursor_walk_conflicts(
+            ops_a, ops_b, keys_a=keys_a, keys_b=keys_b)
+        pairs, da, db = cursor_walk_conflicts_columnar(
+            pa, ra, sa, nma, pb, rb, sb, nmb)
+        assert (da, db) == (want_da, want_db), f"trial {trial}"
+        assert len(pairs) == len(want_conf)
+        for (ia, ib), conf in zip(pairs, want_conf):
+            got = conf.to_dict()
+            assert ops_a[ia].id in (got["opA"]["id"], got["opB"]["id"])
+
+
+def test_native_serializer_byte_parity():
+    """The C serializer (smn_oplog_json) must emit byte-identical JSON
+    to the Python columnar serializer across nasty strings (quotes,
+    backslashes, control chars incl. NUL, non-ASCII)."""
+    from semantic_merge_tpu.frontend import native
+    if not native.available():
+        pytest.skip("native library unavailable")
+    for seed in range(6):
+        view = _random_view(64, seed=seed)
+        expect = view._to_json_py()
+        got = view._to_json_native()
+        assert got is not None
+        assert got == expect
+    empty = _random_view(0)
+    assert empty.to_json() == "[]"

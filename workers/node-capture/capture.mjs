@@ -1,0 +1,106 @@
+#!/usr/bin/env node
+// Time the reference TypeScript worker's `buildAndDiff` on a bench
+// workload and emit the same one-line JSON row bench.py emits — the
+// measured denominator of the BASELINE.json 50x north star.
+//
+// The worker is spawned verbatim (no instrumentation inside it) and
+// spoken to over its own newline JSON-RPC protocol (reference
+// workers/ts/src/index.ts:16-39), so the measurement includes exactly
+// what a real `semmerge` run pays per merge: payload serialization,
+// pipe transfer, ts.createProgram parse+bind, diff, lift, and the
+// response round trip. Repeats reuse one worker process (warm V8/JIT),
+// and the reported number is the best of N — matching bench.py's
+// warm-path protocol.
+//
+// Usage:
+//   cd <reference>/workers/ts && npm install && npm run build
+//   python workers/node-capture/make_workload.py --preset rung3 -o rung3.json
+//   node workers/node-capture/capture.mjs --worker <reference>/workers/ts/dist/index.js rung3.json
+import { spawn } from "node:child_process";
+import { readFileSync } from "node:fs";
+import readline from "node:readline";
+import { argv, exit, stderr, stdout } from "node:process";
+
+function usage() {
+  stderr.write(
+    "usage: capture.mjs --worker <path/to/dist/index.js> [--repeats N] <workload.json>\n");
+  exit(2);
+}
+
+let workerPath = null;
+let repeats = 3;
+let workloadPath = null;
+for (let i = 2; i < argv.length; i++) {
+  if (argv[i] === "--worker") workerPath = argv[++i];
+  else if (argv[i] === "--repeats") repeats = parseInt(argv[++i], 10);
+  else workloadPath = argv[i];
+}
+if (!workerPath || !workloadPath) usage();
+
+const payload = JSON.parse(readFileSync(workloadPath, "utf-8"));
+const nFiles = payload._n_files ?? payload.base.files.length;
+const params = {
+  base: payload.base, left: payload.left, right: payload.right,
+  config: payload.config ?? {},
+};
+
+const child = spawn("node", [workerPath], {
+  stdio: ["pipe", "pipe", "inherit"],
+});
+const rl = readline.createInterface({ input: child.stdout });
+const pending = new Map();
+rl.on("line", (line) => {
+  if (!line) return;
+  let msg;
+  try { msg = JSON.parse(line); } catch { return; }  // stray worker output
+  const entry = pending.get(msg.id);
+  if (entry) { pending.delete(msg.id); entry.resolve(msg); }
+});
+function failAll(why) {
+  for (const [, entry] of pending) entry.reject(new Error(why));
+  pending.clear();
+}
+child.on("exit", (code, sig) => failAll(`worker exited (code=${code} sig=${sig})`));
+child.on("error", (err) => failAll(`worker spawn failed: ${err}`));
+
+let nextId = 1;
+function call(method, p) {
+  return new Promise((resolve, reject) => {
+    const id = nextId++;
+    pending.set(id, { resolve, reject });
+    child.stdin.write(JSON.stringify({ jsonrpc: "2.0", id, method, params: p }) + "\n");
+  });
+}
+
+let best = Infinity;
+let opCount = 0;
+for (let r = 0; r < repeats; r++) {
+  const t0 = process.hrtime.bigint();
+  let resp;
+  try {
+    resp = await call("buildAndDiff", params);
+  } catch (err) {
+    stderr.write(`capture failed: ${err.message}\n`);
+    exit(1);
+  }
+  const dt = Number(process.hrtime.bigint() - t0) / 1e9;
+  if (resp.error) {
+    stderr.write(`worker error: ${JSON.stringify(resp.error)}\n`);
+    child.kill();
+    exit(1);
+  }
+  opCount = resp.result.opLogLeft.length + resp.result.opLogRight.length;
+  if (dt < best) best = dt;
+  stderr.write(`# repeat ${r}: ${(dt * 1e3).toFixed(1)} ms\n`);
+}
+child.stdin.end();
+child.kill();
+
+stdout.write(JSON.stringify({
+  metric: `files buildAndDiff/sec (reference Node worker, ${payload._preset ?? "?"}, ${nFiles} files)`,
+  value: Math.round((nFiles / best) * 100) / 100,
+  unit: "files/sec",
+  vs_baseline: 1.0,
+  wall_ms: Math.round(best * 1e5) / 100,
+  ops: opCount,
+}) + "\n");

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Emit a bench workload as reference-worker ``buildAndDiff`` params.
+
+Writes the exact JSON-RPC ``params`` payload the reference TypeScript
+worker consumes (reference ``workers/ts/src/protocol.ts:16-21``:
+``{base, left, right, config}`` snapshots), built from the same
+synthetic generators ``bench.py`` times this repo with — so a capture
+run in a Node-equipped environment measures the reference worker on
+the *identical* workload behind ``BENCH_r*.json``.
+
+Usage::
+
+    python workers/node-capture/make_workload.py --preset rung3 -o rung3.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=sorted(bench.PRESETS), default="rung3")
+    ap.add_argument("-o", "--out", default=None)
+    args = ap.parse_args()
+    p = bench.PRESETS[args.preset]
+    if "changed" in p:
+        base, left, right = bench.synth_repo_sparse(p["files"], p["decls"],
+                                                    p["changed"])
+    else:
+        base, left, right = bench.synth_repo(p["files"], p["decls"],
+                                             divergent=p.get("conflicts", False))
+    payload = {
+        "base": base.to_dict(),
+        "left": left.to_dict(),
+        "right": right.to_dict(),
+        "config": {"deterministicSeed": "bench"},
+        "_preset": args.preset,
+        "_n_files": p["files"],
+    }
+    out = args.out or f"{args.preset}.json"
+    with open(out, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+    print(f"wrote {out} ({os.path.getsize(out)/1e6:.1f} MB, "
+          f"{p['files']} files)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

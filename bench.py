@@ -26,10 +26,11 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/semmerge_jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
-
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from semantic_merge_tpu.utils.jaxenv import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
 
 from semantic_merge_tpu.frontend.snapshot import Snapshot  # noqa: E402
 
@@ -252,6 +253,90 @@ def _emit_and_exit_on_watchdog(record: dict, seconds: float):
     return t
 
 
+def run_cold_bench(record: dict, args, conflicts_expected: bool,
+                   json_only: bool = False) -> int:
+    """Driver-shaped cold-start measurement (``--cold``): every
+    repetition forks a FRESH python process that imports JAX, builds
+    the workload, initializes the backend, and runs one merge to the
+    payload endpoint — what the L7 git merge driver pays per
+    invocation. The persistent XLA compilation cache
+    (JAX_COMPILATION_CACHE_DIR) is on, as in the CLI, so compiles are
+    disk-warm after the first run; process/imports/caches are cold
+    every time. Reference budget frame: cold ≤ 40 s / warm ≤ 10 s for
+    a large-repo merge (reference architecture.md:311-313)."""
+    child_code = (
+        "import json, sys, time\n"
+        "t0 = time.perf_counter()\n"
+        "sys.path.insert(0, %r)\n"
+        "import bench\n"
+        "from semantic_merge_tpu.backends.base import get_backend\n"
+        "t_import = time.perf_counter() - t0\n"
+        "base, left, right = bench.synth_repo(%d, %d, divergent=%r)\n"
+        "t1 = time.perf_counter()\n"
+        "bk = get_backend('tpu')\n"
+        "t_init = time.perf_counter() - t1\n"
+        "t2 = time.perf_counter()\n"
+        "bench.run_merge_to_payload(bk, base, left, right)\n"
+        "t_merge = time.perf_counter() - t2\n"
+        "print(json.dumps({'import_s': round(t_import, 3),\n"
+        "                  'backend_init_s': round(t_init, 3),\n"
+        "                  'merge_s': round(t_merge, 3)}))\n"
+    ) % (os.path.dirname(os.path.abspath(__file__)),
+         args.files, args.decls, conflicts_expected)
+    import subprocess
+    runs = []
+    total_walls = []
+    errors = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run([sys.executable, "-c", child_code],
+                                  stdout=subprocess.PIPE, text=True,
+                                  env=dict(os.environ), timeout=600)
+        except subprocess.TimeoutExpired:
+            errors.append("cold child timed out after 600s")
+            continue
+        total_walls.append(time.perf_counter() - t0)
+        lines = proc.stdout.strip().splitlines()
+        if proc.returncode != 0 or not lines:
+            errors.append(f"cold child exit {proc.returncode}, "
+                          f"{len(lines)} stdout lines")
+            continue
+        try:
+            runs.append(json.loads(lines[-1]))
+        except json.JSONDecodeError as exc:
+            errors.append(f"cold child output unparseable: {exc}")
+    if not runs:
+        # Always emit a record — the driver contract (round 1 died
+        # with rc=1 and no JSON).
+        record["metric"] = "cold-start merge wall (fresh process/run)"
+        record["unit"] = "seconds"
+        record["error"] = "; ".join(errors) or "no cold run succeeded"
+        print(json.dumps(record), flush=True)
+        return 1
+    if errors:
+        record["error"] = "; ".join(errors)
+    best = min(range(len(runs)), key=lambda i: runs[i]["merge_s"])
+    import jax
+    platform = jax.devices()[0].platform
+    r = runs[best]
+    record["metric"] = (
+        f"cold-start merge wall (fresh process/run, {args.files} files x "
+        f"{args.decls} decls, platform={platform})")
+    record["value"] = round(r["merge_s"], 3)
+    record["unit"] = "seconds"
+    record["vs_baseline"] = 0.0
+    record["cold_runs"] = runs
+    record["process_wall_s"] = [round(w, 2) for w in total_walls]
+    if not json_only:
+        for i, (run, w) in enumerate(zip(runs, total_walls)):
+            print(f"# cold run {i}: import={run['import_s']}s "
+                  f"init={run['backend_init_s']}s merge={run['merge_s']}s "
+                  f"process_total={w:.1f}s", file=sys.stderr)
+    print(json.dumps(record), flush=True)
+    return 0
+
+
 def run_incremental_bench(record: dict, args, n_changed: int,
                           json_only: bool = False) -> int:
     """The rung5i scenario: a 10k-file tree where only ``n_changed``
@@ -341,6 +426,9 @@ def main() -> int:
     parser.add_argument("--preset", choices=sorted(PRESETS),
                         help="BASELINE.json ladder rung (overrides --files/--decls)")
     parser.add_argument("--json-only", action="store_true")
+    parser.add_argument("--cold", action="store_true",
+                        help="Fork a fresh process per merge (driver-shaped "
+                             "cold start; persistent compile cache on)")
     parser.add_argument("--watchdog", type=float,
                         default=float(os.environ.get("BENCH_WATCHDOG", "900")),
                         help="seconds before the bench force-emits and exits")
@@ -386,7 +474,7 @@ def main() -> int:
 
     from semantic_merge_tpu.backends.base import get_backend
 
-    if n_changed is None:
+    if n_changed is None and not args.cold:
         base, left, right = synth_repo(args.files, args.decls,
                                        divergent=conflicts_expected)
 
@@ -395,6 +483,12 @@ def main() -> int:
     # before the parity/warm runs so BOTH paths are measured under it.
     from semantic_merge_tpu.utils.gctune import tune_for_merge
     tune_for_merge()
+
+    if args.cold:
+        # Cold mode never uses the parent's backends — children build
+        # their own; skip parent-side backend init entirely.
+        return run_cold_bench(record, args, conflicts_expected,
+                              json_only=args.json_only)
 
     try:
         tpu = get_backend("tpu")

@@ -389,28 +389,91 @@ static StrSet collect_type_names(const TokVec& toks) {
   return names;
 }
 
+// Index of the `@` starting a (possibly dotted) decorator name ending
+// just before j — `@Name` / `@ns.sub.Name` — or -1 (twin of
+// scanner._decorator_start).
+static int decorator_start(const TokVec& toks, int j) {
+  int t = j - 1;
+  if (t < 0 || toks[t].type != T_IDENT) return -1;
+  while (t - 2 >= 0 && toks[t - 1].text == "." && toks[t - 2].type == T_IDENT)
+    t -= 2;
+  if (t - 1 >= 0 && toks[t - 1].text == "@") return t - 1;
+  return -1;
+}
+
 static int full_start(const TokVec& toks, int i) {
+  // Walk back over modifiers AND decorators: TS parses `@dec` as part
+  // of the declaration node, so the node's pos starts before it
+  // (twin of scanner._full_start).
   int j = i;
-  while (j - 1 >= 0 && toks[j - 1].type == T_IDENT &&
-         DECL_MODIFIERS.count(toks[j - 1].text))
-    j -= 1;
+  while (j - 1 >= 0) {
+    const Token& prev = toks[j - 1];
+    if (prev.type == T_IDENT && DECL_MODIFIERS.count(std::string(prev.text))) {
+      j -= 1;
+      continue;
+    }
+    if (prev.text == ")") {  // @ Name( ... ) / @ ns.Name( ... )
+      int k = j - 1, depth = 0;
+      while (k >= 0) {
+        if (toks[k].text == ")") depth += 1;
+        else if (toks[k].text == "(") {
+          depth -= 1;
+          if (depth == 0) break;
+        }
+        k -= 1;
+      }
+      int start = decorator_start(toks, k);
+      if (start >= 0) {
+        j = start;
+        continue;
+      }
+    }
+    if (prev.type == T_IDENT) {
+      int start = decorator_start(toks, j);
+      if (start >= 0) {
+        j = start;
+        continue;
+      }
+    }
+    break;
+  }
   return toks[j].prev_end;
 }
 
-static int skip_type_params(const TokVec& toks, int i) {
+// (names, index_after) for a `<T, U extends X = Y>` list at i. Type
+// parameters resolve lexically: the checker renders a type-parameter
+// reference by its name even with no default lib, so the signature
+// renderers treat these names as in-scope types (twin of
+// scanner._type_param_names).
+static int type_param_names(const TokVec& toks, int i,
+                            std::vector<std::string>* names) {
   int n = int(toks.size());
   if (i < n && toks[i].text == "<") {
     int depth = 0;
+    bool expecting = false;
     while (i < n) {
-      if (toks[i].text == "<") depth += 1;
-      else if (toks[i].text == ">" || toks[i].text == ">>" || toks[i].text == ">>>") {
-        depth -= int(toks[i].text.size());  // count of '>' chars
+      const auto& t = toks[i].text;
+      if (t == "<") {
+        depth += 1;
+        if (depth == 1) expecting = true;
+      } else if (t == ">" || t == ">>" || t == ">>>") {
+        depth -= int(t.size());  // count of '>' chars
         if (depth <= 0) return i + 1;
+      } else if (depth == 1 && t == ",") {
+        expecting = true;
+      } else if (expecting && depth == 1 && toks[i].type == T_IDENT &&
+                 t != "const" && t != "in" && t != "out") {
+        if (names) names->push_back(std::string(t));
+        expecting = false;
       }
       i += 1;
     }
   }
   return i;
+}
+
+static int skip_type_params(const TokVec& toks, int i) {
+  return type_param_names(toks, i, nullptr);
 }
 
 static int matching_brace(const TokVec& toks, int i) {
@@ -712,20 +775,30 @@ static bool scan_function(const std::string& path, const TokVec& toks, int i,
     has_name = true;
     j += 1;
   }
-  j = skip_type_params(toks, j);
+  std::vector<std::string> tp_names;
+  j = type_param_names(toks, j, &tp_names);
   if (j >= n || toks[j].text != "(") return false;
   if (!has_name && !has_default_modifier(toks, i)) return false;
+  // The decl's own type parameters are lexically in scope for its
+  // param/return annotations and render by name (checker semantics).
+  StrSet local_owned;
+  const StrSet* scope = &declared;
+  if (!tp_names.empty()) {
+    local_owned = declared;
+    for (auto& nm : tp_names) local_owned.insert(nm);
+    scope = &local_owned;
+  }
   int params_start = j;
   int params_end = matching_paren(toks, params_start);
   std::vector<const Token*> ptoks;
   for (int k = params_start + 1; k < params_end; k++) ptoks.push_back(&toks[k]);
-  auto param_types = parse_param_types(ptoks, declared);
+  auto param_types = parse_param_types(ptoks, *scope);
   int k = params_end + 1;
   std::string ret_type = "any";
   if (k < n && toks[k].text == ":") {
     static const StrSet stop = {"{", ";"};
     auto [type_toks, k2] = collect_type_tokens(toks, k + 1, stop);
-    ret_type = render_type(type_toks, declared);
+    ret_type = render_type(type_toks, *scope);
     k = k2;
   }
   int end_idx;

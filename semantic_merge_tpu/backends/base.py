@@ -39,13 +39,15 @@ class Backend(Protocol):
                        *, base_rev: str = "base", seed: str = "0",
                        timestamp: str | None = None,
                        change_signature: bool = False,
-                       structured_apply: bool = False) -> BuildAndDiffResult: ...
+                       structured_apply: bool = False,
+                       statement_ops: bool = False) -> BuildAndDiffResult: ...
 
     def diff(self, base: Snapshot, right: Snapshot,
              *, base_rev: str = "base", seed: str = "0",
              timestamp: str | None = None,
              change_signature: bool = False,
-             structured_apply: bool = False) -> List[Op]: ...
+             structured_apply: bool = False,
+             statement_ops: bool = False) -> List[Op]: ...
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         """Compose two op logs; backends override to run composition on
@@ -64,6 +66,7 @@ def run_merge(backend: Backend, base: Snapshot, left: Snapshot,
               right: Snapshot, *, base_rev: str = "base", seed: str = "0",
               timestamp: str | None = None, change_signature: bool = False,
               structured_apply: bool = False, signature_matcher=None,
+              statement_ops: bool = False,
               phases: Dict | None = None):
     """Full 3-way merge through a backend: uses the backend's fused
     ``merge`` entry point when it has one (the TPU backend's
@@ -74,13 +77,14 @@ def run_merge(backend: Backend, base: Snapshot, left: Snapshot,
         return merge(base, left, right, base_rev=base_rev, seed=seed,
                      timestamp=timestamp, change_signature=change_signature,
                      structured_apply=structured_apply,
-                     signature_matcher=signature_matcher, phases=phases)
+                     signature_matcher=signature_matcher,
+                     statement_ops=statement_ops, phases=phases)
     import time
     t0 = time.perf_counter()
     result = backend.build_and_diff(
         base, left, right, base_rev=base_rev, seed=seed, timestamp=timestamp,
         change_signature=change_signature, structured_apply=structured_apply,
-        signature_matcher=signature_matcher)
+        signature_matcher=signature_matcher, statement_ops=statement_ops)
     if phases is not None:
         phases["build_and_diff"] = (phases.get("build_and_diff", 0.0)
                                     + time.perf_counter() - t0)

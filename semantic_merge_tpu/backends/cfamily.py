@@ -9,8 +9,8 @@ from __future__ import annotations
 
 from typing import List
 
-from ..core.difflift import (diff_nodes, lift, refine_signature_changes,
-                             source_maps)
+from ..core.difflift import (diff_nodes, lift, lift_statements,
+                             refine_signature_changes, source_maps)
 from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
 from ..frontend.cfamily import LanguageSpec, scan_snapshot_cfamily
@@ -35,7 +35,8 @@ class CFamilyBackend:
                        timestamp: str | None = None,
                        change_signature: bool = False,
                        structured_apply: bool = False,
-                       signature_matcher=None) -> BuildAndDiffResult:
+                       signature_matcher=None,
+                       statement_ops: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot_cfamily(self._filter(base), self.spec)
         left_nodes = scan_snapshot_cfamily(self._filter(left), self.spec)
@@ -51,13 +52,23 @@ class CFamilyBackend:
         if change_signature:
             diffs_l = refine_signature_changes(diffs_l, src_l, signature_matcher)
             diffs_r = refine_signature_changes(diffs_r, src_r, signature_matcher)
+        stmt_l = stmt_r = []
+        if statement_ops:
+            stmt_l = lift_statements(
+                diffs_l, base_nodes, left_nodes, src_l,
+                (self._filter(base), self._filter(left)),
+                base_rev=base_rev, seed=seed, side="L", timestamp=ts)
+            stmt_r = lift_statements(
+                diffs_r, base_nodes, right_nodes, src_r,
+                (self._filter(base), self._filter(right)),
+                base_rev=base_rev, seed=seed, side="R", timestamp=ts)
         if not structured_apply:
             src_l = src_r = None
         return BuildAndDiffResult(
             op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts,
-                             sources=src_l),
+                             sources=src_l) + stmt_l,
             op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts,
-                              sources=src_r),
+                              sources=src_r) + stmt_r,
             symbol_maps={
                 "base": symbol_map(base_nodes),
                 "left": symbol_map(left_nodes),
@@ -70,7 +81,8 @@ class CFamilyBackend:
              timestamp: str | None = None,
              change_signature: bool = False,
              structured_apply: bool = False,
-             signature_matcher=None) -> List[Op]:
+             signature_matcher=None,
+             statement_ops: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot_cfamily(self._filter(base), self.spec)
         right_nodes = scan_snapshot_cfamily(self._filter(right), self.spec)
@@ -81,10 +93,16 @@ class CFamilyBackend:
                    if want_sources else None)
         if change_signature:
             diffs = refine_signature_changes(diffs, sources, signature_matcher)
+        stmt = []
+        if statement_ops:
+            stmt = lift_statements(
+                diffs, base_nodes, right_nodes, sources,
+                (self._filter(base), self._filter(right)),
+                base_rev=base_rev, seed=seed, side="R", timestamp=ts)
         if not structured_apply:
             sources = None
         return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts,
-                    sources=sources)
+                    sources=sources) + stmt
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         return host_compose(delta_a, delta_b)

@@ -116,7 +116,8 @@ class SubprocessBackend:
                        timestamp: str | None = None,
                        change_signature: bool = False,
                        structured_apply: bool = False,
-                       signature_matcher=None) -> BuildAndDiffResult:
+                       signature_matcher=None,
+                       statement_ops: bool = False) -> BuildAndDiffResult:
         if signature_matcher is not None:
             raise WorkerError(
                 "signature_matcher is in-process only; the subprocess "
@@ -126,6 +127,7 @@ class SubprocessBackend:
             "right": self._files(right), "baseRev": base_rev, "seed": seed,
             "timestamp": timestamp, "changeSignature": change_signature,
             "structuredApply": structured_apply,
+            "statementOps": statement_ops,
         })
         return BuildAndDiffResult(
             op_log_left=[Op.from_dict(o) for o in result["opLogLeft"]],
@@ -139,12 +141,14 @@ class SubprocessBackend:
              timestamp: str | None = None,
              change_signature: bool = False,
              structured_apply: bool = False,
-             signature_matcher=None) -> List[Op]:
+             signature_matcher=None,
+             statement_ops: bool = False) -> List[Op]:
         result = self._call("diff", {
             "base": self._files(base), "right": self._files(right),
             "baseRev": base_rev, "seed": seed, "timestamp": timestamp,
             "changeSignature": change_signature,
             "structuredApply": structured_apply,
+            "statementOps": statement_ops,
         })
         return [Op.from_dict(o) for o in result["opLog"]]
 

@@ -11,8 +11,8 @@ from __future__ import annotations
 
 from typing import List
 
-from ..core.difflift import (diff_nodes, lift, refine_signature_changes,
-                             source_maps)
+from ..core.difflift import (diff_nodes, lift, lift_statements,
+                             refine_signature_changes, source_maps)
 from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
 from ..frontend.scanner import scan_snapshot
@@ -37,7 +37,8 @@ class HostTSBackend:
                        timestamp: str | None = None,
                        change_signature: bool = False,
                        structured_apply: bool = False,
-                       signature_matcher=None) -> BuildAndDiffResult:
+                       signature_matcher=None,
+                       statement_ops: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(ts_files(base))
         left_nodes = scan_snapshot(ts_files(left))
@@ -51,13 +52,23 @@ class HostTSBackend:
         if change_signature:
             diffs_l = refine_signature_changes(diffs_l, src_l, signature_matcher)
             diffs_r = refine_signature_changes(diffs_r, src_r, signature_matcher)
+        stmt_l = stmt_r = []
+        if statement_ops:
+            stmt_l = lift_statements(
+                diffs_l, base_nodes, left_nodes, src_l,
+                (ts_files(base), ts_files(left)),
+                base_rev=base_rev, seed=seed, side="L", timestamp=ts)
+            stmt_r = lift_statements(
+                diffs_r, base_nodes, right_nodes, src_r,
+                (ts_files(base), ts_files(right)),
+                base_rev=base_rev, seed=seed, side="R", timestamp=ts)
         if not structured_apply:
             src_l = src_r = None
         return BuildAndDiffResult(
             op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts,
-                             sources=src_l),
+                             sources=src_l) + stmt_l,
             op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts,
-                              sources=src_r),
+                              sources=src_r) + stmt_r,
             symbol_maps={
                 "base": symbol_map(base_nodes),
                 "left": symbol_map(left_nodes),
@@ -70,7 +81,8 @@ class HostTSBackend:
              timestamp: str | None = None,
              change_signature: bool = False,
              structured_apply: bool = False,
-             signature_matcher=None) -> List[Op]:
+             signature_matcher=None,
+             statement_ops: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         base_nodes = scan_snapshot(ts_files(base))
         right_nodes = scan_snapshot(ts_files(right))
@@ -80,10 +92,16 @@ class HostTSBackend:
         sources = source_maps(ts_files(base), ts_files(right)) if want_sources else None
         if change_signature:
             diffs = refine_signature_changes(diffs, sources, signature_matcher)
+        stmt = []
+        if statement_ops:
+            stmt = lift_statements(
+                diffs, base_nodes, right_nodes, sources,
+                (ts_files(base), ts_files(right)),
+                base_rev=base_rev, seed=seed, side="R", timestamp=ts)
         if not structured_apply:
             sources = None
         return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts,
-                    sources=sources)
+                    sources=sources) + stmt
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         return host_compose(delta_a, delta_b)

@@ -19,8 +19,8 @@ from collections import OrderedDict
 from functools import partial
 from typing import Dict, List
 
-from ..core.difflift import (Diff, lift, refine_signature_changes,
-                             source_maps)
+from ..core.difflift import (Diff, lift, lift_statements,
+                             refine_signature_changes, source_maps)
 from ..core.encode import Interner, encode_decls_keyed
 from ..core.ids import EPOCH_ISO
 from ..core.ops import Op
@@ -158,7 +158,8 @@ class TpuTSBackend:
                        timestamp: str | None = None,
                        change_signature: bool = False,
                        structured_apply: bool = False,
-                       signature_matcher=None) -> BuildAndDiffResult:
+                       signature_matcher=None,
+                       statement_ops: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         self._maybe_reset_interner()
         base_t, base_nodes = self._scan_encode(base)
@@ -174,13 +175,23 @@ class TpuTSBackend:
         if change_signature:
             diffs_l = refine_signature_changes(diffs_l, src_l, signature_matcher)
             diffs_r = refine_signature_changes(diffs_r, src_r, signature_matcher)
+        stmt_l = stmt_r = []
+        if statement_ops:
+            stmt_l = lift_statements(
+                diffs_l, base_nodes, left_nodes, src_l,
+                (ts_files(base), ts_files(left)),
+                base_rev=base_rev, seed=seed, side="L", timestamp=ts)
+            stmt_r = lift_statements(
+                diffs_r, base_nodes, right_nodes, src_r,
+                (ts_files(base), ts_files(right)),
+                base_rev=base_rev, seed=seed, side="R", timestamp=ts)
         if not structured_apply:
             src_l = src_r = None
         return BuildAndDiffResult(
             op_log_left=lift(base_rev, diffs_l, seed=seed + "/L", timestamp=ts,
-                             sources=src_l),
+                             sources=src_l) + stmt_l,
             op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts,
-                              sources=src_r),
+                              sources=src_r) + stmt_r,
             symbol_maps={
                 "base": symbol_map(base_nodes),
                 "left": symbol_map(left_nodes),
@@ -193,11 +204,12 @@ class TpuTSBackend:
              timestamp: str | None = None,
              change_signature: bool = False,
              structured_apply: bool = False,
-             signature_matcher=None) -> List[Op]:
+             signature_matcher=None,
+             statement_ops: bool = False) -> List[Op]:
         ts = timestamp or EPOCH_ISO
         self._maybe_reset_interner()
         if (self._mesh is None and not change_signature
-                and not structured_apply):
+                and not structured_apply and not statement_ops):
             base_t, base_nodes, base_key = self._scan_encode_keyed(base)
             right_t, right_nodes, right_key = self._scan_encode_keyed(right)
             fused = self._fused_engine().diff(
@@ -217,10 +229,16 @@ class TpuTSBackend:
         sources = source_maps(ts_files(base), ts_files(right)) if want_sources else None
         if change_signature:
             diffs = refine_signature_changes(diffs, sources, signature_matcher)
+        stmt = []
+        if statement_ops:
+            stmt = lift_statements(
+                diffs, base_nodes, right_nodes, sources,
+                (ts_files(base), ts_files(right)),
+                base_rev=base_rev, seed=seed, side="R", timestamp=ts)
         if not structured_apply:
             sources = None
         return lift(base_rev, diffs, seed=seed + "/R", timestamp=ts,
-                    sources=sources)
+                    sources=sources) + stmt
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
         if self._mesh is not None:
@@ -235,20 +253,28 @@ class TpuTSBackend:
               change_signature: bool = False,
               structured_apply: bool = False,
               signature_matcher=None,
+              statement_ops: bool = False,
               phases: Dict | None = None):
         """Full 3-way merge in ONE device round trip when eligible (see
         :mod:`semantic_merge_tpu.ops.fused`): diff, deterministic op
         identity, and composition all stay on device; one compact fetch.
         With a mesh active the same program runs dp-sharded (distributed
-        diff sort-join, row-sharded SHA). Ineligible configurations
-        (changeSignature or structured-apply requested, oversized
-        strings) fall back to the two-program path with identical
-        observable output. Returns ``(BuildAndDiffResult, composed_ops,
-        conflicts)``."""
+        diff sort-join, row-sharded SHA). Ineligible configurations —
+        structured-apply, statement ops, or a changeSignature merge
+        whose rows actually contain a foldable delete+add pair — fall
+        back to the two-program path with identical observable output.
+        Returns ``(BuildAndDiffResult, composed_ops, conflicts)``."""
         import time
         ts = timestamp or EPOCH_ISO
         self._maybe_reset_interner()
-        if not change_signature and not structured_apply:
+        if not structured_apply and not statement_ops:
+            # changeSignature no longer forfeits the fused path: the
+            # refinement only *changes* anything when a deleted and an
+            # added decl share (file, name, kind) (exact-key pass of
+            # core.difflift.refine_signature_changes) — checked
+            # columnar-ly on the fetched rows below; the overwhelmingly
+            # common no-candidate merge keeps the one-round-trip result
+            # (its op stream is bit-identical to the refined one).
             t0 = time.perf_counter()
             base_t, base_nodes, base_key = self._scan_encode_keyed(base)
             left_t, left_nodes, left_key = self._scan_encode_keyed(left)
@@ -272,17 +298,26 @@ class TpuTSBackend:
                 overlap_work=build_symbol_maps, phases=phases)
             if fused is not None:
                 ops_l, ops_r, composed, conflicts = fused
-                result = BuildAndDiffResult(
-                    op_log_left=ops_l, op_log_right=ops_r,
-                    symbol_maps=maps,
-                )
-                return result, composed, conflicts
+                if change_signature and (
+                        _changesig_candidates(ops_l, signature_matcher)
+                        or _changesig_candidates(ops_r, signature_matcher)):
+                    # A foldable delete+add pair exists: refinement
+                    # would rewrite the stream (and re-index op ids),
+                    # so this merge takes the two-program path below.
+                    pass
+                else:
+                    result = BuildAndDiffResult(
+                        op_log_left=ops_l, op_log_right=ops_r,
+                        symbol_maps=maps,
+                    )
+                    return result, composed, conflicts
         t0 = time.perf_counter()
         result = self.build_and_diff(
             base, left, right, base_rev=base_rev, seed=seed, timestamp=ts,
             change_signature=change_signature,
             structured_apply=structured_apply,
-            signature_matcher=signature_matcher)
+            signature_matcher=signature_matcher,
+            statement_ops=statement_ops)
         if phases is not None:
             phases["build_and_diff"] = (phases.get("build_and_diff", 0.0)
                                         + time.perf_counter() - t0)
@@ -296,6 +331,38 @@ class TpuTSBackend:
 
     def close(self) -> None:
         pass
+
+
+def _changesig_candidates(view, matcher) -> bool:
+    """Columnar twin of the changeSignature eligibility question: could
+    ``refine_signature_changes`` rewrite this op stream at all?
+
+    Exact-key pass: a deleted decl and an added decl sharing
+    ``(file, name, kind)`` (names non-null). With a model ``matcher``
+    the residual pass keys by ``(kind, file)`` — conservatively, any
+    delete+add pair at all forfeits the fused result. ``view`` is an
+    :class:`~semantic_merge_tpu.ops.oplog_view.OpStreamView`; only the
+    delete/add rows' nodes are touched."""
+    import numpy as np
+
+    from ..ops.oplog_view import KIND_ADD as V_ADD, KIND_DELETE as V_DEL
+    kinds = view.kind
+    del_rows = np.nonzero(kinds == V_DEL)[0]
+    add_rows = np.nonzero(kinds == V_ADD)[0]
+    if not len(del_rows) or not len(add_rows):
+        return False
+    if matcher is not None:
+        return True
+    dels = set()
+    for i in view.a_slot[del_rows].tolist():
+        a = view.base_nodes[i]
+        if a.name:
+            dels.add((a.file, a.name, a.kind))
+    for j in view.b_slot[add_rows].tolist():
+        b = view.side_nodes[j]
+        if b.name and (b.file, b.name, b.kind) in dels:
+            return True
+    return False
 
 
 def decode_diffs(t: DiffOpsTensor,

@@ -58,6 +58,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_diff.add_argument("--signature-matcher", action="store_true",
                         help="Pair renamed+retyped decls by embedding "
                              "similarity (also [engine].signature_matcher)")
+    p_diff.add_argument("--statement-ops", action="store_true",
+                        help="Extract editStmtBlock ops for body-only decl "
+                             "edits (also [engine].statement_ops)")
 
     p_merge = sub.add_parser("semmerge", help="Semantic merge base A B into working tree")
     p_merge.add_argument("base")
@@ -86,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
                          help="Ops carry decl text/spans so add/delete/"
                               "changeSignature materialize structurally "
                               "(also [engine].structured_apply)")
+    p_merge.add_argument("--statement-ops", action="store_true",
+                         help="Extract editStmtBlock ops for body-only decl "
+                              "edits; implied by --strict-conflicts "
+                              "(also [engine].statement_ops)")
 
     p_rebase = sub.add_parser("semrebase", help="Replay a commit's stored op log onto a revision")
     p_rebase.add_argument("commit", help="Commit whose semmerge note holds the op log")
@@ -102,6 +109,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("--ckpt-dir", default=None)
     p_train.add_argument("--ckpt-every", type=int, default=50)
     p_train.add_argument("--no-resume", action="store_true")
+    p_train.add_argument("--eval", action="store_true",
+                         help="After training, report held-out pairing "
+                              "precision/recall (models.evaluate)")
     return parser
 
 
@@ -113,6 +123,12 @@ def main(argv: Sequence[str] | None = None) -> int:
         # must keep normal collection cadence (see utils/gctune).
         from .utils.gctune import tune_for_merge
         tune_for_merge()
+        # Persistent compile cache for driver-shaped cold starts (the
+        # reference's cold ≤40 s budget frame); jaxenv.force_cpu drops
+        # it again on CPU-pinned runs (XLA:CPU AOT reload of collective
+        # executables aborts — see utils/jaxenv).
+        from .utils.jaxenv import enable_compile_cache
+        enable_compile_cache()
     try:
         if args.command == "semdiff":
             return cmd_semdiff(args)
@@ -182,6 +198,8 @@ def cmd_semdiff(args: argparse.Namespace) -> int:
     tracer = Tracer(enabled=args.trace, profile_dir=args.profile)
     backend, config = _resolve_backend(args.backend)
     change_sig = args.change_signature or config.engine.change_signature
+    stmt_ops = (getattr(args, "statement_ops", False)
+                or config.engine.statement_ops)
     try:
         with tracer.phase("snapshot"):
             from .runtime.git import (archive_bytes, diff_scope,
@@ -198,7 +216,8 @@ def cmd_semdiff(args: argparse.Namespace) -> int:
                                timestamp=commit_timestamp_iso(args.rev2),
                                change_signature=change_sig,
                                signature_matcher=_signature_matcher(
-                                   args, config, change_sig))
+                                   args, config, change_sig),
+                               statement_ops=stmt_ops)
     finally:
         backend.close()
         tracer.close()
@@ -244,6 +263,10 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                       or config.engine.structured_apply)
         strict = (getattr(args, "strict_conflicts", False)
                   or config.engine.conflict_mode == "strict")
+        # Strict mode implies statement ops: the ConcurrentStmtEdit
+        # category has no inputs without editStmtBlock extraction.
+        stmt_ops = (getattr(args, "statement_ops", False)
+                    or config.engine.statement_ops or strict)
         sig_matcher = _signature_matcher(args, config, change_sig)
         if not strict:
             # The normal path goes through the backend's fused merge
@@ -255,7 +278,7 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                     backend, base_snap, left_snap, right_snap,
                     base_rev=base_rev, seed=seed, timestamp=timestamp,
                     change_signature=change_sig, structured_apply=structured,
-                    signature_matcher=sig_matcher)
+                    signature_matcher=sig_matcher, statement_ops=stmt_ops)
         else:
             # Strict conflict detection inspects the raw op logs between
             # diff and compose, so it needs the two-step path.
@@ -264,7 +287,7 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                     base_snap, left_snap, right_snap,
                     base_rev=base_rev, seed=seed, timestamp=timestamp,
                     change_signature=change_sig, structured_apply=structured,
-                    signature_matcher=sig_matcher)
+                    signature_matcher=sig_matcher, statement_ops=stmt_ops)
             with tracer.phase("compose"):
                 from .core.strict_conflicts import detect_conflicts_strict
                 ops_left, ops_right, conflicts = detect_conflicts_strict(
@@ -383,6 +406,15 @@ def cmd_train_matcher(args: argparse.Namespace) -> int:
         print(f"nothing to do: checkpoint already at step {args.steps}{where}")
     else:
         print(f"trained {ran} steps, final loss {loss:.4f}{where}")
+    if args.eval:
+        # Held-out pairing precision/recall, from the checkpoint just
+        # written (or seeded params when no --ckpt-dir — reported with
+        # trained=false so the number cannot masquerade as quality).
+        from .models.evaluate import evaluate_matcher
+        from .models.signature import EmbeddingSignatureMatcher
+        matcher = EmbeddingSignatureMatcher(ckpt_dir=args.ckpt_dir,
+                                            allow_untrained=True)
+        print(json.dumps({"matcher_eval": evaluate_matcher(matcher)}))
     return 0
 
 

@@ -24,6 +24,10 @@ Schema (superset of the reference's documented schema at reference
                                    # | "strict" (all [CFR-002] categories)
     text_fallback = true           # [FBK-001]: 3-way text merge for files no
                                    # backend indexes (off => those stay at base)
+    incremental = true             # scope scan/diff to changed files
+                                   # (false => full-tree, collision-exact)
+    statement_ops = false          # extract editStmtBlock body-edit ops
+                                   # (implied by conflict_mode = "strict")
     structured_apply = false       # ops carry decl text/spans; applier splices
                                    # add/delete/changeSignature structurally
     max_nodes_per_bucket = 2048    # padding bucket sizes, powers of two
@@ -64,6 +68,10 @@ class EngineConfig:
     # (reference architecture.md:202-204; see runtime.git.merge_scope
     # for the collision caveat that motivates the off switch).
     incremental: bool = True
+    # Extract editStmtBlock ops for body-only decl edits (implied by
+    # conflict_mode = "strict"; parity mode keeps the reference's op
+    # vocabulary, so this is opt-in).
+    statement_ops: bool = False
     structured_apply: bool = False
     max_nodes_per_bucket: int = 2048
     mesh_shape: str = "auto"
@@ -137,6 +145,9 @@ def load_config(start: pathlib.Path | None = None) -> Config:
             str(engine.get("conflict_mode", config.engine.conflict_mode)),
             "engine.conflict_mode", ("parity", "strict")),
         text_fallback=bool(engine.get("text_fallback", config.engine.text_fallback)),
+        incremental=bool(engine.get("incremental", config.engine.incremental)),
+        statement_ops=bool(
+            engine.get("statement_ops", config.engine.statement_ops)),
         structured_apply=bool(
             engine.get("structured_apply", config.engine.structured_apply)),
         max_nodes_per_bucket=int(

@@ -78,3 +78,32 @@ def delete_vs_edit_conflict(op_del: Op, op_edit: Op, delete_side: str) -> Confli
             {"id": "keepEdit", "label": "Keep the edit", "ops": [op_edit.id]},
         ],
     )
+
+
+def concurrent_stmt_edit_conflict(op_a: Op, op_b: Op) -> Conflict:
+    """Both sides edited the same declaration's statement body to
+    different results ([CFR-002] "Concurrent edits to the same
+    statement with overlapping token ranges", reference
+    ``requirements.md:97``). Granularity is the per-decl body block —
+    the unit ``editStmtBlock`` records; identical edits (equal
+    ``newBodyHash``) agree and do not conflict. The minimal slice is
+    the edited body itself, satisfying [CFR-003]'s minimal-code-slice
+    requirement."""
+    file = str(op_a.params.get("file", ""))
+    return Conflict(
+        id=f"conf-{op_a.id[:8]}-{op_b.id[:8]}",
+        category="ConcurrentStmtEdit",
+        symbolId=op_a.target.symbolId,
+        addressIds={"A": op_a.target.addressId, "B": op_b.target.addressId,
+                    "base": op_a.target.addressId},
+        opA=op_a.to_dict(),
+        opB=op_b.to_dict(),
+        minimalSlice={"path": file, "start": 0, "end": 0,
+                      "code": str(op_a.params.get("oldBody", ""))},
+        suggestions=[
+            {"id": "keepA", "label": "Keep branch A's body edit",
+             "ops": [op_a.id]},
+            {"id": "keepB", "label": "Keep branch B's body edit",
+             "ops": [op_b.id]},
+        ],
+    )

@@ -269,6 +269,86 @@ def lift(base_rev: str, diffs: List[Diff], *, seed: str = "0",
     return ops
 
 
+def statement_edits(base_nodes: List[DeclNode], side_nodes: List[DeclNode],
+                    sources, *, base_rev: str, seed: str,
+                    timestamp: str = EPOCH_ISO, start_idx: int = 0) -> List[Op]:
+    """``editStmtBlock`` ops: identity-stable decls whose body changed.
+
+    The reference *schemas* statement-level edits (its requirements
+    gate two [CFR-002] conflict categories on them, reference
+    ``requirements.md:97-98``; design sketch at
+    ``architecture.md:160``) but extracts none. This pass implements
+    the capability: a declaration present in base and side under the
+    same ``(symbolId, name, file)`` key whose full-span source text
+    (``pos..end``, leading trivia included per the full-start span
+    contract) differs emits one ``editStmtBlock`` op carrying old/new
+    body text + 64-bit body hashes — enough for the applier to splice
+    and for ``semrebase`` to replay. Matching by name+file (not
+    addressId) tolerates the position shifts earlier edits in the same
+    file cause; a decl that was renamed or moved AND body-edited stays
+    outside this pass's reach (the rename/move op already records the
+    change). Key collisions (same signature, name, file) keep the last
+    occurrence, matching the differ's JS-``Map`` semantics.
+
+    Op ids continue the lift stream's index sequence (``start_idx`` =
+    number of lifted ops), so ids stay deterministic functions of
+    (seed, rev, stream position, content). Opt-in: parity mode must
+    keep the reference's observable op vocabulary, so this runs only
+    under ``--statement-ops`` / ``[engine] statement_ops`` / strict
+    conflict mode.
+    """
+    base_map, side_map = sources
+    by_key: Dict[tuple, DeclNode] = {}
+    for n in base_nodes:
+        by_key[(n.symbolId, n.name, n.file)] = n  # last wins, Map quirk
+    ops: List[Op] = []
+    idx = start_idx
+    prov = {"rev": base_rev, "timestamp": timestamp}
+    for b in side_nodes:
+        a = by_key.get((b.symbolId, b.name, b.file))
+        if a is None:
+            continue
+        src_a = base_map.get(a.file)
+        src_b = side_map.get(b.file)
+        if src_a is None or src_b is None:
+            continue
+        old = src_a[a.pos:a.end]
+        new = src_b[b.pos:b.end]
+        if old == new:
+            continue
+        from .ids import stable_hash_hex
+        ops.append(Op.new(
+            "editStmtBlock",
+            Target(symbolId=a.symbolId, addressId=a.addressId),
+            params={"file": b.file,
+                    "oldBodyHash": stable_hash_hex(old, n_hex=16),
+                    "newBodyHash": stable_hash_hex(new, n_hex=16),
+                    "oldBody": old, "newBody": new},
+            guards={"exists": True, "addressMatch": a.addressId},
+            effects={"summary": f"edit body of {a.name}"},
+            provenance=prov,
+            op_id=deterministic_op_id(seed, base_rev, idx, "editStmtBlock",
+                                      a.symbolId, a.addressId, b.addressId),
+        ))
+        idx += 1
+    return ops
+
+
+def lift_statements(diffs, base_nodes, side_nodes, sources, files_pair,
+                    *, base_rev: str, seed: str, side: str,
+                    timestamp: str = EPOCH_ISO) -> List[Op]:
+    """The statement-op tail of one side's lifted stream — the single
+    place that owns the seed/side and start-index conventions every
+    backend must share (op ids continue the lift sequence, so a
+    convention drift would silently fork ids between backends).
+    ``sources`` reuses an already-built :func:`source_maps` pair;
+    ``files_pair`` builds one lazily otherwise."""
+    sm = sources or source_maps(*files_pair)
+    return statement_edits(base_nodes, side_nodes, sm, base_rev=base_rev,
+                           seed=f"{seed}/{side}", timestamp=timestamp,
+                           start_idx=len(diffs))
+
+
 def _op_id(seed: str, rev: str, idx: int, op_type: str, d: Diff) -> str:
     a_addr = d.a.addressId if d.a else ""
     b_addr = d.b.addressId if d.b else ""

@@ -13,11 +13,17 @@ join, immune to interleaving:
 - **IncompatibleSignatureChange** — both sides change one symbol's
   signature differently (requires ``changeSignature`` extraction).
 - **DeleteVsEdit** — one side deletes a declaration the other side
-  renames / moves / re-signs.
+  renames / moves / re-signs / body-edits.
+- **ConcurrentStmtEdit** — both sides edited one declaration's body
+  to different results (requires ``editStmtBlock`` extraction —
+  ``core.difflift.statement_edits``, enabled automatically in strict
+  mode).
 
-The remaining two categories (concurrent statement edits, extract vs
-inline) need statement-level edit ops that no backend extracts yet —
-they gate on the op vocabulary, not on this join.
+The one remaining category, extract vs inline, gates on
+``extractMethod``/``inlineMethod`` extraction that no backend emits —
+body-motion detection across declarations is [SPEC] in the reference
+too (its requirements name the category, reference
+``requirements.md:98``, but its worker has no extractor).
 
 Semantics: conflicting ops drop from both streams (the reference's
 DivergentRename drop semantics, generalized), the pre-pass runs before
@@ -36,11 +42,12 @@ from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
-from .conflict import (Conflict, delete_vs_edit_conflict,
-                       divergent_rename_conflict)
+from .conflict import (Conflict, concurrent_stmt_edit_conflict,
+                       delete_vs_edit_conflict, divergent_rename_conflict)
 from .ops import Op
 
-_EDIT_TYPES = ("renameSymbol", "moveDecl", "changeSignature")
+_EDIT_TYPES = ("renameSymbol", "moveDecl", "changeSignature",
+               "editStmtBlock")
 
 
 def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
@@ -84,6 +91,19 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
             for op_b in sig_b:
                 if op_a.params.get("newSignature") != op_b.params.get("newSignature"):
                     conflicts.append(incompatible_signature_conflict(op_a, op_b))
+                    drop_a.add(id(op_a))
+                    drop_b.add(id(op_b))
+
+        stm_a = [op for op in ops_a if op.type == "editStmtBlock"]
+        stm_b = [op for op in ops_b if op.type == "editStmtBlock"]
+        for op_a in stm_a:
+            for op_b in stm_b:
+                # Same decl (same address), bodies edited to different
+                # results; identical edits agree and pass through.
+                if (op_a.target.addressId == op_b.target.addressId
+                        and op_a.params.get("newBodyHash")
+                        != op_b.params.get("newBodyHash")):
+                    conflicts.append(concurrent_stmt_edit_conflict(op_a, op_b))
                     drop_a.add(id(op_a))
                     drop_b.add(id(op_b))
 

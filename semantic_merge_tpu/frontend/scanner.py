@@ -356,28 +356,93 @@ def _is_expression_position(toks: List[Token], i: int) -> bool:
 
 
 def _full_start(toks: List[Token], i: int) -> int:
-    """The declaration's ``pos``: walk back over modifier tokens to the
-    first token of the declaration node, then take the preceding token's
-    end offset (0 at file start) — TS ``node.pos`` semantics."""
+    """The declaration's ``pos``: walk back over modifier tokens — and
+    decorators, which TS parses as part of the declaration node (a
+    ``@dec class C`` node's span starts at the decorator) — to the
+    first token of the declaration node, then take the preceding
+    token's end offset (0 at file start) — TS ``node.pos`` semantics."""
     j = i
-    while j - 1 >= 0 and toks[j - 1].type == IDENT and toks[j - 1].text in _DECL_MODIFIERS:
-        j -= 1
+    while j - 1 >= 0:
+        prev = toks[j - 1]
+        if prev.type == IDENT and prev.text in _DECL_MODIFIERS:
+            j -= 1
+            continue
+        # Decorator: ``@ Name``, ``@ ns.Name``, or either with a call
+        # ``(...)`` — immediately before the declaration head / its
+        # modifiers.
+        if prev.text == ")":
+            k = j - 1
+            depth = 0
+            while k >= 0:
+                if toks[k].text == ")":
+                    depth += 1
+                elif toks[k].text == "(":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                k -= 1
+            start = _decorator_start(toks, k)
+            if start is not None:
+                j = start
+                continue
+        if prev.type == IDENT:
+            start = _decorator_start(toks, j)
+            if start is not None:
+                j = start
+                continue
+        break
     return toks[j].prev_end
+
+
+def _decorator_start(toks: List[Token], j: int) -> int | None:
+    """Index of the ``@`` starting a (possibly dotted) decorator name
+    that ends just before *j* — ``@Name`` / ``@ns.sub.Name`` — or
+    ``None`` if tokens before *j* are not a decorator name."""
+    t = j - 1
+    if t < 0 or toks[t].type != IDENT:
+        return None
+    while t - 2 >= 0 and toks[t - 1].text == "." and toks[t - 2].type == IDENT:
+        t -= 2
+    if t - 1 >= 0 and toks[t - 1].text == "@":
+        return t - 1
+    return None
 
 
 def _skip_type_params(toks: List[Token], i: int) -> int:
     """Skip ``<...>`` starting at *i* (if present); returns index after."""
+    return _type_param_names(toks, i)[1]
+
+
+def _type_param_names(toks: List[Token], i: int) -> tuple:
+    """``(names, index_after)`` for a ``<T, U extends X = Y>`` list at
+    *i* (empty names if absent). Type parameters resolve *lexically* —
+    the reference checker renders a type-parameter reference by its
+    name regardless of the missing default lib
+    (``checker.typeToString`` of a TypeParameter prints the parameter
+    name; reference ``workers/ts/src/sast.ts:78-83``) — so the
+    signature renderers must treat these names as in-scope types."""
+    names: list = []
     if i < len(toks) and toks[i].text == "<":
         depth = 0
+        expecting = False
         while i < len(toks):
-            if toks[i].text == "<":
+            t = toks[i].text
+            if t == "<":
                 depth += 1
-            elif toks[i].text in (">", ">>", ">>>"):
-                depth -= toks[i].text.count(">")
+                if depth == 1:
+                    expecting = True
+            elif t in (">", ">>", ">>>"):
+                depth -= t.count(">")
                 if depth <= 0:
-                    return i + 1
+                    return names, i + 1
+            elif depth == 1 and t == ",":
+                expecting = True
+            elif (expecting and depth == 1 and toks[i].type == IDENT
+                    and t not in ("const", "in", "out")):
+                names.append(t)
+                expecting = False
             i += 1
-    return i
+    return names, i
 
 
 def _matching_brace(toks: List[Token], i: int) -> int:
@@ -406,22 +471,25 @@ def _scan_function(path: str, toks: List[Token], i: int, declared: set[str]) -> 
     if j < n and toks[j].type == IDENT:
         name = toks[j].text
         j += 1
-    j = _skip_type_params(toks, j)
+    tp_names, j = _type_param_names(toks, j)
     if j >= n or toks[j].text != "(":
         return None
     if name is None and not _has_default_modifier(toks, i):
         # A nameless ``function (`` in statement position is not a valid
         # declaration unless it is ``export default function``.
         return None
+    # The decl's own type parameters are lexically in scope for its
+    # param/return annotations and render by name (checker semantics).
+    local = declared | set(tp_names) if tp_names else declared
     params_start = j
     params_end = _matching_paren(toks, params_start)
-    param_types = _parse_param_types(toks[params_start + 1 : params_end], declared)
+    param_types = _parse_param_types(toks[params_start + 1 : params_end], local)
     # Return type: ``: T`` after the parameter list, up to ``{`` or ``;``.
     k = params_end + 1
     ret_type = "any"
     if k < n and toks[k].text == ":":
         type_toks, k = _collect_type_tokens(toks, k + 1, stop={"{", ";"})
-        ret_type = _render_type(type_toks, declared)
+        ret_type = _render_type(type_toks, local)
     # Body or overload signature end.
     if k < n and toks[k].text == "{":
         end_idx = _matching_brace(toks, k)

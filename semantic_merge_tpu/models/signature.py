@@ -37,12 +37,24 @@ class EmbeddingSignatureMatcher:
 
     def __init__(self, threshold: float = 0.85, ckpt_dir: str | None = None,
                  seed: int = 0, seq_len: int = 64,
-                 max_candidates: int = 512) -> None:
+                 max_candidates: int = 512,
+                 allow_untrained: bool = False,
+                 cfg=None) -> None:
         self.threshold = threshold
+        #: Optional MatcherConfig override (default: the product
+        #: config) — must match the checkpoint's shapes.
+        self._cfg_override = cfg
         self.ckpt_dir = ckpt_dir
         self.seed = seed
         self.seq_len = seq_len
         self.max_candidates = max_candidates
+        #: Whether parameters came from a trained checkpoint. Scoring
+        #: with seeded-random parameters produces deterministic but
+        #: semantically arbitrary pairings, so the product path refuses
+        #: it (pair() falls back to exact-key-only) unless
+        #: ``allow_untrained`` opts in (tests, evaluation harnesses).
+        self.trained = False
+        self.allow_untrained = allow_untrained
         self._embed = None
         self._params = None
         self._cfg = None
@@ -60,7 +72,7 @@ class EmbeddingSignatureMatcher:
             logger.warning("signature matcher unavailable (%s); "
                            "falling back to exact-key pairing", exc)
             return False
-        cfg = MatcherConfig()
+        cfg = self._cfg_override or MatcherConfig()
         mesh = build_mesh()
         params = None
         if self.ckpt_dir:
@@ -76,11 +88,17 @@ class EmbeddingSignatureMatcher:
                         latest, args=ocp.args.StandardRestore(
                             {"params": p0, "opt_state": o0}))
                     params = restored["params"]
+                    self.trained = True
             except Exception as exc:
                 logger.warning("matcher checkpoint restore failed (%s); "
                                "using seeded init", exc)
         if params is None:
             params, _ = init_matcher(jax.random.PRNGKey(self.seed), cfg)
+        # Params must live replicated on the mesh the embed's shard_map
+        # runs over — a checkpoint restore (and some init paths) leaves
+        # them committed to device 0, which jit rejects.
+        params = jax.tree.map(
+            lambda leaf: jax.device_put(leaf, mesh.replicated()), params)
 
         import functools
 
@@ -121,6 +139,14 @@ class EmbeddingSignatureMatcher:
                            len(deletes), len(adds), self.max_candidates)
             return []
         if not self._ensure():
+            return []
+        if not self.trained and not self.allow_untrained:
+            logger.warning(
+                "signature matcher has NO trained checkpoint (ckpt_dir=%r): "
+                "refusing to score with seeded-random parameters; only "
+                "exact-key pairs will be used. Train one with "
+                "'semmerge train-matcher --ckpt-dir DIR' and set "
+                "[engine] matcher_ckpt_dir.", self.ckpt_dir)
             return []
         zd = self._embed_texts([t for _, t in deletes])
         za = self._embed_texts([t for _, t in adds])

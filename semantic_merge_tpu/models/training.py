@@ -127,6 +127,15 @@ def train_matcher(cfg: TrainConfig, mesh=None, *, resume: bool = True):
         if manager is not None and (step % cfg.ckpt_every == 0
                                     or step == cfg.steps):
             import orbax.checkpoint as ocp
+
+            # Drain in-flight step collectives first: orbax's async
+            # save issues its own device transfers, and on a
+            # multi-device host (virtual CPU mesh) two concurrent
+            # multi-participant XLA programs can deadlock each other's
+            # rendezvous (observed: ring-attention permute vs save-time
+            # all-gather, fatal after 40 s).
+            import jax
+            jax.block_until_ready((params, opt_state))
             manager.save(step, args=ocp.args.StandardSave(
                 {"params": params, "opt_state": opt_state}))
     if manager is not None:

@@ -157,6 +157,30 @@ def _apply_rename_symbol(root: pathlib.Path, op: Op) -> None:
     path.write_text(code, encoding="utf-8")
 
 
+def _apply_edit_stmt_block(root: pathlib.Path, op: Op) -> None:
+    """Splice an ``editStmtBlock``'s new body over its old one. The op
+    carries both texts (core.difflift.statement_edits), so the splice
+    is a single exact replacement — position-independent, surviving
+    earlier edits that shifted offsets. A missing old body (the other
+    side rewrote the decl some other way) degrades to a logged skip,
+    consistent with the reference applier's unknown-op posture."""
+    file_path = op.params.get("file")
+    old_body = op.params.get("oldBody")
+    new_body = op.params.get("newBody")
+    if not file_path or old_body is None or new_body is None:
+        return
+    path = root / _normalize_relpath(file_path)
+    if not path.exists():
+        logger.debug("editStmtBlock target missing: %s", path)
+        return
+    code = path.read_text(encoding="utf-8")
+    if str(old_body) not in code:
+        logger.debug("editStmtBlock old body not found in %s; skipping", path)
+        return
+    path.write_text(code.replace(str(old_body), str(new_body), 1),
+                    encoding="utf-8")
+
+
 def _apply_modify_import(root: pathlib.Path, op: Op) -> None:
     file_path = op.params.get("file")
     old_import = op.params.get("oldImport")
@@ -257,4 +281,5 @@ _HANDLERS = {
     "renameSymbol": _apply_rename_symbol,
     "modifyImport": _apply_modify_import,
     "reorderImports": _apply_reorder_imports,
+    "editStmtBlock": _apply_edit_stmt_block,
 }

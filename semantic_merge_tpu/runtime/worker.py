@@ -53,7 +53,8 @@ def _handle(backend, method: str, params: Dict[str, Any]) -> Dict[str, Any]:
             seed=params.get("seed", "0"),
             timestamp=params.get("timestamp"),
             change_signature=bool(params.get("changeSignature", False)),
-            structured_apply=bool(params.get("structuredApply", False)))
+            structured_apply=bool(params.get("structuredApply", False)),
+            statement_ops=bool(params.get("statementOps", False)))
         return {
             "opLogLeft": [op.to_dict() for op in result.op_log_left],
             "opLogRight": [op.to_dict() for op in result.op_log_right],
@@ -67,7 +68,8 @@ def _handle(backend, method: str, params: Dict[str, Any]) -> Dict[str, Any]:
             seed=params.get("seed", "0"),
             timestamp=params.get("timestamp"),
             change_signature=bool(params.get("changeSignature", False)),
-            structured_apply=bool(params.get("structuredApply", False)))
+            structured_apply=bool(params.get("structuredApply", False)),
+            statement_ops=bool(params.get("statementOps", False)))
         return {"opLog": [op.to_dict() for op in ops]}
     if method == "compose":
         from ..core.ops import Op

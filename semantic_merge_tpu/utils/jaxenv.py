@@ -37,6 +37,15 @@ def force_cpu(n_devices: int | None = None) -> None:
             os.environ["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count={n_devices}"
             ).strip()
+    # CPU runs must NOT use the persistent compilation cache: XLA:CPU's
+    # AOT reload of multi-replica (collective) executables aborts the
+    # process on a cache hit (observed round 5: fatal rendezvous
+    # deadlock / PThread abort re-loading a shard_map train step). The
+    # cache exists for real-TPU cold starts, where reload works. The
+    # marker env var makes the prohibition stick in CHILD processes
+    # whose own entry point calls enable_compile_cache (bench --cold).
+    os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    os.environ["SEMMERGE_NO_COMPILE_CACHE"] = "1"
     os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
@@ -60,6 +69,10 @@ def force_cpu(n_devices: int | None = None) -> None:
     import jax._src.xla_bridge as _xb
 
     jax.config.update("jax_platforms", "cpu")
+    try:  # live-config twin of the env-var pop above
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
     if n_devices is not None:
         try:
             jax.config.update("jax_num_cpu_devices", n_devices)
@@ -102,3 +115,40 @@ def accelerator_available(timeout: float = 120.0, retries: int = 1) -> str | Non
                         return plat
             return None  # initialised but CPU-only: no accelerator
     return None
+
+
+def compile_cache_dir() -> str:
+    """Machine-fingerprinted persistent-compile-cache path.
+
+    jaxlib's XLA:CPU AOT entries embed the *compile* machine's CPU
+    features; loading them on a host with fewer features is undefined
+    ("could lead to execution errors such as SIGILL", cpu_aot_loader) —
+    observed in round 5 as a fatal collective-rendezvous deadlock when
+    a cache written on an avx512vp2intersect machine was reused on a
+    lesser host. Keying the directory by a CPU-feature fingerprint
+    makes a machine change start a fresh cache instead of loading
+    poison."""
+    import hashlib
+    try:
+        with open("/proc/cpuinfo", "r", encoding="utf-8") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    fp = hashlib.sha256(line.encode()).hexdigest()[:12]
+                    break
+            else:
+                fp = "noflags"
+    except OSError:
+        import platform
+        fp = hashlib.sha256(platform.processor().encode()).hexdigest()[:12]
+    return f"/tmp/semmerge_jax_cache_{fp}"
+
+
+def enable_compile_cache() -> None:
+    """Default the persistent compilation cache to the per-machine path
+    (no-op if the caller already set JAX_COMPILATION_CACHE_DIR, or if a
+    CPU-pinned ancestor prohibited the cache via
+    SEMMERGE_NO_COMPILE_CACHE — see :func:`force_cpu`)."""
+    if os.environ.get("SEMMERGE_NO_COMPILE_CACHE") == "1":
+        return
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", compile_cache_dir())
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")

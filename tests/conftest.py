@@ -14,11 +14,10 @@ import pathlib
 
 # Persistent XLA compilation cache: device-kernel tests compile a handful
 # of padded shapes; caching makes repeat suite runs take seconds.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/semmerge_jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
-
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from semantic_merge_tpu.utils.jaxenv import force_cpu  # noqa: E402
+from semantic_merge_tpu.utils.jaxenv import enable_compile_cache, force_cpu  # noqa: E402
+
+enable_compile_cache()
 
 force_cpu(8)

@@ -273,8 +273,12 @@ def test_semmerge_incremental_matches_full_scan(repo):
     (repo / ".semmerge.toml").write_text(
         "[engine]\nincremental = false\n")
     rc = main(["semmerge", "basebr", "brA", "brB",
-               "--inplace", "--backend", "host"])
+               "--inplace", "--backend", "host", "--trace"])
     assert rc == 0
+    # The config switch must actually disable scoping: a full-tree run
+    # records no scope_files counter.
+    trace = json.loads((repo / ".semmerge-trace.json").read_text())
+    assert "scope_files" not in trace.get("counters", {})
     assert (notes("brA"), notes("brB")) == inc_notes
     full_tree = {p.relative_to(repo).as_posix(): p.read_text()
                  for p in sorted(repo.rglob("*.ts"))}

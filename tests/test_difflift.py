@@ -97,3 +97,66 @@ def test_lift_is_deterministic():
     assert [o.to_dict() for o in ops1] == [o.to_dict() for o in ops2]
     ops3 = lift("base", diff_nodes(base, side), seed="other")
     assert ops1[0].id != ops3[0].id
+
+
+def test_statement_edits_extraction():
+    """editStmtBlock ops for body-only changes; identity changes stay
+    with their rename/move ops (core.difflift.statement_edits)."""
+    from semantic_merge_tpu.core.difflift import statement_edits
+    from semantic_merge_tpu.frontend.scanner import scan_snapshot
+    base_files = [
+        {"path": "a.ts", "content": "export function f(n: number): number { return 1; }\n"},
+        {"path": "b.ts", "content": "export function g(s: string): string { return s; }\n"},
+    ]
+    side_files = [
+        {"path": "a.ts", "content": "export function f(n: number): number { return 2; }\n"},
+        {"path": "b.ts", "content": "export function g(s: string): string { return s; }\n"},
+    ]
+    base_nodes = scan_snapshot(base_files)
+    side_nodes = scan_snapshot(side_files)
+    base_map = {f["path"]: f["content"] for f in base_files}
+    side_map = {f["path"]: f["content"] for f in side_files}
+    ops = statement_edits(base_nodes, side_nodes, (base_map, side_map),
+                          base_rev="r", seed="s", start_idx=0)
+    assert [op.type for op in ops] == ["editStmtBlock"]
+    op = ops[0]
+    assert op.params["file"] == "a.ts"
+    assert "return 1" in op.params["oldBody"]
+    assert "return 2" in op.params["newBody"]
+    assert op.params["oldBodyHash"] != op.params["newBodyHash"]
+    # Deterministic ids: same inputs, same id.
+    again = statement_edits(base_nodes, side_nodes, (base_map, side_map),
+                            base_rev="r", seed="s", start_idx=0)
+    assert again[0].id == op.id
+    # A renamed decl's body change is NOT a statement edit (the rename
+    # op records the change).
+    renamed = [
+        {"path": "a.ts", "content": "export function h(n: number): number { return 2; }\n"},
+        side_files[1],
+    ]
+    ops2 = statement_edits(base_nodes, scan_snapshot(renamed),
+                           (base_map, {f["path"]: f["content"] for f in renamed}),
+                           base_rev="r", seed="s", start_idx=0)
+    assert ops2 == []
+
+
+def test_statement_edits_backend_parity():
+    """Host and TPU backends emit identical op logs with statement_ops
+    (the tpu path routes through the shared two-program lift)."""
+    import pytest
+    pytest.importorskip("jax")
+    from semantic_merge_tpu.backends.base import get_backend
+    from semantic_merge_tpu.frontend.snapshot import Snapshot
+    base = Snapshot(files=[
+        {"path": "a.ts", "content": "export function f(n: number): number { return 1; }\n"}])
+    left = Snapshot(files=[
+        {"path": "a.ts", "content": "export function f(n: number): number { return 10; }\n"}])
+    right = Snapshot(files=[
+        {"path": "a.ts", "content": "export function f(n: number): number { return 1; }\n"}])
+    kw = dict(base_rev="r", seed="s", timestamp="2026-01-01T00:00:00Z",
+              statement_ops=True)
+    rh = get_backend("host").build_and_diff(base, left, right, **kw)
+    rt = get_backend("tpu").build_and_diff(base, left, right, **kw)
+    assert [o.to_dict() for o in rh.op_log_left] == [o.to_dict() for o in rt.op_log_left]
+    assert [o.type for o in rh.op_log_left] == ["editStmtBlock"]
+    assert rh.op_log_right == []

@@ -57,6 +57,8 @@ def test_fixture_inventory():
     # The corpus must keep covering the tricky categories.
     names = {p.stem for p in FIXTURES}
     required = {
+        "overloads", "default_type_params", "decorators",
+        "declare_module", "triple_slash",
         "generics_function", "union_intersection", "inferred_return",
         "object_literal_types", "array_types", "unresolved_identifiers",
         "resolved_in_snapshot", "tsx_component", "nested_decls",

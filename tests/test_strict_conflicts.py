@@ -128,3 +128,119 @@ def test_config_rejects_bad_conflict_mode(tmp_path, monkeypatch):
     from semantic_merge_tpu.config import load_config
     with _pytest.raises(ValueError, match="conflict_mode"):
         load_config()
+
+
+def test_concurrent_stmt_edit_conflict():
+    a = [_op("editStmtBlock", "sym", {"file": "f.ts", "oldBodyHash": "h0",
+                                      "newBodyHash": "hA",
+                                      "oldBody": "x", "newBody": "yA"}, "a1")]
+    b = [_op("editStmtBlock", "sym", {"file": "f.ts", "oldBodyHash": "h0",
+                                      "newBodyHash": "hB",
+                                      "oldBody": "x", "newBody": "yB"}, "b1")]
+    kept_a, kept_b, conflicts = detect_conflicts_strict(a, b)
+    assert [c.category for c in conflicts] == ["ConcurrentStmtEdit"]
+    assert kept_a == [] and kept_b == []
+    # [CFR-003]: minimal slice carries the disputed body.
+    assert conflicts[0].to_dict()["minimalSlice"]["code"] == "x"
+
+
+def test_identical_stmt_edits_agree():
+    a = [_op("editStmtBlock", "sym", {"file": "f.ts", "oldBodyHash": "h0",
+                                      "newBodyHash": "hSame",
+                                      "oldBody": "x", "newBody": "y"}, "a1")]
+    b = [_op("editStmtBlock", "sym", {"file": "f.ts", "oldBodyHash": "h0",
+                                      "newBodyHash": "hSame",
+                                      "oldBody": "x", "newBody": "y"}, "b1")]
+    kept_a, kept_b, conflicts = detect_conflicts_strict(a, b)
+    assert conflicts == []
+    assert len(kept_a) == 1 and len(kept_b) == 1
+
+
+def test_delete_vs_stmt_edit():
+    a = [_op("deleteDecl", "sym", {"file": "f.ts"}, "a1")]
+    b = [_op("editStmtBlock", "sym", {"file": "f.ts", "oldBodyHash": "h0",
+                                      "newBodyHash": "hB",
+                                      "oldBody": "x", "newBody": "y"}, "b1")]
+    _, _, conflicts = detect_conflicts_strict(a, b)
+    assert [c.category for c in conflicts] == ["DeleteVsEdit"]
+
+
+def test_cli_concurrent_stmt_edit_end_to_end(tmp_path, monkeypatch):
+    """Strict mode implies statement-op extraction: divergent body
+    edits of one function conflict (ConcurrentStmtEdit), while parity
+    mode merges silently (body-only changes emit no ops there)."""
+    import json
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    (tmp_path / "a.ts").write_text(
+        "export function foo(n: number): number { return 0; }\n")
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "t@e")
+    git("config", "user.name", "t")
+    git("add", "-A")
+    git("commit", "-qm", "base")
+    git("branch", "basebr")
+    git("checkout", "-qb", "ba")
+    (tmp_path / "a.ts").write_text(
+        "export function foo(n: number): number { return 1; }\n")
+    git("commit", "-qam", "edit A")
+    git("checkout", "-q", "main")
+    git("checkout", "-qb", "bb")
+    (tmp_path / "a.ts").write_text(
+        "export function foo(n: number): number { return 2; }\n")
+    git("commit", "-qam", "edit B")
+    git("checkout", "-q", "main")
+
+    monkeypatch.chdir(tmp_path)
+    from semantic_merge_tpu.cli import main
+    rc = main(["semmerge", "basebr", "ba", "bb", "--backend", "host",
+               "--strict-conflicts"])
+    assert rc == 1
+    payload = json.loads((tmp_path / ".semmerge-conflicts.json").read_text())
+    assert any(c["category"] == "ConcurrentStmtEdit" for c in payload)
+
+
+def test_cli_stmt_edit_applies_to_merge(tmp_path, monkeypatch):
+    """A one-sided body edit lands in the merged tree via the
+    editStmtBlock applier handler (text fallback would also patch it;
+    disabling it proves the op path does the splice)."""
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    (tmp_path / "a.ts").write_text(
+        "export function foo(n: number): number { return 0; }\n")
+    (tmp_path / "b.ts").write_text(
+        "export function other(s: string): string { return s; }\n")
+    (tmp_path / ".semmerge.toml").write_text(
+        "[engine]\nstatement_ops = true\ntext_fallback = false\n")
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "t@e")
+    git("config", "user.name", "t")
+    git("add", "-A")
+    git("commit", "-qm", "base")
+    git("branch", "basebr")
+    git("checkout", "-qb", "ba")
+    (tmp_path / "a.ts").write_text(
+        "export function foo(n: number): number { return 42; }\n")
+    git("commit", "-qam", "edit A")
+    git("checkout", "-q", "main")
+    git("checkout", "-qb", "bb")
+    (tmp_path / "b.ts").write_text(
+        "export function other2(s: string): string { return s; }\n")
+    git("commit", "-qam", "rename B")
+    git("checkout", "-q", "main")
+
+    monkeypatch.chdir(tmp_path)
+    from semantic_merge_tpu.cli import main
+    rc = main(["semmerge", "basebr", "ba", "bb", "--backend", "host",
+               "--inplace"])
+    assert rc == 0
+    assert "return 42" in (tmp_path / "a.ts").read_text()
+    assert "other2" in (tmp_path / "b.ts").read_text()

@@ -1,0 +1,617 @@
+/* semmerge_opfactory — C op-object factory for the columnar op logs.
+ *
+ * The fused merge path keeps op logs as int32/digest columns
+ * (semantic_merge_tpu/ops/oplog_view.py); consumers that need real Op
+ * objects (the applier's handler dispatch, parity tests, the bench's
+ * honest composed-stream consumption) previously materialized them in
+ * Python at ~2 us/op — the largest host phase left after the native
+ * JSON serializer. This extension builds the same objects with the
+ * CPython C API: Op/Target instances via tp_new-free __new__ +
+ * slot SetAttr, params/guards/effects as presized dicts, field
+ * strings decoded from the cached node string tables
+ * (oplog_view._node_table layout: 4 UTF-8 fields per node, int64
+ * offsets).
+ *
+ * Two entry points:
+ *   stream_ops(kind, a_slot, b_slot, words, base_blob, base_offs,
+ *              side_blob, side_offs, prov, op_cls, target_cls) -> list[Op]
+ *   composed_ops(<left stream args...>, <right stream args...>,
+ *                sides, idxs, addr_ov, file_ov, name_ov,
+ *                prov_left, prov_right, op_cls, target_cls) -> list[Op]
+ * composed_ops applies the chain-override rules of
+ * oplog_view._materialize_decoded row-by-row, building each final
+ * composed op directly — the intermediate per-side stream objects are
+ * never created. Byte-for-byte to_dict parity with the Python
+ * materializers is fuzz-tested in tests/test_oplog_view.py.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+
+/* Interned field/key names, created at module init. */
+static PyObject *S_id, *S_schemaVersion, *S_type, *S_target, *S_params,
+    *S_guards, *S_effects, *S_provenance, *S_symbolId, *S_addressId,
+    *S_oldName, *S_newName, *S_file, *S_oldAddress, *S_newAddress,
+    *S_oldFile, *S_newFile, *S_exists, *S_addressMatch, *S_summary,
+    *S_renameContext;
+static PyObject *T_renameSymbol, *T_moveDecl, *T_addDecl, *T_deleteDecl;
+static PyObject *SUM_add, *SUM_del, *ARROW, *SUM_ren_prefix, *SUM_mov_prefix;
+static PyObject *ONE;
+
+typedef struct {
+  const char *blob;
+  Py_ssize_t blob_len;
+  const int64_t *offs;
+} NodeTab;
+
+typedef struct {
+  const int32_t *kind, *a_slot, *b_slot;
+  const int32_t *words; /* n*4 */
+  NodeTab bt, st;
+} Stream;
+
+/* Slot descriptors fetched once per entry call: setting through
+ * tp_descr_set skips the generic attribute machinery, and tp_alloc
+ * skips the __new__ Python call — together ~3x on object build. */
+typedef struct {
+  PyTypeObject *op_t, *tgt_t;
+  PyObject *op_d[8];  /* id, schemaVersion, type, target, params,
+                         guards, effects, provenance */
+  PyObject *tgt_d[2]; /* symbolId, addressId */
+  int ok;
+} Factory;
+
+static int dset(PyObject *descr, PyObject *obj, PyObject *val) {
+  /* factory_init guarantees tp_descr_set exists for every descriptor */
+  return Py_TYPE(descr)->tp_descr_set(descr, obj, val);
+}
+
+static int factory_init(Factory *f, PyObject *op_cls, PyObject *target_cls) {
+  memset(f, 0, sizeof(*f));
+  if (!PyType_Check(op_cls) || !PyType_Check(target_cls)) {
+    PyErr_SetString(PyExc_TypeError, "op_cls/target_cls must be types");
+    return -1;
+  }
+  f->op_t = (PyTypeObject *)op_cls;
+  f->tgt_t = (PyTypeObject *)target_cls;
+  PyObject *names[8] = {S_id, S_schemaVersion, S_type, S_target, S_params,
+                        S_guards, S_effects, S_provenance};
+  for (int i = 0; i < 8; i++) {
+    f->op_d[i] = PyObject_GetAttr(op_cls, names[i]);
+    if (!f->op_d[i] || !Py_TYPE(f->op_d[i])->tp_descr_set) {
+      PyErr_SetString(PyExc_TypeError, "op_cls lacks slot descriptors");
+      return -1;
+    }
+  }
+  PyObject *tnames[2] = {S_symbolId, S_addressId};
+  for (int i = 0; i < 2; i++) {
+    f->tgt_d[i] = PyObject_GetAttr(target_cls, tnames[i]);
+    if (!f->tgt_d[i] || !Py_TYPE(f->tgt_d[i])->tp_descr_set) {
+      PyErr_SetString(PyExc_TypeError, "target_cls lacks slot descriptors");
+      return -1;
+    }
+  }
+  f->ok = 1;
+  return 0;
+}
+
+static void factory_clear(Factory *f) {
+  for (int i = 0; i < 8; i++) Py_XDECREF(f->op_d[i]);
+  for (int i = 0; i < 2; i++) Py_XDECREF(f->tgt_d[i]);
+}
+
+/* Decode field f (0 sym, 1 addr, 2 name, 3 file) of node as str. */
+static PyObject *field(const NodeTab *t, int64_t node, int f) {
+  int64_t a = t->offs[node * 4 + f], b = t->offs[node * 4 + f + 1];
+  if (a < 0 || b < a || b > t->blob_len) {
+    PyErr_SetString(PyExc_ValueError, "node table offset out of range");
+    return NULL;
+  }
+  return PyUnicode_DecodeUTF8(t->blob + a, b - a, "strict");
+}
+
+static const char HEXD[] = "0123456789abcdef";
+
+static PyObject *uuid_str(const int32_t *w4) {
+  char buf[36];
+  char hex[32];
+  for (int k = 0; k < 4; k++) {
+    uint32_t v = (uint32_t)w4[k];
+    for (int j = 7; j >= 0; j--) {
+      hex[k * 8 + j] = HEXD[v & 0xF];
+      v >>= 4;
+    }
+  }
+  int p = 0;
+  for (int i = 0; i < 32; i++) {
+    if (i == 8 || i == 12 || i == 16 || i == 20) buf[p++] = '-';
+    buf[p++] = hex[i];
+  }
+  return PyUnicode_FromStringAndSize(buf, 36);
+}
+
+static PyObject *make_target(const Factory *f, PyObject *sym,
+                             PyObject *addr) {
+  PyObject *t = f->tgt_t->tp_alloc(f->tgt_t, 0);
+  if (!t) return NULL;
+  if (dset(f->tgt_d[0], t, sym) < 0 || dset(f->tgt_d[1], t, addr) < 0) {
+    Py_DECREF(t);
+    return NULL;
+  }
+  return t;
+}
+
+/* Assemble one Op. Steals NO references; all borrowed/owned by caller.
+ * effects/guards/params are owned dict refs passed in (steals them). */
+static PyObject *make_op(const Factory *f, PyObject *op_id, PyObject *type,
+                         PyObject *target /* stolen */,
+                         PyObject *params /* stolen */,
+                         PyObject *guards /* stolen */,
+                         PyObject *effects /* stolen */, PyObject *prov) {
+  PyObject *op = f->op_t->tp_alloc(f->op_t, 0);
+  if (!op) goto fail;
+  if (dset(f->op_d[0], op, op_id) < 0) goto fail_op;
+  if (dset(f->op_d[1], op, ONE) < 0) goto fail_op;
+  if (dset(f->op_d[2], op, type) < 0) goto fail_op;
+  if (dset(f->op_d[3], op, target) < 0) goto fail_op;
+  if (dset(f->op_d[4], op, params) < 0) goto fail_op;
+  if (dset(f->op_d[5], op, guards) < 0) goto fail_op;
+  if (dset(f->op_d[6], op, effects) < 0) goto fail_op;
+  if (dset(f->op_d[7], op, prov) < 0) goto fail_op;
+  Py_DECREF(target);
+  Py_DECREF(params);
+  Py_DECREF(guards);
+  Py_DECREF(effects);
+  return op;
+fail_op:
+  Py_DECREF(op);
+fail:
+  Py_XDECREF(target);
+  Py_XDECREF(params);
+  Py_XDECREF(guards);
+  Py_XDECREF(effects);
+  return NULL;
+}
+
+static PyObject *guards_for(PyObject *addr) {
+  PyObject *g = PyDict_New();
+  if (!g) return NULL;
+  if (PyDict_SetItem(g, S_exists, Py_True) < 0 ||
+      PyDict_SetItem(g, S_addressMatch, addr) < 0) {
+    Py_DECREF(g);
+    return NULL;
+  }
+  return g;
+}
+
+static PyObject *summary3(PyObject *prefix, PyObject *a, PyObject *b) {
+  /* prefix + a + ARROW + b */
+  PyObject *s1 = PyUnicode_Concat(prefix, a);
+  if (!s1) return NULL;
+  PyObject *s2 = PyUnicode_Concat(s1, ARROW);
+  Py_DECREF(s1);
+  if (!s2) return NULL;
+  PyObject *s3 = PyUnicode_Concat(s2, b);
+  Py_DECREF(s2);
+  return s3;
+}
+
+static PyObject *effects_summary(PyObject *summary /* stolen */) {
+  if (!summary) return NULL;
+  PyObject *e = PyDict_New();
+  if (!e) {
+    Py_DECREF(summary);
+    return NULL;
+  }
+  if (PyDict_SetItem(e, S_summary, summary) < 0) {
+    Py_DECREF(summary);
+    Py_DECREF(e);
+    return NULL;
+  }
+  Py_DECREF(summary);
+  return e;
+}
+
+/* Build op i of a stream, applying composed-row overrides when
+ * addr_ov/file_ov/name_ov are non-NULL (borrowed, may be Py_None).
+ * Override semantics mirror oplog_view._materialize_decoded exactly,
+ * except ops are always built fresh (value-identical). */
+static PyObject *build_op(const Stream *s, Py_ssize_t i, PyObject *prov,
+                          const Factory *f, PyObject *addr_ov,
+                          PyObject *file_ov, PyObject *name_ov) {
+  int k = s->kind[i];
+  PyObject *op_id = uuid_str(s->words + 4 * i);
+  if (!op_id) return NULL;
+  PyObject *result = NULL;
+  int has_addr = addr_ov && addr_ov != Py_None;
+  int has_file = file_ov && file_ov != Py_None;
+  int has_name = name_ov && name_ov != Py_None;
+
+  if (k == 0 || k == 1) { /* renameSymbol / moveDecl */
+    int64_t an = s->a_slot[i], bn = s->b_slot[i];
+    PyObject *a_sym = field(&s->bt, an, 0), *a_addr = field(&s->bt, an, 1);
+    if (!a_sym || !a_addr) {
+      Py_XDECREF(a_sym);
+      Py_XDECREF(a_addr);
+      goto done;
+    }
+    PyObject *t_addr = has_addr ? addr_ov : a_addr;
+    PyObject *target = make_target(f, a_sym, t_addr);
+    PyObject *guards = guards_for(a_addr);
+    if (!target || !guards) {
+      Py_XDECREF(target);
+      Py_XDECREF(guards);
+      Py_DECREF(a_sym);
+      Py_DECREF(a_addr);
+      goto done;
+    }
+    if (k == 0) { /* renameSymbol */
+      PyObject *a_name = field(&s->bt, an, 2), *b_name = field(&s->st, bn, 2),
+               *b_file = field(&s->st, bn, 3);
+      if (!a_name || !b_name || !b_file) {
+        Py_XDECREF(a_name);
+        Py_XDECREF(b_name);
+        Py_XDECREF(b_file);
+        Py_DECREF(target);
+        Py_DECREF(guards);
+        Py_DECREF(a_sym);
+        Py_DECREF(a_addr);
+        goto done;
+      }
+      PyObject *params = PyDict_New();
+      int ok = params && PyDict_SetItem(params, S_oldName, a_name) == 0 &&
+               PyDict_SetItem(params, S_newName, b_name) == 0 &&
+               PyDict_SetItem(params, S_file,
+                              has_file ? file_ov : b_file) == 0;
+      if (ok && has_file) /* rename + chained file: newFile then file */
+        ok = PyDict_SetItem(params, S_newFile, file_ov) == 0;
+      /* NOTE: _materialize_decoded sets newFile THEN overwrites file;
+       * insertion order is oldName,newName,file,newFile — file was
+       * already inserted above, so order matches. renameContext never
+       * applies to renameSymbol. */
+      PyObject *effects =
+          ok ? effects_summary(summary3(SUM_ren_prefix, a_name, b_name))
+             : NULL;
+      Py_DECREF(a_name);
+      Py_DECREF(b_name);
+      Py_DECREF(b_file);
+      Py_DECREF(a_sym);
+      Py_DECREF(a_addr);
+      if (!ok || !effects) {
+        Py_XDECREF(params);
+        Py_XDECREF(effects);
+        Py_DECREF(target);
+        Py_DECREF(guards);
+        goto done;
+      }
+      result = make_op(f, op_id, T_renameSymbol, target, params, guards,
+                       effects, prov);
+    } else { /* moveDecl */
+      PyObject *b_addr = field(&s->st, bn, 1), *a_file = field(&s->bt, an, 3),
+               *b_file = field(&s->st, bn, 3);
+      if (!b_addr || !a_file || !b_file) {
+        Py_XDECREF(b_addr);
+        Py_XDECREF(a_file);
+        Py_XDECREF(b_file);
+        Py_DECREF(target);
+        Py_DECREF(guards);
+        Py_DECREF(a_sym);
+        Py_DECREF(a_addr);
+        goto done;
+      }
+      PyObject *params = PyDict_New();
+      int ok = params && PyDict_SetItem(params, S_oldAddress, a_addr) == 0 &&
+               PyDict_SetItem(params, S_newAddress,
+                              has_addr ? addr_ov : b_addr) == 0 &&
+               PyDict_SetItem(params, S_oldFile, a_file) == 0 &&
+               PyDict_SetItem(params, S_newFile,
+                              has_file ? file_ov : b_file) == 0;
+      if (ok && has_name)
+        ok = PyDict_SetItem(params, S_renameContext, name_ov) == 0;
+      PyObject *effects =
+          ok ? effects_summary(summary3(SUM_mov_prefix, a_addr, b_addr))
+             : NULL;
+      Py_DECREF(b_addr);
+      Py_DECREF(a_file);
+      Py_DECREF(b_file);
+      Py_DECREF(a_sym);
+      Py_DECREF(a_addr);
+      if (!ok || !effects) {
+        Py_XDECREF(params);
+        Py_XDECREF(effects);
+        Py_DECREF(target);
+        Py_DECREF(guards);
+        goto done;
+      }
+      result = make_op(f, op_id, T_moveDecl, target, params, guards,
+                       effects, prov);
+    }
+  } else { /* addDecl (2) / deleteDecl (3) */
+    const NodeTab *tab = (k == 2) ? &s->st : &s->bt;
+    int64_t node = (k == 2) ? s->b_slot[i] : s->a_slot[i];
+    PyObject *sym = field(tab, node, 0), *addr = field(tab, node, 1),
+             *fil = field(tab, node, 3);
+    if (!sym || !addr || !fil) {
+      Py_XDECREF(sym);
+      Py_XDECREF(addr);
+      Py_XDECREF(fil);
+      goto done;
+    }
+    PyObject *t_addr = has_addr ? addr_ov : addr;
+    PyObject *target = make_target(f, sym, t_addr);
+    PyObject *params = PyDict_New();
+    int ok = target && params && PyDict_SetItem(params, S_file, fil) == 0;
+    if (ok && has_name)
+      ok = PyDict_SetItem(params, S_renameContext, name_ov) == 0;
+    PyObject *guards = ok ? PyDict_New() : NULL;
+    PyObject *effects = NULL;
+    if (ok && guards) {
+      PyObject *sum = (k == 2) ? SUM_add : SUM_del;
+      Py_INCREF(sum);
+      effects = effects_summary(sum);
+    }
+    Py_DECREF(sym);
+    Py_DECREF(addr);
+    Py_DECREF(fil);
+    if (!ok || !guards || !effects) {
+      Py_XDECREF(target);
+      Py_XDECREF(params);
+      Py_XDECREF(guards);
+      Py_XDECREF(effects);
+      goto done;
+    }
+    result = make_op(f, op_id, (k == 2) ? T_addDecl : T_deleteDecl,
+                     target, params, guards, effects, prov);
+  }
+done:
+  Py_DECREF(op_id);
+  return result;
+}
+
+/* ---- argument plumbing ---- */
+
+typedef struct {
+  Py_buffer kind, a_slot, b_slot, words, b_offs, s_offs;
+  Py_buffer b_blob, s_blob;
+  Stream s;
+  Py_ssize_t n;
+  int held;
+} StreamArgs;
+
+static int get_stream(PyObject *args, Py_ssize_t off, StreamArgs *sa) {
+  PyObject *kind = PyTuple_GET_ITEM(args, off);
+  PyObject *a_slot = PyTuple_GET_ITEM(args, off + 1);
+  PyObject *b_slot = PyTuple_GET_ITEM(args, off + 2);
+  PyObject *words = PyTuple_GET_ITEM(args, off + 3);
+  PyObject *b_blob = PyTuple_GET_ITEM(args, off + 4);
+  PyObject *b_offs = PyTuple_GET_ITEM(args, off + 5);
+  PyObject *s_blob = PyTuple_GET_ITEM(args, off + 6);
+  PyObject *s_offs = PyTuple_GET_ITEM(args, off + 7);
+  memset(sa, 0, sizeof(*sa));
+  if (PyObject_GetBuffer(kind, &sa->kind, PyBUF_C_CONTIGUOUS) < 0) return -1;
+  if (PyObject_GetBuffer(a_slot, &sa->a_slot, PyBUF_C_CONTIGUOUS) < 0) goto f1;
+  if (PyObject_GetBuffer(b_slot, &sa->b_slot, PyBUF_C_CONTIGUOUS) < 0) goto f2;
+  if (PyObject_GetBuffer(words, &sa->words, PyBUF_C_CONTIGUOUS) < 0) goto f3;
+  if (PyObject_GetBuffer(b_blob, &sa->b_blob, PyBUF_C_CONTIGUOUS) < 0) goto f4;
+  if (PyObject_GetBuffer(b_offs, &sa->b_offs, PyBUF_C_CONTIGUOUS) < 0) goto f5;
+  if (PyObject_GetBuffer(s_blob, &sa->s_blob, PyBUF_C_CONTIGUOUS) < 0) goto f6;
+  if (PyObject_GetBuffer(s_offs, &sa->s_offs, PyBUF_C_CONTIGUOUS) < 0) goto f7;
+  sa->n = sa->kind.len / 4;
+  if (sa->a_slot.len != sa->kind.len || sa->b_slot.len != sa->kind.len ||
+      sa->words.len != sa->kind.len * 4) {
+    PyErr_SetString(PyExc_ValueError, "column length mismatch");
+    goto f8;
+  }
+  sa->s.kind = (const int32_t *)sa->kind.buf;
+  sa->s.a_slot = (const int32_t *)sa->a_slot.buf;
+  sa->s.b_slot = (const int32_t *)sa->b_slot.buf;
+  sa->s.words = (const int32_t *)sa->words.buf;
+  sa->s.bt.blob = (const char *)sa->b_blob.buf;
+  sa->s.bt.blob_len = sa->b_blob.len;
+  sa->s.bt.offs = (const int64_t *)sa->b_offs.buf;
+  sa->s.st.blob = (const char *)sa->s_blob.buf;
+  sa->s.st.blob_len = sa->s_blob.len;
+  sa->s.st.offs = (const int64_t *)sa->s_offs.buf;
+  sa->held = 1;
+  return 0;
+f8:
+  PyBuffer_Release(&sa->s_offs);
+f7:
+  PyBuffer_Release(&sa->s_blob);
+f6:
+  PyBuffer_Release(&sa->b_offs);
+f5:
+  PyBuffer_Release(&sa->b_blob);
+f4:
+  PyBuffer_Release(&sa->words);
+f3:
+  PyBuffer_Release(&sa->b_slot);
+f2:
+  PyBuffer_Release(&sa->a_slot);
+f1:
+  PyBuffer_Release(&sa->kind);
+  return -1;
+}
+
+static void release_stream(StreamArgs *sa) {
+  if (!sa->held) return;
+  PyBuffer_Release(&sa->kind);
+  PyBuffer_Release(&sa->a_slot);
+  PyBuffer_Release(&sa->b_slot);
+  PyBuffer_Release(&sa->words);
+  PyBuffer_Release(&sa->b_blob);
+  PyBuffer_Release(&sa->b_offs);
+  PyBuffer_Release(&sa->s_blob);
+  PyBuffer_Release(&sa->s_offs);
+  sa->held = 0;
+}
+
+static PyObject *py_stream_ops(PyObject *self, PyObject *args) {
+  (void)self;
+  if (PyTuple_GET_SIZE(args) != 11) {
+    PyErr_SetString(PyExc_TypeError, "stream_ops expects 11 args");
+    return NULL;
+  }
+  StreamArgs sa;
+  if (get_stream(args, 0, &sa) < 0) return NULL;
+  PyObject *prov = PyTuple_GET_ITEM(args, 8);
+  Factory fac;
+  if (factory_init(&fac, PyTuple_GET_ITEM(args, 9),
+                   PyTuple_GET_ITEM(args, 10)) < 0) {
+    factory_clear(&fac);
+    release_stream(&sa);
+    return NULL;
+  }
+  PyObject *out = PyList_New(sa.n);
+  if (!out) {
+    factory_clear(&fac);
+    release_stream(&sa);
+    return NULL;
+  }
+  for (Py_ssize_t i = 0; i < sa.n; i++) {
+    PyObject *op = build_op(&sa.s, i, prov, &fac, NULL, NULL, NULL);
+    if (!op) {
+      Py_DECREF(out);
+      factory_clear(&fac);
+      release_stream(&sa);
+      return NULL;
+    }
+    PyList_SET_ITEM(out, i, op);
+  }
+  factory_clear(&fac);
+  release_stream(&sa);
+  return out;
+}
+
+static PyObject *py_composed_ops(PyObject *self, PyObject *args) {
+  (void)self;
+  if (PyTuple_GET_SIZE(args) != 25) {
+    PyErr_SetString(PyExc_TypeError, "composed_ops expects 25 args");
+    return NULL;
+  }
+  StreamArgs left, right;
+  if (get_stream(args, 0, &left) < 0) return NULL;
+  if (get_stream(args, 8, &right) < 0) {
+    release_stream(&left);
+    return NULL;
+  }
+  PyObject *sides = PyTuple_GET_ITEM(args, 16);
+  PyObject *idxs = PyTuple_GET_ITEM(args, 17);
+  PyObject *addr_ov = PyTuple_GET_ITEM(args, 18);
+  PyObject *file_ov = PyTuple_GET_ITEM(args, 19);
+  PyObject *name_ov = PyTuple_GET_ITEM(args, 20);
+  PyObject *prov_l = PyTuple_GET_ITEM(args, 21);
+  PyObject *prov_r = PyTuple_GET_ITEM(args, 22);
+  Factory fac;
+  int fac_ok = factory_init(&fac, PyTuple_GET_ITEM(args, 23),
+                            PyTuple_GET_ITEM(args, 24)) == 0;
+  PyObject *out = NULL;
+  if (!fac_ok) {
+    factory_clear(&fac);
+    release_stream(&left);
+    release_stream(&right);
+    return NULL;
+  }
+  Py_buffer sides_b = {0}, idxs_b = {0};
+  if (PyObject_GetBuffer(sides, &sides_b, PyBUF_C_CONTIGUOUS) < 0) goto done0;
+  if (PyObject_GetBuffer(idxs, &idxs_b, PyBUF_C_CONTIGUOUS) < 0) goto done1;
+  {
+    Py_ssize_t n = sides_b.len / 4;
+    const int32_t *sd = (const int32_t *)sides_b.buf;
+    const int32_t *ix = (const int32_t *)idxs_b.buf;
+    if (idxs_b.len != sides_b.len ||
+        !PyList_Check(addr_ov) || !PyList_Check(file_ov) ||
+        !PyList_Check(name_ov) || PyList_GET_SIZE(addr_ov) != n ||
+        PyList_GET_SIZE(file_ov) != n || PyList_GET_SIZE(name_ov) != n) {
+      PyErr_SetString(PyExc_ValueError, "composed row arrays mismatch");
+      goto done2;
+    }
+    out = PyList_New(n);
+    if (!out) goto done2;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      const Stream *s = (sd[i] == 0) ? &left.s : &right.s;
+      Py_ssize_t row = ix[i];
+      Py_ssize_t limit = (sd[i] == 0) ? left.n : right.n;
+      if (row < 0 || row >= limit) {
+        PyErr_SetString(PyExc_IndexError, "composed ref out of range");
+        Py_CLEAR(out);
+        goto done2;
+      }
+      PyObject *op = build_op(
+          s, row, (sd[i] == 0) ? prov_l : prov_r, &fac,
+          PyList_GET_ITEM(addr_ov, i), PyList_GET_ITEM(file_ov, i),
+          PyList_GET_ITEM(name_ov, i));
+      if (!op) {
+        Py_CLEAR(out);
+        goto done2;
+      }
+      PyList_SET_ITEM(out, i, op);
+    }
+  }
+done2:
+  PyBuffer_Release(&idxs_b);
+done1:
+  PyBuffer_Release(&sides_b);
+done0:
+  factory_clear(&fac);
+  release_stream(&left);
+  release_stream(&right);
+  return out;
+}
+
+static PyMethodDef Methods[] = {
+    {"stream_ops", py_stream_ops, METH_VARARGS,
+     "Build one op stream's Op objects from its columns."},
+    {"composed_ops", py_composed_ops, METH_VARARGS,
+     "Build the composed Op sequence from two streams' columns + "
+     "per-row chain overrides."},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,
+                                       "semmerge_opfactory",
+                                       NULL,
+                                       -1,
+                                       Methods,
+                                       NULL,
+                                       NULL,
+                                       NULL,
+                                       NULL};
+
+static PyObject *intern(const char *s) { return PyUnicode_InternFromString(s); }
+
+PyMODINIT_FUNC PyInit_semmerge_opfactory(void) {
+  PyObject *m = PyModule_Create(&moduledef);
+  if (!m) return NULL;
+  S_id = intern("id");
+  S_schemaVersion = intern("schemaVersion");
+  S_type = intern("type");
+  S_target = intern("target");
+  S_params = intern("params");
+  S_guards = intern("guards");
+  S_effects = intern("effects");
+  S_provenance = intern("provenance");
+  S_symbolId = intern("symbolId");
+  S_addressId = intern("addressId");
+  S_oldName = intern("oldName");
+  S_newName = intern("newName");
+  S_file = intern("file");
+  S_oldAddress = intern("oldAddress");
+  S_newAddress = intern("newAddress");
+  S_oldFile = intern("oldFile");
+  S_newFile = intern("newFile");
+  S_exists = intern("exists");
+  S_addressMatch = intern("addressMatch");
+  S_summary = intern("summary");
+  S_renameContext = intern("renameContext");
+  T_renameSymbol = intern("renameSymbol");
+  T_moveDecl = intern("moveDecl");
+  T_addDecl = intern("addDecl");
+  T_deleteDecl = intern("deleteDecl");
+  SUM_add = PyUnicode_FromString("add decl");
+  SUM_del = PyUnicode_FromString("delete decl");
+  SUM_ren_prefix = PyUnicode_FromString("rename ");
+  SUM_mov_prefix = PyUnicode_FromString("move ");
+  ARROW = PyUnicode_FromString("\xe2\x86\x92");
+  ONE = PyLong_FromLong(1);
+  return m;
+}

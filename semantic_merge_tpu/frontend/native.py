@@ -242,3 +242,64 @@ def try_oplog_json(n: int, kind, a_slot, b_slot, words,
         return ctypes.string_at(ptr, out_len.value).decode("utf-8")
     finally:
         lib.smn_free(ptr)
+
+
+_OPFACTORY_PATH = _NATIVE_DIR / "semmerge_opfactory.so"
+_opfactory = None
+_opfactory_attempted = False
+
+
+def load_opfactory():
+    """The C op-object factory extension (``native/opfactory.c``), or
+    ``None`` when unavailable (SEMMERGE_NATIVE=0, no compiler, load
+    failure). Built on demand like the scanner library."""
+    global _opfactory, _opfactory_attempted
+    if _opfactory is not None or _opfactory_attempted:
+        return _opfactory
+    _opfactory_attempted = True
+    if _mode() == "0":
+        return None
+    src = _NATIVE_DIR / "opfactory.c"
+    stale = (_OPFACTORY_PATH.exists() and src.exists()
+             and src.stat().st_mtime > _OPFACTORY_PATH.stat().st_mtime)
+    if not _OPFACTORY_PATH.exists() or stale:
+        if not src.exists():
+            if _mode() == "1":
+                raise RuntimeError(
+                    f"SEMMERGE_NATIVE=1 but {src} is missing")
+            return None
+        import sysconfig
+        try:
+            proc = subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR),
+                 # Build against the RUNNING interpreter's headers, not
+                 # whatever python3 is first on make's PATH.
+                 f"PY_INC={sysconfig.get_paths()['include']}",
+                 "semmerge_opfactory.so"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                timeout=300)
+        except (OSError, subprocess.TimeoutExpired) as exc:
+            if _mode() == "1":
+                raise RuntimeError(f"SEMMERGE_NATIVE=1 but the opfactory "
+                                   f"build could not run: {exc}") from exc
+            logger.debug("opfactory build unavailable: %s", exc)
+            return None
+        if proc.returncode != 0:
+            if _mode() == "1":
+                raise RuntimeError("SEMMERGE_NATIVE=1 but the opfactory "
+                                   "build failed:\n" + proc.stdout[-2000:])
+            logger.warning("opfactory build failed:\n%s", proc.stdout[-2000:])
+            return None
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "semmerge_opfactory", str(_OPFACTORY_PATH))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception as exc:
+        if _mode() == "1":
+            raise
+        logger.warning("opfactory load failed: %s", exc)
+        return None
+    _opfactory = mod
+    return _opfactory

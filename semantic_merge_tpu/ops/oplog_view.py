@@ -201,12 +201,33 @@ class OpStreamView(Sequence):
             op = self._ops[i] = self._build_one(i)
         return op
 
+    def _c_stream_args(self):
+        """(columns..., tables...) tuple prefix shared by the C factory
+        entry points. Empty streams are the CALLER'S guard (len > 0
+        checks) — this always returns the tuple."""
+        base_tbl = _get_table(self.base_tbl_ref, self.base_nodes)
+        side_tbl = _get_table(self.side_tbl_ref, self.side_nodes)
+        return (np.ascontiguousarray(self.kind, np.int32),
+                np.ascontiguousarray(self.a_slot, np.int32),
+                np.ascontiguousarray(self.b_slot, np.int32),
+                np.ascontiguousarray(self.words, np.int32),
+                base_tbl[0], base_tbl[1], side_tbl[0], side_tbl[1])
+
     def materialize(self) -> List[Op]:
-        """Every op as an object, built with per-kind tight loops (the
-        cost profile of the old eager path, paid only when a consumer
-        actually iterates)."""
+        """Every op as an object — via the C factory
+        (``native/opfactory.c``) when available, else per-kind Python
+        loops. Paid only when a consumer actually iterates."""
         if self._all_done:
             return self._ops  # type: ignore[return-value]
+        if self._ops is None and len(self) > 0:
+            from ..frontend.native import load_opfactory
+            fac = load_opfactory()
+            if fac is not None:
+                ops = fac.stream_ops(*self._c_stream_args(), self.prov,
+                                     Op, Target)
+                self._ops = ops
+                self._all_done = True
+                return ops
         ids = self.ids()
         n = len(self)
         ops: List[Optional[Op]] = self._ops if self._ops is not None else [None] * n
@@ -420,6 +441,23 @@ class ComposedOpView(Sequence):
 
     def materialize(self) -> List[Op]:
         if self._all is None:
+            if len(self) > 0:
+                from ..frontend.native import load_opfactory
+                fac = load_opfactory()
+                if fac is not None:
+                    # One C pass builds every final composed op straight
+                    # from the two streams' columns + per-row overrides;
+                    # the intermediate stream objects never materialize.
+                    # (Ops are value-identical to the Python path but
+                    # always fresh — no sharing with the stream views.)
+                    self._all = fac.composed_ops(
+                        *self.left._c_stream_args(),
+                        *self.right._c_stream_args(),
+                        np.asarray(self.sides, np.int32),
+                        np.asarray(self.idxs, np.int32),
+                        self.addr_s, self.file_s, self.name_s,
+                        self.left.prov, self.right.prov, Op, Target)
+                    return self._all
             ops_l = self.left.materialize()
             ops_r = self.right.materialize()
             self._all = [

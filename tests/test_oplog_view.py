@@ -104,13 +104,14 @@ def test_composed_view_applies_overrides():
     name_s = [None, None, None, "nn", None, None, None, None]
     comp = ComposedOpView(sides, idxs, addr_s, file_s, name_s, view, view)
     from semantic_merge_tpu.ops.oplog_view import _materialize_decoded
+    # Lazy single-row access before bulk materialization: rows without
+    # overrides share the stream op (no clone).
+    assert comp[5] is view[5]
     expect = [_materialize_decoded(view[i], addr_s[i], file_s[i], name_s[i])
               for i in range(n)]
-    got = list(comp)
+    got = list(comp)  # bulk path (C factory when available)
     assert [o.to_dict() for o in got] == [o.to_dict() for o in expect]
     assert comp[1].to_dict() == expect[1].to_dict()
-    # Rows without overrides share the stream op (no clone).
-    assert comp[5] is view[5]
 
 
 def _rand_sorted_streams(rng: random.Random, n: int):
@@ -178,3 +179,52 @@ def test_native_serializer_byte_parity():
         assert got == expect
     empty = _random_view(0)
     assert empty.to_json() == "[]"
+
+
+def test_c_op_factory_matches_python_materializers():
+    """The C op factory (native/opfactory.c) must build value-identical
+    Op objects: stream ops vs the Python per-kind builders, and
+    composed ops vs the _materialize_decoded override path — across
+    nasty strings and random override patterns."""
+    from semantic_merge_tpu.frontend.native import load_opfactory
+    if load_opfactory() is None:
+        pytest.skip("op factory unavailable")
+    from semantic_merge_tpu.ops.oplog_view import _materialize_decoded
+    rng = random.Random(17)
+    for seed in range(4):
+        view = _random_view(56, seed=seed)
+        expect = [view._build_one(i).to_dict() for i in range(len(view))]
+        got = [op.to_dict() for op in _random_view(56, seed=seed).materialize()]
+        assert got == expect
+        # Composed: random refs + overrides over two distinct streams.
+        left = _random_view(40, seed=seed)
+        right = _random_view(40, seed=seed + 100)
+        n = 64
+        sides = [rng.randrange(2) for _ in range(n)]
+        idxs = [rng.randrange(40) for _ in range(n)]
+        def ov():
+            return [rng.choice([None, None, 'x "q"', 'π→', '']) for _ in range(n)]
+        addr_s, file_s, name_s = ov(), ov(), ov()
+        comp = ComposedOpView(sides, idxs, addr_s, file_s, name_s, left, right)
+        want = [_materialize_decoded(
+                    (left if s == 0 else right)._build_one(i), a, f, nm).to_dict()
+                for s, i, a, f, nm in zip(sides, idxs, addr_s, file_s, name_s)]
+        assert [op.to_dict() for op in comp.materialize()] == want
+
+
+def test_c_composed_ops_respect_per_side_provenance():
+    """Composed rows must carry their own stream's provenance — the C
+    path takes both prov dicts and selects by side."""
+    from semantic_merge_tpu.frontend.native import load_opfactory
+    left = _random_view(6, seed=1)
+    right = _random_view(6, seed=2)
+    right.prov = {"rev": "OTHER", "timestamp": "1999-01-01T00:00:00Z"}
+    sides = [0, 1, 0, 1, 1, 0]
+    idxs = [0, 1, 2, 3, 4, 5]
+    none = [None] * 6
+    comp = ComposedOpView(sides, idxs, none, none, none, left, right)
+    ops = comp.materialize()
+    for s, op in zip(sides, ops):
+        assert op.provenance == (left.prov if s == 0 else right.prov)
+    if load_opfactory() is None:
+        pytest.skip("C factory unavailable (python path verified)")

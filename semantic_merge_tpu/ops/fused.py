@@ -22,11 +22,12 @@ ONE jitted program and ONE compact fetch:
    :mod:`semantic_merge_tpu.core.ids`) is assembled from a
    device-resident string-hash table and hashed in ONE compression by
    the batched SHA-256 of :mod:`semantic_merge_tpu.ops.sha256`;
-3. **id ranking** — the composition sort key ranks id *strings*
-   (reference ``semmerge/compose.py:16-18``); UUID-formatted hex ids
-   with dashes at fixed positions order exactly like their leading
-   128 digest bits, so a 4-word lexsort over both streams reproduces
-   the host's rank table;
+3. **id tiebreaks from raw digest words** — the composition sort key
+   ranks id *strings* (reference ``semmerge/compose.py:16-18``);
+   UUID-formatted hex ids with dashes at fixed positions order exactly
+   like their leading 128 digest bits, so the canonical and merged
+   sorts simply take the four uint32 digest words as trailing keys —
+   no separate rank sort exists;
 4. **compose** — the canonical sorts, DivergentRename candidate join,
    and segmented chain scans of :mod:`semantic_merge_tpu.ops.compose`,
    run directly on columns derived from the diff output (no re-intern:
@@ -63,7 +64,7 @@ from ..core.encode import NULL_ID, PAD_ID, DeclTensor, Interner, bucket_size, pa
 from ..core.ops import Op
 from .compose import (_PAD_PREC, _local_seg_scan,
                       _rename_candidate_query, _rename_candidate_tables,
-                      _rename_pairs, _sort_perm, _sort_stream)
+                      _rename_pairs, _sort_perm)
 from .diff import KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME, _diff_plan
 from .oplog_view import (ComposedOpView, OpStreamView,
                          cursor_walk_conflicts_columnar)
@@ -225,11 +226,31 @@ def _op_id_words(kind, a_slot, b_slot, b_cols, s_cols, hash_tab,
                          n_words=4)
 
 
-def _compose_cols(kind, a_slot, b_slot, id_rank, b_cols, s_cols, C: int):
+#: Fused-path stream layout: the id tiebreak keys are the four raw
+#: digest words (big-endian uint32 — unsigned order == the id string's
+#: lexicographic order), NOT a precomputed global rank. Sorting on the
+#: words directly removes the 2C-row rank sort + scatter the v1 kernel
+#: paid before every compose (sorts dominate the kernel's device time).
+_STREAM_COLS_W = ("prec", "ts_rank", "idw0", "idw1", "idw2", "idw3",
+                  "is_rename", "is_move", "sym", "new_name", "chain_name",
+                  "new_addr", "chain_file", "op_index")
+
+
+def _sort_stream_w(cols):
+    """Canonical per-stream sort by (prec, ts rank, id words) — one
+    stable 6-key XLA sort, every other column carried as payload."""
+    out = jax.lax.sort(tuple(cols[k] for k in _STREAM_COLS_W),
+                       num_keys=6, is_stable=True)
+    return dict(zip(_STREAM_COLS_W, out))
+
+
+def _compose_cols(kind, a_slot, b_slot, words, b_cols, s_cols, C: int):
     """Derive the composer's encoded columns directly from diff rows —
     the scan interner's ids ARE the compose equality ids (names, files
     and addresses only ever get compared or decoded, never re-tagged;
-    see ``core.encode.encode_oplog`` for the host's equivalent)."""
+    see ``core.encode.encode_oplog`` for the host's equivalent).
+    ``words`` are the [C, 4] uint32 op-id digest words; invalid rows
+    mask to the max key (their _PAD_PREC already sorts them last)."""
     b_file = b_cols[3]
     s_name, s_file = s_cols[2], s_cols[3]
     s_addr = s_cols[1]
@@ -243,10 +264,14 @@ def _compose_cols(kind, a_slot, b_slot, id_rank, b_cols, s_cols, C: int):
     kc = jnp.clip(kind, 0, 3)
     sym_id = jnp.where(is_add, s_sym[b_sl], b_sym[a_sl])
     nn = jnp.where(is_ren, s_name[b_sl], NULL_ID)
+    inval = jnp.uint32(0xFFFFFFFF)
+    vmask = valid[:, None]
+    wmask = jnp.where(vmask, words, inval)
     return {
         "prec": jnp.where(valid, jnp.asarray(_PREC_BY_KIND)[kc], _PAD_PREC),
         "ts_rank": jnp.where(valid, 0, NULL_ID),  # single shared timestamp
-        "id_rank": jnp.where(valid, id_rank, NULL_ID),
+        "idw0": wmask[:, 0], "idw1": wmask[:, 1],
+        "idw2": wmask[:, 2], "idw3": wmask[:, 3],
         "is_rename": (is_ren & valid).astype(jnp.int32),
         "is_move": (is_mv & valid).astype(jnp.int32),
         "sym": jnp.where(valid, sym_id, PAD_ID),
@@ -273,8 +298,12 @@ def _merge_scan_spec(a, b, C: int):
     opidx = cat("op_index")
     live = opidx != NULL_ID
 
-    prec, ts, idr = cat("prec"), cat("ts_rank"), cat("id_rank")
-    merged_order, iota = _sort_perm(prec, ts, side, idr)
+    prec, ts = cat("prec"), cat("ts_rank")
+    # Cross-stream order: (prec, ts) with A before B on ties (side key);
+    # within a stream, ties order by the id words — identical to the
+    # global-rank formulation, minus the rank sort.
+    merged_order, iota = _sort_perm(prec, ts, side, cat("idw0"),
+                                    cat("idw1"), cat("idw2"), cat("idw3"))
     merged_pos = jnp.zeros_like(iota).at[merged_order].set(iota)
 
     sym = cat("sym")
@@ -329,31 +358,20 @@ def _fused_merge_kernel(b_cols, l_cols, r_cols, hash_tab, dig_l, dig_r,
 def _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
                       b_cols, l_cols, r_cols, C: int, split: bool = False):
     """Stages shared by the single-device and dp-sharded fused kernels:
-    id ranking, compose columns, canonical sorts, candidate join,
-    speculative merge+scan, and the compact flat packing. Inputs here
-    are full (replicated on every shard in the mesh case).
+    compose columns (digest words as id tiebreak keys), canonical
+    sorts, candidate join, speculative merge+scan, and the compact
+    flat packing. Inputs here are full (replicated on every shard in
+    the mesh case).
 
     ``split=True`` returns ``(head, tail)`` instead of one vector —
     byte-identical content, but the host can start async copies for
     both and materialize the op streams (head) while the compose block
     (tail) is still in flight through the device tunnel."""
     overflow = ((nopsL > C) | (nopsR > C)).astype(jnp.int32)
-    # Global id ranks: 128-bit big-endian word lexsort over both streams
-    # == lexicographic rank of the uuid-formatted id strings.
-    inval = jnp.uint32(0xFFFFFFFF)
-    validL = (kL >= 0)[:, None]
-    validR = (kR >= 0)[:, None]
-    all_words = jnp.concatenate([jnp.where(validL, wL, inval),
-                                 jnp.where(validR, wR, inval)])
-    order, iota2 = _sort_perm(all_words[:, 0], all_words[:, 1],
-                              all_words[:, 2], all_words[:, 3])
-    rank = jnp.zeros((2 * C,), jnp.int32).at[order].set(iota2)
-    id_rank_l, id_rank_r = rank[:C], rank[C:]
-
-    colsL = _compose_cols(kL, aL, bL, id_rank_l, b_cols, l_cols, C)
-    colsR = _compose_cols(kR, aR, bR, id_rank_r, b_cols, r_cols, C)
-    a = _sort_stream(colsL)
-    b = _sort_stream(colsR)
+    colsL = _compose_cols(kL, aL, bL, wL, b_cols, l_cols, C)
+    colsR = _compose_cols(kR, aR, bR, wR, b_cols, r_cols, C)
+    a = _sort_stream_w(colsL)
+    b = _sort_stream_w(colsR)
 
     tables = _rename_candidate_tables(a, nopsL, C)
     b_rsym, b_rname = _rename_pairs(b, nopsR, C)

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Stage-split timing of the fused merge kernel.
+
+Answers the profiling question VERDICT r4 left open — how much of the
+device time is the diff join vs SHA op identity vs the compose sorts/
+scans — by jitting cumulative PREFIXES of the fused program and timing
+each: the difference between consecutive prefixes is that stage's cost
+(each prefix is one jitted program, so XLA still fuses within it; the
+split is therefore a faithful attribution, not a hand-scheduled one).
+
+Runs on whatever platform jax selects (real chip when the relay is up;
+`JAX_PLATFORMS=cpu` for XLA-on-CPU). Usage::
+
+    python scripts/kernel_split.py [--files 10000] [--decls 4]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from semantic_merge_tpu.utils.jaxenv import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--files", type=int, default=10000)
+    ap.add_argument("--decls", type=int, default=4)
+    ap.add_argument("--repeats", type=int, default=5)
+    args = ap.parse_args()
+
+    import bench
+    from semantic_merge_tpu.backends.base import get_backend
+
+    base, left, right = bench.synth_repo(args.files, args.decls,
+                                         divergent=True)
+    bk = get_backend("tpu")
+    # Warm scan/encode + device decl columns through the normal path.
+    bench.run_merge(bk, base, left, right)
+    eng = bk._fused_engine()
+    base_t, base_nodes, base_key = bk._scan_encode_keyed(base)
+    left_t, left_nodes, left_key = bk._scan_encode_keyed(left)
+    right_t, right_nodes, right_key = bk._scan_encode_keyed(right)
+    hash_tab = eng.strings.sync()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+    from semantic_merge_tpu.core.ids import op_id_prefix_digest
+    from semantic_merge_tpu.ops import fused as F
+
+    dev_b, nb = eng._device_decl(base_t, base_key)
+    dev_l, nl = eng._device_decl(left_t, left_key)
+    dev_r, nr = eng._device_decl(right_t, right_key)
+    C = eng._bucket(max(eng._cap_hint, 8))
+    dig_l = np.frombuffer(op_id_prefix_digest("bench/L", "bench"), np.uint8)
+    dig_r = np.frombuffer(op_id_prefix_digest("bench/R", "bench"), np.uint8)
+
+    def stage_diff(b, l, r, tab, dl, dr):
+        planL = F._diff_plan(b[0], b[1], b[2], l[0], l[1], l[2], nb, nl)
+        planR = F._diff_plan(b[0], b[1], b[2], r[0], r[1], r[2], nb, nr)
+        return planL["n_ops"], planR["n_ops"]
+
+    def stage_emit(b, l, r, tab, dl, dr):
+        planL = F._diff_plan(b[0], b[1], b[2], l[0], l[1], l[2], nb, nl)
+        planR = F._diff_plan(b[0], b[1], b[2], r[0], r[1], r[2], nb, nr)
+        kL, aL, bL, nL_ = F._emit_slots(planL, C, nb, nl)
+        kR, aR, bR, nR_ = F._emit_slots(planR, C, nb, nr)
+        return kL, kR, nL_, nR_
+
+    def stage_sha(b, l, r, tab, dl, dr):
+        planL = F._diff_plan(b[0], b[1], b[2], l[0], l[1], l[2], nb, nl)
+        planR = F._diff_plan(b[0], b[1], b[2], r[0], r[1], r[2], nb, nr)
+        kL, aL, bL, _ = F._emit_slots(planL, C, nb, nl)
+        kR, aR, bR, _ = F._emit_slots(planR, C, nb, nr)
+        wL = F._op_id_words(kL, aL, bL, b, l, tab, dl, C=C)
+        wR = F._op_id_words(kR, aR, bR, b, r, tab, dr, C=C)
+        return wL, wR
+
+    def stage_full(b, l, r, tab, dl, dr):
+        return F._fused_merge_kernel(b, l, r, tab, dl, dr,
+                                     nb=nb, nl=nl, nr=nr, C=C)
+
+    stages = [("diff_join", stage_diff), ("emit_slots", stage_emit),
+              ("sha_ids", stage_sha), ("full_kernel", stage_full)]
+    results = {}
+    inputs = (dev_b, dev_l, dev_r, hash_tab, jnp.asarray(dig_l),
+              jnp.asarray(dig_r))
+    for name, fn in stages:
+        jf = jax.jit(fn)
+        jax.block_until_ready(jf(*inputs))  # compile
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jf(*inputs))
+            best = min(best, time.perf_counter() - t0)
+        results[name] = best * 1e3
+
+    plat = jax.devices()[0].platform
+    print(f"# platform={plat} files={args.files} C={C} nb={nb}")
+    prev = 0.0
+    for name, _ in stages:
+        t = results[name]
+        print(f"{name:14s} cumulative {t:8.1f} ms   stage {t - prev:8.1f} ms")
+        prev = t
+    compose_share = results["full_kernel"] - results["sha_ids"]
+    print(f"# compose stages (sorts + candidate join + scans + pack): "
+          f"{compose_share:.1f} ms "
+          f"({100 * compose_share / results['full_kernel']:.0f}% of kernel)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -226,24 +226,6 @@ def _op_id_words(kind, a_slot, b_slot, b_cols, s_cols, hash_tab,
                          n_words=4)
 
 
-#: Fused-path stream layout: the id tiebreak keys are the four raw
-#: digest words (big-endian uint32 — unsigned order == the id string's
-#: lexicographic order), NOT a precomputed global rank. Sorting on the
-#: words directly removes the 2C-row rank sort + scatter the v1 kernel
-#: paid before every compose (sorts dominate the kernel's device time).
-_STREAM_COLS_W = ("prec", "ts_rank", "idw0", "idw1", "idw2", "idw3",
-                  "is_rename", "is_move", "sym", "new_name", "chain_name",
-                  "new_addr", "chain_file", "op_index")
-
-
-def _sort_stream_w(cols):
-    """Canonical per-stream sort by (prec, ts rank, id words) — one
-    stable 6-key XLA sort, every other column carried as payload."""
-    out = jax.lax.sort(tuple(cols[k] for k in _STREAM_COLS_W),
-                       num_keys=6, is_stable=True)
-    return dict(zip(_STREAM_COLS_W, out))
-
-
 def _compose_cols(kind, a_slot, b_slot, words, b_cols, s_cols, C: int):
     """Derive the composer's encoded columns directly from diff rows —
     the scan interner's ids ARE the compose equality ids (names, files
@@ -263,6 +245,9 @@ def _compose_cols(kind, a_slot, b_slot, words, b_cols, s_cols, C: int):
     is_mv = kind == KIND_MOVE
     kc = jnp.clip(kind, 0, 3)
     sym_id = jnp.where(is_add, s_sym[b_sl], b_sym[a_sl])
+    # new_name doubles as the rename chain value on the fused path
+    # (host encode distinguishes equality-keyed vs chain forms; here
+    # both are the interned side name).
     nn = jnp.where(is_ren, s_name[b_sl], NULL_ID)
     inval = jnp.uint32(0xFFFFFFFF)
     vmask = valid[:, None]
@@ -276,7 +261,6 @@ def _compose_cols(kind, a_slot, b_slot, words, b_cols, s_cols, C: int):
         "is_move": (is_mv & valid).astype(jnp.int32),
         "sym": jnp.where(valid, sym_id, PAD_ID),
         "new_name": nn,
-        "chain_name": nn,
         "new_addr": jnp.where(is_mv, s_addr[b_sl], NULL_ID),
         "chain_file": jnp.where(valid,
                                 jnp.where(kind == KIND_DELETE,
@@ -286,53 +270,42 @@ def _compose_cols(kind, a_slot, b_slot, words, b_cols, s_cols, C: int):
     }
 
 
-def _merge_scan_spec(a, b, C: int):
-    """Speculative merged order + segmented chain scans (no drops) —
-    the same stage-3 instructions as ``ops.compose._merge_and_scan``,
-    emitting compact ``side<<30|op_index`` references."""
-    def cat(name):
-        return jnp.concatenate([a[name], b[name]])
-
+def _merge_scan_spec(m, side_m, C: int):
+    """Segmented chain scans + compact ``side<<30|op_index`` references
+    over rows ALREADY in merged (composed) order — the same stage-3
+    instructions as ``ops.compose._merge_and_scan``. The caller's one
+    canonical sort produced the merged layout, so the only sort here is
+    the 1-key stable symbol grouping for the scans (stability preserves
+    merged order within each symbol segment)."""
     total = 2 * C
-    side = jnp.concatenate([jnp.zeros((C,), jnp.int32), jnp.ones((C,), jnp.int32)])
-    opidx = cat("op_index")
+    opidx = m["op_index"]
     live = opidx != NULL_ID
-
-    prec, ts = cat("prec"), cat("ts_rank")
-    # Cross-stream order: (prec, ts) with A before B on ties (side key);
-    # within a stream, ties order by the id words — identical to the
-    # global-rank formulation, minus the rank sort.
-    merged_order, iota = _sort_perm(prec, ts, side, cat("idw0"),
-                                    cat("idw1"), cat("idw2"), cat("idw3"))
-    merged_pos = jnp.zeros_like(iota).at[merged_order].set(iota)
-
-    sym = cat("sym")
-    is_rename = cat("is_rename") == 1
-    is_move = cat("is_move") == 1
-    new_name = cat("chain_name")
-    new_addr = cat("new_addr")
-    file_contrib = cat("chain_file")
+    sym = m["sym"]
+    is_rename = m["is_rename"] == 1
+    is_move = m["is_move"] == 1
+    new_name = m["new_name"]
+    new_addr = m["new_addr"]
+    file_contrib = m["chain_file"]
 
     move_live = is_move & live
     c_addr_val = jnp.where(move_live & (new_addr != NULL_ID), new_addr, NULL_ID)
     c_file_val = jnp.where(move_live & (file_contrib != NULL_ID), file_contrib, NULL_ID)
     c_name_val = jnp.where(is_rename & live, new_name, NULL_ID)
 
-    seg_order, _ = _sort_perm(sym, merged_pos)
+    seg_order, _ = _sort_perm(sym)
     seg_sym = sym[seg_order]
     chain_addr = _local_seg_scan(seg_sym, seg_order, c_addr_val)
     chain_file = _local_seg_scan(seg_sym, seg_order, c_file_val)
     chain_name = _local_seg_scan(seg_sym, seg_order, c_name_val)
 
-    live_m = live[merged_order]
-    out_pos = jnp.cumsum(live_m.astype(jnp.int32)) - 1
-    n_out = jnp.sum(live_m.astype(jnp.int32))
-    pos = jnp.where(live_m, out_pos, total)
-    packed = (side << 30) | jnp.where(opidx >= 0, opidx, 0)
+    out_pos = jnp.cumsum(live.astype(jnp.int32)) - 1
+    n_out = jnp.sum(live.astype(jnp.int32))
+    pos = jnp.where(live, out_pos, total)
+    packed = (side_m << 30) | jnp.where(opidx >= 0, opidx, 0)
 
     def place(vals):
         buf = jnp.full((total,), NULL_ID, jnp.int32)
-        return buf.at[pos].set(vals[merged_order], mode="drop")
+        return buf.at[pos].set(vals, mode="drop")
 
     return (n_out, place(packed), place(chain_addr), place(chain_file),
             place(chain_name))
@@ -370,14 +343,45 @@ def _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
     overflow = ((nopsL > C) | (nopsR > C)).astype(jnp.int32)
     colsL = _compose_cols(kL, aL, bL, wL, b_cols, l_cols, C)
     colsR = _compose_cols(kR, aR, bR, wR, b_cols, r_cols, C)
-    a = _sort_stream_w(colsL)
-    b = _sort_stream_w(colsR)
+
+    # ONE canonical sort serves everything: sorting the concatenation
+    # by (prec, ts, side, id words) yields the merged (composed) order
+    # directly, AND its restriction to one side IS that side's
+    # canonical order — so the per-stream sorts of the v1/v2 kernels
+    # collapse into a cheap stable partition of the merged rows
+    # (cumsum + one bijective scatter per needed column).
+    def cat(name):
+        return jnp.concatenate([colsL[name], colsR[name]])
+
+    side = jnp.concatenate([jnp.zeros((C,), jnp.int32),
+                            jnp.ones((C,), jnp.int32)])
+    merged_order, _ = _sort_perm(cat("prec"), cat("ts_rank"), side,
+                                 cat("idw0"), cat("idw1"),
+                                 cat("idw2"), cat("idw3"))
+    m = {k: cat(k)[merged_order]
+         for k in ("sym", "is_rename", "is_move", "new_name",
+                   "new_addr", "chain_file", "op_index")}
+    side_m = side[merged_order]
+
+    is_a = side_m == 0
+    pos_a = jnp.cumsum(is_a.astype(jnp.int32)) - 1
+    pos_b = jnp.cumsum((~is_a).astype(jnp.int32)) - 1
+    ppos = jnp.where(is_a, pos_a, C + pos_b)
+
+    def part(v):  # merged rows -> [A canonical | B canonical]
+        return jnp.zeros((2 * C,), v.dtype).at[ppos].set(v)
+
+    a = {}
+    b = {}
+    for k in ("sym", "is_rename", "new_name", "op_index"):
+        pv = part(m[k])
+        a[k], b[k] = pv[:C], pv[C:]
 
     tables = _rename_candidate_tables(a, nopsL, C)
     b_rsym, b_rname = _rename_pairs(b, nopsR, C)
     has_cand = jnp.any(_rename_candidate_query(tables, C, b_rsym, b_rname))
 
-    n_out, ref, c_addr, c_file, c_name = _merge_scan_spec(a, b, C)
+    n_out, ref, c_addr, c_file, c_name = _merge_scan_spec(m, side_m, C)
 
     scalars = jnp.stack([nopsL, nopsR, n_out, has_cand.astype(jnp.int32),
                          overflow, jnp.int32(0), jnp.int32(0), jnp.int32(0)])

@@ -96,8 +96,29 @@ class TpuTSBackend:
         """Scan+encode, also returning the snapshot's stable identity
         (the tuple of per-file decl-cache keys + interner token) — the
         key under which the fused path caches device-resident decl
-        columns. ``None`` when any file lacks a stable key."""
+        columns. ``None`` when any file lacks a stable key.
+
+        Warm repeats skip identity RECOMPUTATION too: the identity is
+        cached on the Snapshot object, guarded by a content
+        fingerprint built from the files' ``hash()`` values — Python
+        strings cache their hash, so verification is O(n_files) after
+        the first pass, and replacing any path/content string (the
+        only way str content changes) invalidates it. At the 10k-file
+        rung this removes ~60 ms of per-merge cache-key bookkeeping
+        the snapshot cache's own lookup used to pay."""
         from ..frontend.declcache import global_cache
+        tok = self._interner.token
+        fp = None
+        cached = snapshot.__dict__.get("_semmerge_identity")
+        if cached is not None:
+            cident, cfp = cached
+            if cident[0] == tok:
+                fp = _snapshot_fingerprint(snapshot)
+                if cfp == fp:
+                    hit = self._snap_cache.get(cident)
+                    if hit is not None:
+                        self._snap_cache.move_to_end(cident)
+                        return hit[0], hit[1], cident
         keyed = scan_snapshot_keyed(ts_files(snapshot))
         identity = None
         keys = [k for k, _ in keyed]
@@ -109,12 +130,17 @@ class TpuTSBackend:
             hit = self._snap_cache.get(identity)
             if hit is not None:
                 self._snap_cache.move_to_end(identity)
+                # Content-aliased snapshot objects (e.g. an unchanged
+                # side equal to base) get the object-level fast path
+                # too, not just the one that populated the cache.
+                _store_identity(snapshot, identity, fp)
                 return hit[0], hit[1], identity
         t, nodes = encode_decls_keyed(keyed, self._interner, global_cache())
         if identity is not None:
             self._snap_cache[identity] = (t, nodes)
             while len(self._snap_cache) > 4:
                 self._snap_cache.popitem(last=False)
+            _store_identity(snapshot, identity, fp)
         return t, nodes, identity
 
     def configure(self, config) -> None:
@@ -331,6 +357,28 @@ class TpuTSBackend:
 
     def close(self) -> None:
         pass
+
+
+def _store_identity(snapshot: Snapshot, identity, fp) -> None:
+    """Attach the identity-cache record ``(identity, fingerprint)`` to
+    the snapshot object (``identity[0]`` is the interner token). ``fp``
+    reuses a fingerprint the guard already computed, if any."""
+    if fp is None:
+        fp = _snapshot_fingerprint(snapshot)
+    snapshot.__dict__["_semmerge_identity"] = (identity, fp)
+
+
+def _snapshot_fingerprint(snapshot: Snapshot) -> int:
+    """Content fingerprint for the snapshot-object identity cache:
+    hashes every (path, content) pair of the TS-indexed subset — the
+    same file set the guarded identity derives from, so other
+    languages' edits don't invalidate the TS identity. Strings cache
+    their hash, so after the first computation this is an O(n_files)
+    pointer walk; any in-place replacement of a path/content string
+    changes it."""
+    files = ts_files(snapshot)
+    return hash((len(files),)
+                + tuple((f["path"], f["content"]) for f in files))
 
 
 def _changesig_candidates(view, matcher) -> bool:

@@ -67,7 +67,18 @@ def apply_text_fallback(merged_tree: pathlib.Path, base_tar: bytes,
     paths = sorted((set(left) | set(right) | set(base)))
     for path in paths:
         if pathlib.PurePosixPath(path).suffix in indexed:
-            continue  # the semantic pipeline owns indexed files
+            # The semantic pipeline owns indexed files — EXCEPT a file
+            # that exists on a side but neither in base nor in the
+            # op-applied tree: a pure one-sided add the op vocabulary
+            # has no whole-file handler for (the reference applier
+            # skips addDecl too, reference ``semmerge/applier.py:30-31``
+            # — its real driver flow leans on git fast-forwarding pure
+            # adds, which a standalone ``semmerge`` invocation cannot).
+            # Those fall through to the text layer, which resolves a
+            # one-sided add trivially and a both-sided divergent add as
+            # a conflict.
+            if path in base or (merged_tree / path).exists():
+                continue
         base_c = base.get(path)
         resolved, conflict = _resolve(path, base_c, left.get(path),
                                       right.get(path))

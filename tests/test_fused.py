@@ -331,3 +331,39 @@ def test_incremental_scope_fuzz_parity():
             left.restrict(scope), right.restrict(scope), **kw)
         assert _dicts(comp_t) == _dicts(comp_f)
         assert [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_f]
+
+
+def test_snapshot_identity_cache_invalidates_on_mutation():
+    """The snapshot-object identity cache must not serve stale results
+    when a file's content string is replaced in place (the only way
+    str content changes) — the fingerprint guard catches it."""
+    tpu = fused_backend()
+    base = snap([("a.ts", "export function f(x: number): number { return x; }\n")])
+    left = snap([("a.ts", "export function g(x: number): number { return x; }\n")])
+    right = snap([("a.ts", "export function f(x: number): number { return x; }\n")])
+    _, comp1, _ = run_merge(tpu, base, left, right, seed="s", base_rev="r",
+                            timestamp="2026-01-01T00:00:00Z")
+    assert any(o.type == "renameSymbol" and o.params["newName"] == "g"
+               for o in comp1)
+    # Warm repeat on the SAME objects must be served by the identity
+    # cache — pin that the per-file key recomputation did NOT run.
+    import semantic_merge_tpu.backends.ts_tpu as ts_tpu_mod
+    calls = []
+    orig_scan = ts_tpu_mod.scan_snapshot_keyed
+    ts_tpu_mod.scan_snapshot_keyed = \
+        lambda files: (calls.append(1), orig_scan(files))[1]
+    try:
+        _, comp1b, _ = run_merge(tpu, base, left, right, seed="s",
+                                 base_rev="r",
+                                 timestamp="2026-01-01T00:00:00Z")
+    finally:
+        ts_tpu_mod.scan_snapshot_keyed = orig_scan
+    assert calls == [], "warm repeat must hit the identity cache"
+    assert _dicts(comp1b) == _dicts(comp1)
+    # In-place mutation of the same Snapshot object must invalidate.
+    left.files[0]["content"] = \
+        "export function h(x: number): number { return x; }\n"
+    _, comp2, _ = run_merge(tpu, base, left, right, seed="s", base_rev="r",
+                            timestamp="2026-01-01T00:00:00Z")
+    renames = [o for o in comp2 if o.type == "renameSymbol"]
+    assert renames and renames[0].params["newName"] == "h"

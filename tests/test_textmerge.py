@@ -165,3 +165,56 @@ def test_encoder_rejects_bad_attn_mode():
     from semantic_merge_tpu.models.encoder import EncoderConfig
     with _pytest.raises(ValueError, match="attn_mode"):
         EncoderConfig(attn_mode="ulyses")
+
+
+def _tarb(files):
+    """In-memory tar of {path: text} — shared by the added-file tests."""
+    import io
+    import tarfile
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        for name, data in files.items():
+            info = tarfile.TarInfo(name)
+            payload = data.encode()
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+    return buf.getvalue()
+
+
+def test_one_sided_added_indexed_file_materializes(tmp_path):
+    """A .ts file added on one side (absent in base and not produced
+    by the op applier) must land in the merge via the text layer —
+    the op vocabulary has no whole-file add handler (reference
+    applier parity), and a standalone semmerge cannot lean on git
+    fast-forwarding pure adds."""
+    import pathlib
+
+    from semantic_merge_tpu.runtime.textmerge import apply_text_fallback
+
+    merged = tmp_path / "merged"
+    merged.mkdir()
+    (merged / "a.ts").write_text("export function bar(): void {}\n")
+
+    base = _tarb({"a.ts": "export function foo(): void {}\n"})
+    left = _tarb({"a.ts": "export function bar(): void {}\n"})
+    right = _tarb({"a.ts": "export function foo(): void {}\n",
+                   "b.ts": "export function extra(s: string): string { return s; }\n"})
+    conflicts, deleted = apply_text_fallback(merged, base, left, right)
+    assert conflicts == [] and deleted == []
+    assert (merged / "b.ts").read_text().startswith("export function extra")
+    # Indexed files the op pipeline already owns stay untouched.
+    assert (merged / "a.ts").read_text() == "export function bar(): void {}\n"
+
+
+def test_both_sided_divergent_added_indexed_file_conflicts(tmp_path):
+    """Both sides adding the same new .ts path with different content
+    is a conflict the text layer must surface, not silently pick."""
+    from semantic_merge_tpu.runtime.textmerge import apply_text_fallback
+
+    merged = tmp_path / "merged"
+    merged.mkdir()
+    base = _tarb({})
+    left = _tarb({"n.ts": "export const a = 1;\n"})
+    right = _tarb({"n.ts": "export const a = 2;\n"})
+    conflicts, _ = apply_text_fallback(merged, base, left, right)
+    assert conflicts, "divergent both-sided add must conflict"

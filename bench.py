@@ -116,8 +116,8 @@ def serialize_payload(result) -> int:
     lists — both are measured producing identical bytes, so
     ``vs_baseline`` compares output-to-output, not object-to-object."""
     from semantic_merge_tpu.core.ops import OpLog
-    return (len(OpLog(result.op_log_left).to_json())
-            + len(OpLog(result.op_log_right).to_json()))
+    return (len(OpLog(result.op_log_left).to_json_bytes())
+            + len(OpLog(result.op_log_right).to_json_bytes()))
 
 
 def run_merge_to_payload(backend, base, left, right, phases=None):

@@ -221,6 +221,15 @@ class OpLog:
             return fast()
         return dumps_canonical([o.to_dict() for o in self.ops])
 
+    def to_json_bytes(self) -> bytes:
+        """UTF-8 bytes of :meth:`to_json`; columnar views hand the
+        native serializer's buffer through without a decode/encode
+        round trip."""
+        fast = getattr(self.ops, "to_json_bytes", None)
+        if fast is not None:
+            return fast()
+        return self.to_json().encode("utf-8")
+
     @staticmethod
     def from_json(data: str) -> "OpLog":
         return OpLog([Op.from_dict(item) for item in json.loads(data)])

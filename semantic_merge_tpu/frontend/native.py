@@ -216,13 +216,14 @@ def try_scan_snapshot(files: Sequence[dict]) -> Optional[List[DeclNode]]:
     ]
 
 
-def try_oplog_json(n: int, kind, a_slot, b_slot, words,
-                   base_blob: bytes, base_offs, side_blob: bytes, side_offs,
-                   prov_json: str) -> Optional[str]:
-    """Render an op stream's canonical JSON from its device columns via
-    the native serializer (``smn_oplog_json``); ``None`` → caller uses
-    the Python columnar serializer. Arrays must be C-contiguous int32
-    (columns) / int64 (table offsets)."""
+def try_oplog_json_bytes(n: int, kind, a_slot, b_slot, words,
+                         base_blob: bytes, base_offs,
+                         side_blob: bytes, side_offs,
+                         prov_json: str) -> Optional[bytes]:
+    """Render an op stream's canonical JSON (UTF-8 bytes) from its
+    device columns via the native serializer (``smn_oplog_json``);
+    ``None`` → caller uses the Python columnar serializer. Arrays must
+    be C-contiguous int32 (columns) / int64 (table offsets)."""
     lib = _load()
     if lib is None:
         return None
@@ -239,9 +240,10 @@ def try_oplog_json(n: int, kind, a_slot, b_slot, words,
     if not ptr:
         return None
     try:
-        return ctypes.string_at(ptr, out_len.value).decode("utf-8")
+        return ctypes.string_at(ptr, out_len.value)
     finally:
         lib.smn_free(ptr)
+
 
 
 _OPFACTORY_PATH = _NATIVE_DIR / "semmerge_opfactory.so"

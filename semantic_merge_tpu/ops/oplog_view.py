@@ -300,24 +300,32 @@ class OpStreamView(Sequence):
         tables + int32 columns in, JSON bytes out (~20× the Python
         row loop); falls back to the Python serializer when the native
         library is unavailable."""
-        if len(self) > 0:
-            native = self._to_json_native()
-            if native is not None:
-                return native
-        return self._to_json_py()
+        return self.to_json_bytes().decode("utf-8")
 
-    def _to_json_native(self) -> Optional[str]:
-        from ..frontend.native import try_oplog_json
+    def to_json_bytes(self) -> bytes:
+        """UTF-8 bytes of :meth:`to_json` — the native path hands the C
+        buffer through without the 20 MB-scale decode/encode round trip
+        (the notes writer consumes bytes directly)."""
+        if len(self) > 0:
+            raw = self._to_json_native_bytes()
+            if raw is not None:
+                return raw
+        return self._to_json_py().encode("utf-8")
+
+    def _native_args(self):
         base_tbl = _get_table(self.base_tbl_ref, self.base_nodes)
         side_tbl = _get_table(self.side_tbl_ref, self.side_nodes)
-        return try_oplog_json(
-            len(self),
-            np.ascontiguousarray(self.kind, np.int32),
-            np.ascontiguousarray(self.a_slot, np.int32),
-            np.ascontiguousarray(self.b_slot, np.int32),
-            np.ascontiguousarray(self.words, np.int32),
-            base_tbl[0], base_tbl[1], side_tbl[0], side_tbl[1],
-            dumps_canonical(self.prov))
+        return (len(self),
+                np.ascontiguousarray(self.kind, np.int32),
+                np.ascontiguousarray(self.a_slot, np.int32),
+                np.ascontiguousarray(self.b_slot, np.int32),
+                np.ascontiguousarray(self.words, np.int32),
+                base_tbl[0], base_tbl[1], side_tbl[0], side_tbl[1],
+                dumps_canonical(self.prov))
+
+    def _to_json_native_bytes(self) -> Optional[bytes]:
+        from ..frontend.native import try_oplog_json_bytes
+        return try_oplog_json_bytes(*self._native_args())
 
     def _to_json_py(self) -> str:
         ids = self.ids()

@@ -24,7 +24,7 @@ def notes_put(commit: str, oplog: OpLog, namespace: str = NOTES_REF) -> None:
     os.close(fd)
     tmp_file = pathlib.Path(tmp_path)
     try:
-        tmp_file.write_text(oplog.to_json(), encoding="utf-8")
+        tmp_file.write_bytes(oplog.to_json_bytes())
         subprocess.run(
             ["git", "notes", "--ref", namespace, "add", "-f", "-F", str(tmp_file), commit],
             check=True,

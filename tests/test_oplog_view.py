@@ -174,9 +174,9 @@ def test_native_serializer_byte_parity():
     for seed in range(6):
         view = _random_view(64, seed=seed)
         expect = view._to_json_py()
-        got = view._to_json_native()
+        got = view._to_json_native_bytes()
         assert got is not None
-        assert got == expect
+        assert got.decode("utf-8") == expect
     empty = _random_view(0)
     assert empty.to_json() == "[]"
 
@@ -228,3 +228,16 @@ def test_c_composed_ops_respect_per_side_provenance():
         assert op.provenance == (left.prov if s == 0 else right.prov)
     if load_opfactory() is None:
         pytest.skip("C factory unavailable (python path verified)")
+
+
+def test_to_json_bytes_matches_str():
+    """to_json_bytes must be exactly to_json().encode() on both the
+    native and Python paths, and through the OpLog seam notes use."""
+    for seed in (0, 3):
+        view = _random_view(40, seed=seed)
+        assert view.to_json_bytes() == view.to_json().encode("utf-8")
+        assert OpLog(view).to_json_bytes() == \
+            OpLog(view).to_json().encode("utf-8")
+    # Plain-list OpLog path too.
+    ops = list(_random_view(6, seed=1))
+    assert OpLog(ops).to_json_bytes() == OpLog(ops).to_json().encode("utf-8")

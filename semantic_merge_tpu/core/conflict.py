@@ -107,3 +107,36 @@ def concurrent_stmt_edit_conflict(op_a: Op, op_b: Op) -> Conflict:
              "ops": [op_b.id]},
         ],
     )
+
+
+def extract_vs_inline_conflict(op_extract: Op, op_inline: Op,
+                               extract_side: str) -> Conflict:
+    """One branch extracted a statement block into a new declaration
+    while the other inlined a declaration with that same block
+    ([CFR-002] "Extract vs inline on the same body", reference
+    ``requirements.md:98``). The join key is ``blockHash`` — the
+    content identity of the moved statements — so the motions conflict
+    wherever the block lives. ``extract_side`` is ``"A"`` or ``"B"`` —
+    which branch performed the extract."""
+    op_a, op_b = ((op_extract, op_inline) if extract_side == "A"
+                  else (op_inline, op_extract))
+    return Conflict(
+        id=f"conf-{op_a.id[:8]}-{op_b.id[:8]}",
+        category="ExtractVsInline",
+        symbolId=op_extract.target.symbolId,
+        addressIds={"A": op_a.target.addressId, "B": op_b.target.addressId,
+                    "base": None},
+        opA=op_a.to_dict(),
+        opB=op_b.to_dict(),
+        minimalSlice={"path": str(op_extract.params.get("file", "")),
+                      "start": 0, "end": 0,
+                      "code": str(op_extract.params.get("blockHash", ""))},
+        suggestions=[
+            {"id": "keepExtract",
+             "label": f"Keep the extracted {op_extract.params.get('newName')}",
+             "ops": [op_extract.id]},
+            {"id": "keepInline",
+             "label": f"Keep {op_inline.params.get('methodName')} inlined",
+             "ops": [op_inline.id]},
+        ],
+    )

@@ -334,6 +334,135 @@ def statement_edits(base_nodes: List[DeclNode], side_nodes: List[DeclNode],
     return ops
 
 
+def _decl_block(text: str) -> str:
+    """The whitespace-normalized statement block of a declaration's
+    source text: everything between the first ``{`` and the last ``}``,
+    collapsed to single spaces. Empty when the decl has no braced body
+    (``declare``/arrow-less vars) or the block is blank — callers skip
+    those."""
+    lo = text.find("{")
+    hi = text.rfind("}")
+    if lo < 0 or hi <= lo:
+        return ""
+    return " ".join(text[lo + 1:hi].split())
+
+
+_IDENT_CHARS = frozenset(
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789_$")
+
+
+def _block_in(block: str, text: str) -> bool:
+    """True when ``block`` occurs in ``text`` at identifier boundaries:
+    a raw substring check would let ``x + 1;`` "match" inside
+    ``max + 1;`` and mint a motion for code that never moved. Both
+    strings are already whitespace-normalized."""
+    start = 0
+    while True:
+        i = text.find(block, start)
+        if i < 0:
+            return False
+        before_ok = i == 0 or (text[i - 1] not in _IDENT_CHARS
+                               or block[0] not in _IDENT_CHARS)
+        j = i + len(block)
+        after_ok = j >= len(text) or (text[j] not in _IDENT_CHARS
+                                      or block[-1] not in _IDENT_CHARS)
+        if before_ok and after_ok:
+            return True
+        start = i + 1
+
+
+def body_motions(diffs, stmt_ops: List[Op], sources,
+                 *, base_rev: str, seed: str,
+                 timestamp: str = EPOCH_ISO, start_idx: int = 0) -> List[Op]:
+    """``extractMethod`` / ``inlineMethod`` ops: statement-block motion
+    between declarations.
+
+    The reference names extract/inline in its op vocabulary and gates a
+    [CFR-002] conflict category on them (reference
+    ``requirements.md:52,98``) but its worker emits neither. This pass
+    detects the motions from the already-lifted evidence:
+
+    - **extract** — an added declaration N whose braced body appears
+      (whitespace-normalized) in the OLD body of a body-edited
+      declaration D but not in its NEW body: N's statements left D.
+    - **inline** — a deleted declaration N whose body appears in a
+      body-edited D's NEW body but not its OLD body: N's statements
+      landed in D.
+
+    Emitted ops are *markers*: the companion ``editStmtBlock`` /
+    ``addDecl`` / ``deleteDecl`` ops still carry the text-level change
+    (the applier skips unknown-to-it types by contract), so the markers
+    add the semantic identity of the motion — the join key
+    (``blockHash`` over the normalized block) the strict conflict
+    detector and [RES-004] dedup need — without double-applying
+    anything. One motion per added/deleted decl (first matching edit in
+    stream order wins); ids continue the statement stream's index
+    sequence, keeping the whole op stream a deterministic function of
+    (seed, rev, content)."""
+    base_map, side_map = sources
+    edits = [op for op in stmt_ops if op.type == "editStmtBlock"]
+    ops: List[Op] = []
+    idx = start_idx
+    prov = {"rev": base_rev, "timestamp": timestamp}
+    from .ids import stable_hash_hex
+    for d in diffs:
+        if d.kind == "add" and d.b is not None:
+            node, src = d.b, side_map.get(d.b.file)
+        elif d.kind == "delete" and d.a is not None:
+            node, src = d.a, base_map.get(d.a.file)
+        else:
+            continue
+        if src is None:
+            continue
+        block = _decl_block(src[node.pos:node.end])
+        if not block:
+            continue
+        for e in edits:
+            old = " ".join(str(e.params.get("oldBody", "")).split())
+            new = " ".join(str(e.params.get("newBody", "")).split())
+            if d.kind == "add" and _block_in(block, old) \
+                    and not _block_in(block, new):
+                ops.append(Op.new(
+                    "extractMethod",
+                    Target(symbolId=e.target.symbolId,
+                           addressId=e.target.addressId),
+                    params={"file": node.file, "newName": node.name,
+                            "newAddress": node.addressId,
+                            "newSymbol": node.symbolId,
+                            "fromFile": str(e.params.get("file", "")),
+                            "blockHash": stable_hash_hex(block, n_hex=16)},
+                    guards={"exists": True},
+                    effects={"summary": f"extract {node.name}"},
+                    provenance=prov,
+                    op_id=deterministic_op_id(
+                        seed, base_rev, idx, "extractMethod",
+                        e.target.symbolId, node.addressId, block),
+                ))
+            elif d.kind == "delete" and _block_in(block, new) \
+                    and not _block_in(block, old):
+                ops.append(Op.new(
+                    "inlineMethod",
+                    Target(symbolId=e.target.symbolId,
+                           addressId=e.target.addressId),
+                    params={"file": str(e.params.get("file", "")),
+                            "methodName": node.name,
+                            "oldAddress": node.addressId,
+                            "oldSymbol": node.symbolId,
+                            "blockHash": stable_hash_hex(block, n_hex=16)},
+                    guards={"exists": True},
+                    effects={"summary": f"inline {node.name}"},
+                    provenance=prov,
+                    op_id=deterministic_op_id(
+                        seed, base_rev, idx, "inlineMethod",
+                        e.target.symbolId, node.addressId, block),
+                ))
+            else:
+                continue
+            idx += 1
+            break
+    return ops
+
+
 def lift_statements(diffs, base_nodes, side_nodes, sources, files_pair,
                     *, base_rev: str, seed: str, side: str,
                     timestamp: str = EPOCH_ISO) -> List[Op]:
@@ -344,9 +473,13 @@ def lift_statements(diffs, base_nodes, side_nodes, sources, files_pair,
     ``sources`` reuses an already-built :func:`source_maps` pair;
     ``files_pair`` builds one lazily otherwise."""
     sm = sources or source_maps(*files_pair)
-    return statement_edits(base_nodes, side_nodes, sm, base_rev=base_rev,
+    stmt = statement_edits(base_nodes, side_nodes, sm, base_rev=base_rev,
                            seed=f"{seed}/{side}", timestamp=timestamp,
                            start_idx=len(diffs))
+    return stmt + body_motions(diffs, stmt, sm,
+                               base_rev=base_rev, seed=f"{seed}/{side}",
+                               timestamp=timestamp,
+                               start_idx=len(diffs) + len(stmt))
 
 
 def _op_id(seed: str, rev: str, idx: int, op_type: str, d: Diff) -> str:

@@ -18,12 +18,16 @@ join, immune to interleaving:
   to different results (requires ``editStmtBlock`` extraction —
   ``core.difflift.statement_edits``, enabled automatically in strict
   mode).
-
-The one remaining category, extract vs inline, gates on
-``extractMethod``/``inlineMethod`` extraction that no backend emits —
-body-motion detection across declarations is [SPEC] in the reference
-too (its requirements name the category, reference
-``requirements.md:98``, but its worker has no extractor).
+- **ExtractVsInline** — one side extracted a statement block into a
+  new declaration while the other inlined a declaration with that same
+  block (requires ``extractMethod``/``inlineMethod`` extraction —
+  ``core.difflift.body_motions``, enabled automatically in strict
+  mode). Joined on ``blockHash``, the content identity of the moved
+  statements. All six [CFR-002] categories are now implemented; the
+  reference names this one (reference ``requirements.md:98``) but its
+  worker has no extractor. The same pass applies [RES-004]: both sides
+  extracting the same block with identical bodies deduplicate to one
+  declaration (A's kept) instead of conflicting.
 
 Semantics: conflicting ops drop from both streams (the reference's
 DivergentRename drop semantics, generalized), the pre-pass runs before
@@ -43,7 +47,8 @@ from __future__ import annotations
 from typing import Dict, List, Tuple
 
 from .conflict import (Conflict, concurrent_stmt_edit_conflict,
-                       delete_vs_edit_conflict, divergent_rename_conflict)
+                       delete_vs_edit_conflict, divergent_rename_conflict,
+                       extract_vs_inline_conflict)
 from .ops import Op
 
 _EDIT_TYPES = ("renameSymbol", "moveDecl", "changeSignature",
@@ -61,6 +66,19 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
     drop_a: set = set()
     drop_b: set = set()
     conflicts: List[Conflict] = []
+
+    # Body-motion pass first (cross-symbol join on blockHash): an
+    # ExtractVsInline conflict consumes the motion's companion
+    # editStmtBlock/addDecl/deleteDecl ops too, so the per-symbol
+    # loops below must not re-report the same disagreement as
+    # ConcurrentStmtEdit or DeleteVsEdit. Consumption is tracked apart
+    # from the plain drop sets: ops dropped *within* a later loop keep
+    # their established pairing behavior.
+    consumed_a: set = set()
+    consumed_b: set = set()
+    _motion_pass(delta_a, delta_b, consumed_a, consumed_b, conflicts)
+    drop_a |= consumed_a
+    drop_b |= consumed_b
 
     for sym, ops_a in by_sym_a.items():
         ops_b = by_sym_b.get(sym)
@@ -98,6 +116,12 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
         stm_b = [op for op in ops_b if op.type == "editStmtBlock"]
         for op_a in stm_a:
             for op_b in stm_b:
+                # Skip only when the motion pass consumed BOTH sides —
+                # that pair IS the disagreement the motion conflict
+                # reported. One-sided consumption means the other
+                # side's differing edit is its own disagreement.
+                if id(op_a) in consumed_a and id(op_b) in consumed_b:
+                    continue
                 # Same decl (same address), bodies edited to different
                 # results; identical edits agree and pass through.
                 if (op_a.target.addressId == op_b.target.addressId
@@ -113,11 +137,15 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
         edit_b = [op for op in ops_b if op.type in _EDIT_TYPES]
         for op_del in del_a:
             for op_edit in edit_b:
+                if id(op_del) in consumed_a and id(op_edit) in consumed_b:
+                    continue
                 conflicts.append(delete_vs_edit_conflict(op_del, op_edit, "A"))
                 drop_a.add(id(op_del))
                 drop_b.add(id(op_edit))
         for op_del in del_b:
             for op_edit in edit_a:
+                if id(op_del) in consumed_b and id(op_edit) in consumed_a:
+                    continue
                 conflicts.append(delete_vs_edit_conflict(op_del, op_edit, "B"))
                 drop_b.add(id(op_del))
                 drop_a.add(id(op_edit))
@@ -125,6 +153,91 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
     kept_a = [op for op in delta_a if id(op) not in drop_a]
     kept_b = [op for op in delta_b if id(op) not in drop_b]
     return kept_a, kept_b, conflicts
+
+
+def _motion_pass(delta_a: List[Op], delta_b: List[Op],
+                 consumed_a: set, consumed_b: set,
+                 conflicts: List[Conflict]) -> None:
+    """ExtractVsInline detection plus the [RES-004] extract dedup.
+
+    Both rules join ``extractMethod``/``inlineMethod`` markers on
+    ``blockHash`` (the content identity of the moved statements), so
+    the pass is a cross-symbol join and runs before the per-symbol
+    loops. A firing rule consumes the marker AND its companion
+    text-level ops — the ``editStmtBlock`` on the source/host decl and
+    the ``addDecl``/``deleteDecl`` of the moved declaration — so the
+    disagreement surfaces exactly once, as the motion-level category."""
+    def motions(stream, kind):
+        return [op for op in stream if op.type == kind]
+
+    def companions(stream, motion):
+        out = [motion]
+        if motion.type == "extractMethod":
+            addr, decl_t = motion.params.get("newAddress"), "addDecl"
+        else:
+            addr, decl_t = motion.params.get("oldAddress"), "deleteDecl"
+        for op in stream:
+            # The motion op copied its Target verbatim from the source
+            # edit, so match on BOTH ids: structural symbolIds collide
+            # for same-shaped decls, and symbolId alone would swallow
+            # an unrelated decl's body edit.
+            if (op.type == "editStmtBlock"
+                    and op.target.symbolId == motion.target.symbolId
+                    and op.target.addressId == motion.target.addressId):
+                out.append(op)
+            elif op.type == decl_t and op.target.addressId == addr:
+                out.append(op)
+        return out
+
+    # ExtractVsInline: opposite motions of the same block across sides.
+    pairs = ([(e, i, "A") for e in motions(delta_a, "extractMethod")
+              for i in motions(delta_b, "inlineMethod")]
+             + [(e, i, "B") for e in motions(delta_b, "extractMethod")
+                for i in motions(delta_a, "inlineMethod")])
+    for ext, inl, side in pairs:
+        if (id(ext) in (consumed_a if side == "A" else consumed_b)
+                or id(inl) in (consumed_b if side == "A" else consumed_a)):
+            continue
+        if (not ext.params.get("blockHash")
+                or ext.params.get("blockHash") != inl.params.get("blockHash")):
+            continue
+        conflicts.append(extract_vs_inline_conflict(ext, inl, side))
+        ext_stream, ext_set = ((delta_a, consumed_a) if side == "A"
+                               else (delta_b, consumed_b))
+        inl_stream, inl_set = ((delta_b, consumed_b) if side == "A"
+                               else (delta_a, consumed_a))
+        for op in companions(ext_stream, ext):
+            ext_set.add(id(op))
+        for op in companions(inl_stream, inl):
+            inl_set.add(id(op))
+
+    # [RES-004]: both sides extracted the same block with identical
+    # bodies (blockHash equality IS body identity — the detector only
+    # fires on verbatim block membership) from the same source decl
+    # INTO the same name — keep A's new declaration, drop B's
+    # duplicate. Differently-named extracts are not duplicates (the
+    # residual bodies call different helpers; dropping B's declaration
+    # would orphan its callsite), and different bodies hash
+    # differently — both keep both declarations, per the rule.
+    for ea in motions(delta_a, "extractMethod"):
+        if id(ea) in consumed_a:
+            continue
+        for eb in motions(delta_b, "extractMethod"):
+            if id(eb) in consumed_b:
+                continue
+            if (ea.params.get("blockHash")
+                    and ea.params.get("blockHash") == eb.params.get("blockHash")
+                    and ea.target.symbolId == eb.target.symbolId
+                    # addressId too: structural symbolIds collide for
+                    # same-shaped decls, and "same source decl" must
+                    # mean the same base declaration, not a shape twin.
+                    and ea.target.addressId == eb.target.addressId
+                    and ea.params.get("newName") == eb.params.get("newName")):
+                consumed_b.add(id(eb))
+                addr = eb.params.get("newAddress")
+                for op in delta_b:
+                    if op.type == "addDecl" and op.target.addressId == addr:
+                        consumed_b.add(id(op))
 
 
 def _group(ops: List[Op]) -> Dict[str, List[Op]]:

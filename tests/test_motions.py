@@ -1,0 +1,233 @@
+"""Body-motion extraction (extractMethod / inlineMethod) and the
+[CFR-002] ExtractVsInline conflict + [RES-004] extract dedup.
+
+The reference names extract/inline in its op vocabulary (reference
+``requirements.md:52``) and gates a conflict category on them
+(``requirements.md:98``) but its worker emits neither; detection here
+is ``core.difflift.body_motions`` over the already-lifted evidence
+(added/deleted decls whose normalized brace block moved into or out of
+a body-edited decl). Fixtures keep each decl in its own file: position
+shifts would add the reference's spurious ``moveDecl`` quirk ops,
+which are orthogonal to what these tests pin.
+"""
+import json
+import subprocess
+
+from semantic_merge_tpu.backends.base import get_backend
+from semantic_merge_tpu.core.strict_conflicts import detect_conflicts_strict
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+
+TS = "2026-01-01T00:00:00Z"
+KW = dict(base_rev="r", seed="s", timestamp=TS, statement_ops=True)
+
+# Bodies avoid inner variable statements: the scanner indexes those as
+# decls too (reference buildIndex recursion), and a block moving between
+# functions would add the reference's spurious moveDecl for them.
+BIG = ("export function big(s: string): string"
+       " { return s.trim() + '!'; }\n")
+BIG_CALLS = "export function big(s: string): string { return helper(s, 0); }\n"
+# helper takes an extra param so its structural symbolId cannot collide
+# with big's (name-free signatures collide on shape, SURVEY §3.4).
+HELPER = ("export function helper(s: string, pad: number): string"
+          " { return s.trim() + '!'; }\n")
+
+UTIL = "export function util(s: string): string { return s.trim(); }\n"
+CALLER = ("export function caller(s: string, n: number): string"
+          " { return util(s); }\n")
+CALLER_INLINED = ("export function caller(s: string, n: number): string"
+                  " { return s.trim(); }\n")
+
+
+def _snap(**files):
+    return Snapshot(files=[{"path": p + ".ts", "content": c}
+                           for p, c in sorted(files.items())])
+
+
+BASE_EXTRACT = _snap(big=BIG)
+SIDE_EXTRACT = _snap(big=BIG_CALLS, helper=HELPER)
+
+BASE_INLINE = _snap(caller=CALLER, util=UTIL)
+SIDE_INLINE = _snap(caller=CALLER_INLINED, util="")
+
+
+def test_extract_detected():
+    ops = get_backend("host").diff(BASE_EXTRACT, SIDE_EXTRACT, **KW)
+    by_type = {o.type: o for o in ops}
+    assert set(by_type) == {"addDecl", "editStmtBlock", "extractMethod"}
+    ext = by_type["extractMethod"]
+    # The motion targets the SOURCE decl (big) and names the new one.
+    assert ext.target.symbolId == by_type["editStmtBlock"].target.symbolId
+    assert ext.params["newName"] == "helper"
+    assert ext.params["newAddress"] == by_type["addDecl"].target.addressId
+    assert ext.params["blockHash"]
+
+
+def test_inline_detected():
+    ops = get_backend("host").diff(BASE_INLINE, SIDE_INLINE, **KW)
+    by_type = {o.type: o for o in ops}
+    assert set(by_type) == {"deleteDecl", "editStmtBlock", "inlineMethod"}
+    inl = by_type["inlineMethod"]
+    assert inl.target.symbolId == by_type["editStmtBlock"].target.symbolId
+    assert inl.params["methodName"] == "util"
+    assert inl.params["oldAddress"] == by_type["deleteDecl"].target.addressId
+
+
+def test_motion_ids_deterministic():
+    a = get_backend("host").diff(BASE_EXTRACT, SIDE_EXTRACT, **KW)
+    b = get_backend("host").diff(BASE_EXTRACT, SIDE_EXTRACT, **KW)
+    assert [o.to_dict() for o in a] == [o.to_dict() for o in b]
+
+
+def test_no_motion_without_body_match():
+    # The added decl's body never lived in the edited decl: no marker.
+    side = _snap(
+        big="export function big(s: string): string { return 'x'; }\n",
+        helper=("export function helper(s: string, pad: number): string"
+                " { return 'fresh'; }\n"))
+    ops = get_backend("host").diff(BASE_EXTRACT, side, **KW)
+    assert not [o for o in ops if o.type == "extractMethod"]
+
+
+BLOCK = "{ return s.trim(); }"
+CVI_BASE = _snap(
+    big="export function big(s: string): string " + BLOCK + "\n",
+    util=("export function util(s: string, n: number): string "
+          + BLOCK + "\n"),
+    caller=("export function caller(s: string, n: number, b: boolean):"
+            " string { return util(s, 0); }\n"))
+# Branch A: extract big's block into a new decl (new file, no shifts).
+CVI_A = _snap(
+    big="export function big(s: string): string { return ex(s, 0, 0); }\n",
+    ex=("export function ex(s: string, x: number, y: number): string "
+        + BLOCK + "\n"),
+    util=("export function util(s: string, n: number): string "
+          + BLOCK + "\n"),
+    caller=("export function caller(s: string, n: number, b: boolean):"
+            " string { return util(s, 0); }\n"))
+# Branch B: inline util (same block text) into caller, delete util.
+CVI_B = _snap(
+    big="export function big(s: string): string " + BLOCK + "\n",
+    util="",
+    caller=("export function caller(s: string, n: number, b: boolean):"
+            " string " + BLOCK + "\n"))
+
+
+def test_extract_vs_inline_conflict():
+    bk = get_backend("host")
+    res = bk.build_and_diff(CVI_BASE, CVI_A, CVI_B, **KW)
+    assert [o.type for o in res.op_log_left].count("extractMethod") == 1
+    assert [o.type for o in res.op_log_right].count("inlineMethod") == 1
+    kept_a, kept_b, conflicts = detect_conflicts_strict(
+        res.op_log_left, res.op_log_right)
+    assert [c.category for c in conflicts] == ["ExtractVsInline"]
+    # The conflict consumes the motions AND their text-level companions;
+    # nothing about either motion leaks into the residual streams.
+    assert kept_a == [] and kept_b == []
+    d = conflicts[0].to_dict()
+    assert {s["id"] for s in d["suggestions"]} == {"keepExtract", "keepInline"}
+
+
+def test_res004_dedup_identical_extracts():
+    bk = get_backend("host")
+    res = bk.build_and_diff(BASE_EXTRACT, SIDE_EXTRACT, SIDE_EXTRACT, **KW)
+    kept_a, kept_b, conflicts = detect_conflicts_strict(
+        res.op_log_left, res.op_log_right)
+    assert conflicts == []
+    # A keeps its declaration; B's duplicate addDecl and marker drop.
+    assert [o.type for o in kept_a].count("addDecl") == 1
+    assert [o.type for o in kept_b].count("addDecl") == 0
+    assert [o.type for o in kept_b].count("extractMethod") == 0
+    # Identical residual body edits agree and pass through on both sides.
+    assert [o.type for o in kept_b].count("editStmtBlock") == 1
+
+
+def test_block_match_requires_identifier_boundaries():
+    # `return x + 1;` must not "match" inside `return max + 1;` — a raw
+    # substring check would mint a motion for code that never moved.
+    base = _snap(big=("export function big(m: number): number"
+                      " { const max = m; return max + 1; }\n"))
+    side = _snap(
+        big="export function big(m: number): number { return m; }\n",
+        helper=("export function helper(x: number, pad: number): number"
+                " { return x + 1; }\n"))
+    ops = get_backend("host").diff(base, side, **KW)
+    assert not [o for o in ops if o.type == "extractMethod"]
+
+
+def test_differently_named_extracts_do_not_dedup():
+    # Same block, same source decl, DIFFERENT new names: not duplicates.
+    # B's declaration must survive (its residual body calls it); the
+    # differing residual edits surface as ConcurrentStmtEdit instead of
+    # B's helper silently vanishing.
+    side_b = _snap(
+        big="export function big(s: string): string { return other(s, 0); }\n",
+        other=("export function other(s: string, pad: number): string"
+               " { return s.trim() + '!'; }\n"))
+    bk = get_backend("host")
+    res = bk.build_and_diff(BASE_EXTRACT, SIDE_EXTRACT, side_b, **KW)
+    kept_a, kept_b, conflicts = detect_conflicts_strict(
+        res.op_log_left, res.op_log_right)
+    assert [o.type for o in kept_a].count("addDecl") == 1
+    assert [o.type for o in kept_b].count("addDecl") == 1
+    assert any(c.category == "ConcurrentStmtEdit" for c in conflicts)
+
+
+def test_different_bodies_keep_both():
+    # [RES-004] second clause: concurrent extracts with DIFFERENT
+    # bodies keep both declarations — no dedup, no ExtractVsInline.
+    side_b = _snap(
+        big="export function big(s: string): string { return helper(s, 1); }\n",
+        helper=("export function helper(s: string, pad: number): string"
+                " { return s.trim(); }\n"))
+    bk = get_backend("host")
+    res = bk.build_and_diff(BASE_EXTRACT, SIDE_EXTRACT, side_b, **KW)
+    kept_a, kept_b, conflicts = detect_conflicts_strict(
+        res.op_log_left, res.op_log_right)
+    assert not [c for c in conflicts if c.category == "ExtractVsInline"]
+    assert [o.type for o in kept_a].count("addDecl") == 1
+    assert [o.type for o in kept_b].count("addDecl") == 1
+
+
+def test_backend_parity_motions():
+    """Host and TPU backends emit identical motion markers (shared
+    lift_statements tail)."""
+    import pytest
+    pytest.importorskip("jax")
+    rh = get_backend("host").diff(BASE_EXTRACT, SIDE_EXTRACT, **KW)
+    rt = get_backend("tpu").diff(BASE_EXTRACT, SIDE_EXTRACT, **KW)
+    assert [o.to_dict() for o in rh] == [o.to_dict() for o in rt]
+
+
+def test_cli_extract_vs_inline_end_to_end(tmp_path, monkeypatch):
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def write_snapshot(snap):
+        for f in snap.files:
+            (tmp_path / f["path"]).write_text(f["content"])
+
+    write_snapshot(CVI_BASE)
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "t@e")
+    git("config", "user.name", "t")
+    git("add", "-A")
+    git("commit", "-qm", "base")
+    git("branch", "basebr")
+    git("checkout", "-qb", "ba")
+    write_snapshot(CVI_A)
+    git("add", "-A")
+    git("commit", "-qam", "extract")
+    git("checkout", "-q", "main")
+    git("checkout", "-qb", "bb")
+    write_snapshot(CVI_B)  # util.ts emptied: scanner sees no decls
+    git("commit", "-qam", "inline")
+    git("checkout", "-q", "main")
+
+    monkeypatch.chdir(tmp_path)
+    from semantic_merge_tpu.cli import main
+    rc = main(["semmerge", "basebr", "ba", "bb", "--backend", "host",
+               "--strict-conflicts"])
+    assert rc == 1
+    payload = json.loads((tmp_path / ".semmerge-conflicts.json").read_text())
+    assert any(c["category"] == "ExtractVsInline" for c in payload)

@@ -124,6 +124,16 @@ def run_merge_to_payload(backend, base, left, right, phases=None):
     result, composed, conflicts = run_merge(backend, base, left, right,
                                             phases=phases)
     t0 = time.perf_counter()
+    # Serialize first: the notes payloads need only the two op streams,
+    # so under SEMMERGE_SPLIT_FETCH the composed view's chain columns
+    # keep streaming device→host during this work (the deferred-fetch
+    # pipeline seam). Identical deliverables either way; this is a
+    # schedule, not a shortcut.
+    n_bytes = serialize_payload(result)
+    if phases is not None:
+        phases["serialize"] = (phases.get("serialize", 0.0)
+                               + time.perf_counter() - t0)
+        t0 = time.perf_counter()
     # Consume the composed stream the way the CLI's applier does
     # (apply_ops iterates every op): on the device path this
     # materializes the lazy ComposedOpView, so BOTH paths pay for a
@@ -132,11 +142,6 @@ def run_merge_to_payload(backend, base, left, right, phases=None):
     if phases is not None:
         phases["compose_materialize"] = (phases.get("compose_materialize", 0.0)
                                          + time.perf_counter() - t0)
-        t0 = time.perf_counter()
-    n_bytes = serialize_payload(result)
-    if phases is not None:
-        phases["serialize"] = (phases.get("serialize", 0.0)
-                               + time.perf_counter() - t0)
     return result, composed, conflicts, n_bytes
 
 

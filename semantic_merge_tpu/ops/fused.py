@@ -336,10 +336,12 @@ def _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
     flat packing. Inputs here are full (replicated on every shard in
     the mesh case).
 
-    ``split=True`` returns ``(head, tail)`` instead of one vector —
-    byte-identical content, but the host can start async copies for
-    both and materialize the op streams (head) while the compose block
-    (tail) is still in flight through the device tunnel."""
+    ``split=True`` returns ``(head, mid, chains)`` instead of one
+    vector — byte-identical content, but the host can start async
+    copies for all three and materialize the op streams (head) while
+    the compose columns (mid) and chain overrides (chains) are still
+    in flight through the device tunnel; the chains are not awaited
+    until the composed view is actually read."""
     overflow = ((nopsL > C) | (nopsR > C)).astype(jnp.int32)
     colsL = _compose_cols(kL, aL, bL, wL, b_cols, l_cols, C)
     colsR = _compose_cols(kR, aR, bR, wR, b_cols, r_cols, C)
@@ -393,13 +395,20 @@ def _compose_and_pack(kL, aL, bL, wL, nopsL, kR, aR, bR, wR, nopsR,
         kR, aR, bR, as_i32(wR[:, 0]), as_i32(wR[:, 1]),
         as_i32(wR[:, 2]), as_i32(wR[:, 3]),
     ])
-    tail = jnp.concatenate([
-        a["op_index"], b["op_index"],
-        ref, c_addr, c_file, c_name,
-    ])
     if split:
-        return head, tail
-    return jnp.concatenate([head, tail])
+        # Three buffers, three independent device→host streams: the
+        # host needs `head` to materialize the op streams, `mid` for
+        # the composed order + (only when the candidate join fired)
+        # the conflict walk, and `chains` not until the composed view
+        # is actually read — so `chains` (6C of the 24C transfer) can
+        # stream through the tunnel while the host serializes op-log
+        # payloads off `head` (the PP seam of SURVEY §2.3, applied to
+        # the fetch).
+        mid = jnp.concatenate([a["op_index"], b["op_index"], ref])
+        chains = jnp.concatenate([c_addr, c_file, c_name])
+        return head, mid, chains
+    return jnp.concatenate([head, a["op_index"], b["op_index"],
+                            ref, c_addr, c_file, c_name])
 
 
 def _fused_merge_sharded_core(b_st, l_st, r_st, hash_tab, dig_l, dig_r,
@@ -618,13 +627,16 @@ class FusedMergeEngine:
         if phases is not None:
             phases["h2d"] = phases.get("h2d", 0.0) + time.perf_counter() - t0
 
-        # Split-fetch mode: the kernel returns (head, tail) so the host
-        # can materialize the op streams from head while the compose
-        # block is still streaming through the device tunnel. Opt-in —
-        # whether two pipelined fetches beat one packed fetch depends on
-        # the transport (measure on the target link before enabling).
+        # Split-fetch mode: the kernel returns (head, mid, chains) so
+        # the host can materialize the op streams from head — and
+        # serialize payloads off them — while the compose columns are
+        # still streaming through the device tunnel; the chain columns
+        # (6C of the 24C transfer) are not even awaited until the
+        # composed view is actually read. Opt-in — whether pipelined
+        # fetches beat one packed fetch depends on the transport
+        # (measure on the target link before enabling).
         split = os.environ.get("SEMMERGE_SPLIT_FETCH", "0") == "1"
-        flat = tail_dev = None
+        flat = mid_dev = chains_dev = None
         for _attempt in range(4):
             C = self._bucket(max(self._cap_hint, 8 * self._dp))
             t0 = time.perf_counter()
@@ -635,7 +647,8 @@ class FusedMergeEngine:
                 out_dev = _fused_merge_kernel(
                     dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
                     nb=nb, nl=nl, nr=nr, C=C, split=split)
-            head_dev, tail_dev = out_dev if split else (out_dev, None)
+            head_dev, mid_dev, chains_dev = (out_dev if split
+                                             else (out_dev, None, None))
             if overlap_work is not None:
                 # Dispatch is async: host-side work here rides along
                 # with the device execution.
@@ -643,13 +656,11 @@ class FusedMergeEngine:
                 overlap_work = None  # once per merge, not per retry
             if phases is not None:
                 head_dev.block_until_ready()
-                if tail_dev is not None:
-                    tail_dev.block_until_ready()
                 phases["kernel"] = (phases.get("kernel", 0.0)
                                     + time.perf_counter() - t0)
                 t0 = time.perf_counter()
             if split:
-                for d in (head_dev, tail_dev):
+                for d in (head_dev, mid_dev, chains_dev):
                     try:
                         d.copy_to_host_async()
                     except AttributeError:
@@ -696,27 +707,32 @@ class FusedMergeEngine:
             t0 = time.perf_counter()
 
         if split:
-            # The tail's device→host copy overlapped the head decode.
-            flat, off = np.asarray(tail_dev), 0
+            # The mid buffer's device→host copy overlapped the head
+            # decode; the chain buffer is not awaited here at all — its
+            # fetch+decode defer into the composed view (``chain_decode``
+            # phase), overlapping whatever the caller does first
+            # (typically serializing the op-log payloads off ``head``).
+            fm = np.asarray(mid_dev)
             if phases is not None:
                 phases["fetch"] = (phases.get("fetch", 0.0)
                                    + time.perf_counter() - t0)
                 t0 = time.perf_counter()
-        permL, permR = take(C), take(C)
-        ref, c_addr, c_file, c_name = (take(2 * C), take(2 * C),
-                                       take(2 * C), take(2 * C))
+            permL, permR = fm[:C], fm[C:2 * C]
+            ref = fm[2 * C:]
+            chain_cols = None
+        else:
+            permL, permR = take(C), take(C)
+            ref = take(2 * C)
+            chain_cols = (take(2 * C), take(2 * C), take(2 * C))
 
-        # One object-array gather per chain column (NULL_ID wraps to the
-        # mirror's trailing None); the mirror is cached on the interner.
-        table = self.interner.object_table()
         refs = ref[:n_out]
         sides_np = refs >> 30
         idxs_np = refs & ((1 << 30) - 1)
-        addr_o = table[c_addr[:n_out]]
-        file_o = table[c_file[:n_out]]
-        name_o = table[c_name[:n_out]]
+        table = self.interner.object_table()
 
         conflicts: List[Conflict] = []
+        ctx_writes: List[tuple] = []
+        keep = None
         if has_cand:
             # Columnar cursor walk: the reference's head-vs-head
             # DivergentRename walk reads only (precedence, is-rename,
@@ -755,6 +771,9 @@ class FusedMergeEngine:
                 # chains of *affected symbols only* are replayed in
                 # composed order (drops are always renames, so the
                 # addr/file chains from the device scan remain exact).
+                # Only the rename-context values touch the chain
+                # columns, and those are recorded as (pre-keep row,
+                # value) writes so the chain decode can stay deferred.
                 droppedL = np.asarray(sorted(int(pL[i]) for i in da))
                 droppedR = np.asarray(sorted(int(pR[j]) for j in db))
                 drop_mask = (((sides_np == 0)
@@ -778,15 +797,58 @@ class FusedMergeEngine:
                     sym = int(sym_row[i])
                     if kind_row[i] == KIND_RENAME:
                         ctx[sym] = table[newname_row[i]]
-                    name_o[i] = ctx.get(sym)
+                    ctx_writes.append((i, ctx.get(sym)))
                 keep = np.nonzero(~drop_mask)[0]
                 sides_np, idxs_np = sides_np[keep], idxs_np[keep]
-                addr_o, file_o = addr_o[keep], file_o[keep]
-                name_o = name_o[keep]
 
-        composed = ComposedOpView(sides_np.tolist(), idxs_np.tolist(),
-                                  addr_o.tolist(), file_o.tolist(),
-                                  name_o.tolist(), ops_l, ops_r)
+        n_pre = n_out  # pre-keep row count for the deferred gathers
+        # Bind just the interner: closing over `self` would pin the
+        # whole engine (device decl/byte-table caches) for the lifetime
+        # of any unread split-fetch composed view.
+        interner = self.interner
+
+        def decode_chains():
+            """Fetch (split mode) and decode the chain-override columns.
+            Runs inside the compose_decode window on the one-buffer
+            path; on the split path it runs at first composed-view
+            access — by which point the chain bytes have been streaming
+            host-ward since dispatch. ``object_table()`` is re-fetched
+            here because gathers must not be separated from the live
+            view (the interner may have grown since ``merge`` returned;
+            indices are append-only stable)."""
+            t1 = time.perf_counter()
+            if chain_cols is not None:
+                c_addr, c_file, c_name = chain_cols
+            else:
+                fc = np.asarray(chains_dev)
+                c_addr, c_file, c_name = (fc[:2 * C], fc[2 * C:4 * C],
+                                          fc[4 * C:])
+            # One object-array gather per chain column (NULL_ID wraps
+            # to the mirror's trailing None).
+            tbl = interner.object_table()
+            addr_o = tbl[c_addr[:n_pre]]
+            file_o = tbl[c_file[:n_pre]]
+            name_o = tbl[c_name[:n_pre]]
+            for i, v in ctx_writes:
+                name_o[i] = v
+            if keep is not None:
+                addr_o, file_o, name_o = addr_o[keep], file_o[keep], name_o[keep]
+            if phases is not None and split:
+                # On the one-buffer path this work already sits inside
+                # the compose_decode window; a separate key would
+                # double-count it.
+                phases["chain_decode"] = (phases.get("chain_decode", 0.0)
+                                          + time.perf_counter() - t1)
+            return addr_o.tolist(), file_o.tolist(), name_o.tolist()
+
+        if split:
+            composed = ComposedOpView.deferred(
+                sides_np.tolist(), idxs_np.tolist(), decode_chains,
+                ops_l, ops_r)
+        else:
+            addr_s, file_s, name_s = decode_chains()
+            composed = ComposedOpView(sides_np.tolist(), idxs_np.tolist(),
+                                      addr_s, file_s, name_s, ops_l, ops_r)
         if phases is not None:
             phases["compose_decode"] = (phases.get("compose_decode", 0.0)
                                         + time.perf_counter() - t0)

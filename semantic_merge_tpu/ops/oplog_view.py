@@ -415,7 +415,7 @@ class ComposedOpView(Sequence):
     path fed :func:`_materialize_decoded`."""
 
     __slots__ = ("sides", "idxs", "addr_s", "file_s", "name_s",
-                 "left", "right", "_all")
+                 "left", "right", "_all", "_chains_thunk")
 
     def __init__(self, sides: List[int], idxs: List[int],
                  addr_s: List[Optional[str]], file_s: List[Optional[str]],
@@ -429,6 +429,26 @@ class ComposedOpView(Sequence):
         self.left = left
         self.right = right
         self._all: Optional[List[Op]] = None
+        self._chains_thunk = None
+
+    @classmethod
+    def deferred(cls, sides: List[int], idxs: List[int], chains_thunk,
+                 left: OpStreamView, right: OpStreamView
+                 ) -> "ComposedOpView":
+        """A view whose chain-override columns are produced by
+        ``chains_thunk() -> (addr_s, file_s, name_s)`` at first op
+        access. The split-fetch fused merge uses this to leave the
+        chain columns streaming device→host while the caller works off
+        the op streams (e.g. serializing payloads); ``len()`` and the
+        row structure stay available without forcing the fetch."""
+        view = cls(sides, idxs, None, None, None, left, right)
+        view._chains_thunk = chains_thunk
+        return view
+
+    def _force_chains(self) -> None:
+        if self.addr_s is None:
+            self.addr_s, self.file_s, self.name_s = self._chains_thunk()
+            self._chains_thunk = None
 
     def __len__(self) -> int:
         return len(self.sides)
@@ -443,12 +463,14 @@ class ComposedOpView(Sequence):
             raise IndexError(i)
         if self._all is not None:
             return self._all[i]
+        self._force_chains()
         src = self.left if self.sides[i] == 0 else self.right
         return _materialize_decoded(src[self.idxs[i]], self.addr_s[i],
                                     self.file_s[i], self.name_s[i])
 
     def materialize(self) -> List[Op]:
         if self._all is None:
+            self._force_chains()
             if len(self) > 0:
                 from ..frontend.native import load_opfactory
                 fac = load_opfactory()

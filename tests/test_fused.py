@@ -243,10 +243,12 @@ def test_fused_two_way_diff_parity():
 
 
 def test_fused_split_fetch_parity(monkeypatch):
-    """SEMMERGE_SPLIT_FETCH=1 returns the packed result as (head, tail)
-    with pipelined device→host copies — content must be byte-identical
-    to the single-fetch mode, on both the single-device and dp-sharded
-    kernels, including a conflict workload."""
+    """SEMMERGE_SPLIT_FETCH=1 returns the packed result as
+    (head, mid, chains) with pipelined device→host copies and the chain
+    decode deferred into the composed view — content must be
+    byte-identical to the single-fetch mode, on both the single-device
+    and dp-sharded kernels, including a conflict workload (whose
+    rename-context patch rides the deferred decode)."""
     import jax
     import bench
     from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
@@ -270,6 +272,39 @@ def test_fused_split_fetch_parity(monkeypatch):
             assert [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_h]
             if divergent:
                 assert conf_t
+
+
+def test_split_fetch_deferred_chains_survive_interner_growth(monkeypatch):
+    """The deferred chain decode re-fetches the interner's object table
+    at access time: materializing a split-fetch composed view AFTER a
+    later merge has grown the interner must still decode the original
+    merge's chain overrides correctly (indices are append-only stable).
+    Serialization off the op streams must also work without forcing the
+    chain fetch — the overlap the split mode exists for."""
+    import bench
+    from semantic_merge_tpu.core.ops import OpLog
+
+    monkeypatch.setenv("SEMMERGE_SPLIT_FETCH", "1")
+    tpu = fused_backend()
+    host = get_backend("host")
+    base, left, right = bench.synth_repo(40, 3, divergent=True)
+    res_t, comp_t, _ = run_merge(tpu, base, left, right, seed="b",
+                                 base_rev="b",
+                                 timestamp="2026-01-01T00:00:00Z")
+    # Serialize payloads BEFORE touching the composed view (bench/CLI
+    # pipeline order); chains stay unfetched during this.
+    assert comp_t.addr_s is None
+    payload = OpLog(res_t.op_log_left).to_json_bytes()
+    assert payload and comp_t.addr_s is None
+    # A second, different merge grows the shared interner.
+    base2, left2, right2 = bench.synth_repo(25, 4)
+    run_merge(tpu, base2, left2, right2, seed="c", base_rev="c",
+              timestamp="2026-01-01T00:00:00Z")
+    # NOW materialize the first view — decode must be unaffected.
+    res_h, comp_h, _ = run_merge(host, base, left, right, seed="b",
+                                 base_rev="b",
+                                 timestamp="2026-01-01T00:00:00Z")
+    assert _dicts(comp_t) == _dicts(comp_h)
 
 
 def test_snapshot_encode_cache_no_stale_hits():

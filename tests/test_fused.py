@@ -242,19 +242,21 @@ def test_fused_two_way_diff_parity():
         assert _dicts(ops_t) == _dicts(ops_h)
 
 
-def test_fused_split_fetch_parity(monkeypatch):
-    """SEMMERGE_SPLIT_FETCH=1 returns the packed result as
+@pytest.mark.parametrize("split_env", ["1", "0"])
+def test_fused_split_fetch_parity(monkeypatch, split_env):
+    """Split-fetch (default) returns the packed result as
     (head, mid, chains) with pipelined device→host copies and the chain
     decode deferred into the composed view — content must be
-    byte-identical to the single-fetch mode, on both the single-device
-    and dp-sharded kernels, including a conflict workload (whose
-    rename-context patch rides the deferred decode)."""
+    byte-identical to the one-buffer mode (SEMMERGE_SPLIT_FETCH=0) and
+    to the host oracle, on both the single-device and dp-sharded
+    kernels, including a conflict workload (whose rename-context patch
+    rides the deferred decode)."""
     import jax
     import bench
     from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
     from semantic_merge_tpu.parallel.mesh import build_mesh
 
-    monkeypatch.setenv("SEMMERGE_SPLIT_FETCH", "1")
+    monkeypatch.setenv("SEMMERGE_SPLIT_FETCH", split_env)
     host = get_backend("host")
     mesh = build_mesh(jax.devices(), dp=8, pp=1, sp=1, tp=1, ep=1).mesh
     for tpu in (fused_backend(), TpuTSBackend(mesh=mesh)):

@@ -69,6 +69,24 @@ class TpuTSBackend:
         # node lists outside the decl cache's byte budget; cleared on
         # interner reset.
         self._snap_cache: "OrderedDict" = OrderedDict()
+        # symbolMaps payloads by snapshot identity: pure functions of
+        # the node list (~28 ms per 45k-decl revision to rebuild), so
+        # warm merges reuse them. Same lifecycle and immutability
+        # contract as the snapshot cache.
+        self._symmap_cache: "OrderedDict" = OrderedDict()
+
+    def _symbol_map_cached(self, nodes, key):
+        if key is not None:
+            hit = self._symmap_cache.get(key)
+            if hit is not None:
+                self._symmap_cache.move_to_end(key)
+                return hit
+        m = symbol_map(nodes)
+        if key is not None:
+            self._symmap_cache[key] = m
+            while len(self._symmap_cache) > 4:
+                self._symmap_cache.popitem(last=False)
+        return m
 
     def _fused_engine(self):
         from ..ops.fused import FusedMergeEngine
@@ -91,6 +109,7 @@ class TpuTSBackend:
             # Every snapshot-cache entry is keyed by the dead token and
             # can never hit again — drop them now, not by LRU attrition.
             self._snap_cache.clear()
+            self._symmap_cache.clear()
 
     def _scan_encode_keyed(self, snapshot: Snapshot):
         """Scan+encode, also returning the snapshot's stable identity
@@ -188,9 +207,9 @@ class TpuTSBackend:
                        statement_ops: bool = False) -> BuildAndDiffResult:
         ts = timestamp or EPOCH_ISO
         self._maybe_reset_interner()
-        base_t, base_nodes = self._scan_encode(base)
-        left_t, left_nodes = self._scan_encode(left)
-        right_t, right_nodes = self._scan_encode(right)
+        base_t, base_nodes, base_key = self._scan_encode_keyed(base)
+        left_t, left_nodes, left_key = self._scan_encode_keyed(left)
+        right_t, right_nodes, right_key = self._scan_encode_keyed(right)
         t_l, t_r = self._diff_pair_fn()(base_t, left_t, right_t)
         diffs_l = decode_diffs(t_l, base_t, left_t, base_nodes, left_nodes)
         diffs_r = decode_diffs(t_r, base_t, right_t, base_nodes, right_nodes)
@@ -219,9 +238,9 @@ class TpuTSBackend:
             op_log_right=lift(base_rev, diffs_r, seed=seed + "/R", timestamp=ts,
                               sources=src_r) + stmt_r,
             symbol_maps={
-                "base": symbol_map(base_nodes),
-                "left": symbol_map(left_nodes),
-                "right": symbol_map(right_nodes),
+                "base": self._symbol_map_cached(base_nodes, base_key),
+                "left": self._symbol_map_cached(left_nodes, left_key),
+                "right": self._symbol_map_cached(right_nodes, right_key),
             },
         )
 
@@ -313,9 +332,10 @@ class TpuTSBackend:
             maps: Dict[str, list] = {}
 
             def build_symbol_maps():
-                maps["base"] = symbol_map(base_nodes)
-                maps["left"] = symbol_map(left_nodes)
-                maps["right"] = symbol_map(right_nodes)
+                maps["base"] = self._symbol_map_cached(base_nodes, base_key)
+                maps["left"] = self._symbol_map_cached(left_nodes, left_key)
+                maps["right"] = self._symbol_map_cached(right_nodes,
+                                                        right_key)
 
             fused = self._fused_engine().merge(
                 base_t, base_key, base_nodes, left_t, left_key, left_nodes,

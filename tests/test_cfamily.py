@@ -263,3 +263,25 @@ def test_indexed_assignment_is_not_a_field():
     names = [n.name for n in nodes]
     assert "idx" not in names and "val" not in names
     assert "a" in names
+
+
+def test_java_body_motion_extract():
+    """Body-motion markers ride the shared lift_statements tail, so the
+    C-family backends get extract detection for free: a new Java method
+    whose body left an edited method emits extractMethod."""
+    base = Snapshot(files=[{"path": "src/A.java", "content":
+                            "class A { int work(int x) "
+                            "{ return x * 2 + 1; } }\n"}])
+    side = Snapshot(files=[
+        {"path": "src/A.java", "content":
+         "class A { int work(int x) { return help(x, 0); } }\n"},
+        {"path": "src/B.java", "content":
+         "class B { int help(int x, int pad) { return x * 2 + 1; } }\n"}])
+    backend = get_backend("java")
+    ops = backend.diff(base, side, base_rev="b", seed="s",
+                       timestamp="2026-01-01T00:00:00Z", statement_ops=True)
+    ext = [o for o in ops if o.type == "extractMethod"]
+    assert len(ext) == 1
+    assert ext[0].params["newName"] == "help"
+    edited = [o for o in ops if o.type == "editStmtBlock"]
+    assert edited and ext[0].target.symbolId == edited[0].target.symbolId

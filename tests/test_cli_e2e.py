@@ -214,6 +214,44 @@ def test_semrebase_replays_stored_oplog(repo):
     assert (repo / "b.ts").exists(), "brB's own file must survive the replay"
 
 
+def test_semrebase_replays_statement_ops_with_motion_markers(repo):
+    """A statement-ops merge stores editStmtBlock ops AND motion
+    markers (extractMethod) in notes; semrebase must replay the body
+    edits and skip the markers harmlessly (applier unknown-op
+    posture)."""
+    (repo / "big.ts").write_text(
+        "export function big(s: string): string { return s.trim() + '!'; }\n")
+    commit_all(repo, "base")
+    git(["branch", "basebr"], repo)
+    git(["checkout", "-qb", "brA"], repo)
+    (repo / "big.ts").write_text(
+        "export function big(s: string): string { return helper(s, 0); }\n")
+    (repo / "helper.ts").write_text(
+        "export function helper(s: string, pad: number): string"
+        " { return s.trim() + '!'; }\n")
+    commit_all(repo, "extract")
+    git(["checkout", "-q", "main"], repo)
+    git(["checkout", "-qb", "brB"], repo)
+    (repo / "other.ts").write_text("export function other(): void {}\n")
+    commit_all(repo, "side")
+    git(["checkout", "-q", "main"], repo)
+    # structured-apply attaches decl text payloads, so the replayed
+    # addDecl can create helper.ts (a payload-less addDecl degrades to
+    # a logged skip — the applier's documented posture).
+    rc = main(["semmerge", "basebr", "brA", "brB", "--backend", "host",
+               "--statement-ops", "--structured-apply"])
+    assert rc == 0
+    note = json.loads(subprocess.run(
+        ["git", "notes", "--ref", "semmerge", "show", "brA"], cwd=repo,
+        check=True, capture_output=True, text=True).stdout)
+    assert any(op["type"] == "extractMethod" for op in note)
+    # Replay brA's note (body edit + addDecl + marker) onto brB.
+    rc = main(["semrebase", "brA", "brB", "--inplace"])
+    assert rc == 0
+    assert "helper(s, 0)" in (repo / "big.ts").read_text()
+    assert (repo / "helper.ts").exists()
+
+
 def test_semrebase_without_note_fails_cleanly(repo):
     (repo / "a.ts").write_text("export function foo(): void {}\n")
     commit_all(repo, "base")

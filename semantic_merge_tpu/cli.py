@@ -325,13 +325,16 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
             finally:
                 _cleanup([base_tree])
             deleted_paths: list = []
+            text_written: list = []
             if config.engine.text_fallback:
                 # [FBK-001]: files outside the active backend's indexed
                 # set merge textually.
                 from .runtime.textmerge import apply_text_fallback
-                text_conflicts, deleted_paths = apply_text_fallback(
-                    merged_tree, base_tar, left_tar, right_tar,
-                    indexed_extensions=getattr(backend, "extensions", None))
+                text_conflicts, deleted_paths, text_written = \
+                    apply_text_fallback(
+                        merged_tree, base_tar, left_tar, right_tar,
+                        indexed_extensions=getattr(backend, "extensions",
+                                                   None))
                 tracer.count("text_conflicts", len(text_conflicts))
                 if text_conflicts:
                     _write_conflict_reports(text_conflicts)
@@ -341,8 +344,26 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
             formatter = None
             ts_cfg = config.languages.get("typescript")
             if ts_cfg and ts_cfg.formatter_cmd:
-                formatter = [*ts_cfg.formatter_cmd, "."]
-            emit_files(merged_tree, formatter)
+                formatter = list(ts_cfg.formatter_cmd)
+            touched = None
+            if config.engine.formatter_scope == "touched":
+                # Everything the merge wrote: the op stream's path
+                # params plus text-fallback writes of formatter-relevant
+                # (indexed) extensions — a text-merged notes.txt or
+                # binary must not reach prettier as an explicit arg.
+                # Untouched files keep their bytes.
+                from .runtime.applier import _normalize_relpath
+                exts = getattr(backend, "extensions", None)
+                touched = {str(_normalize_relpath(v))
+                           for op in composed
+                           for k in ("file", "oldFile", "newFile",
+                                     "oldPath", "newPath")
+                           if isinstance((v := op.params.get(k)), str) and v}
+                touched.update(
+                    str(_normalize_relpath(p)) for p in text_written
+                    if exts is None
+                    or pathlib.PurePosixPath(p).suffix in exts)
+            emit_files(merged_tree, formatter, paths=touched)
         with tracer.phase("typecheck"):
             if config.ci.require_typecheck:
                 ok, diagnostics = typecheck_ts(merged_tree)

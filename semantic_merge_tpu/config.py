@@ -73,6 +73,11 @@ class EngineConfig:
     # vocabulary, so this is opt-in).
     statement_ops: bool = False
     structured_apply: bool = False
+    # "tree" (parity: prettier runs over the whole merged tree, the
+    # reference's behavior) or "touched": format only files the merge
+    # actually wrote — untouched files keep their bytes (comment/format
+    # preservation for the 99% of a large repo a merge never visits).
+    formatter_scope: str = "tree"
     max_nodes_per_bucket: int = 2048
     mesh_shape: str = "auto"
     # Model-scored changeSignature pairing for renamed+retyped decls
@@ -150,6 +155,9 @@ def load_config(start: pathlib.Path | None = None) -> Config:
             engine.get("statement_ops", config.engine.statement_ops)),
         structured_apply=bool(
             engine.get("structured_apply", config.engine.structured_apply)),
+        formatter_scope=_validated(
+            str(engine.get("formatter_scope", config.engine.formatter_scope)),
+            "engine.formatter_scope", ("tree", "touched")),
         max_nodes_per_bucket=int(
             engine.get("max_nodes_per_bucket", config.engine.max_nodes_per_bucket)
         ),

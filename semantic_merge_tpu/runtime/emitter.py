@@ -15,12 +15,40 @@ from typing import Sequence
 
 from ..utils.loggingx import logger
 
-DEFAULT_FORMATTER = ("npx", "prettier", "--write", ".")
+import re
+
+#: Target-free: emit_files appends "." (tree mode) or the touched paths.
+DEFAULT_FORMATTER = ("npx", "prettier", "--write")
+
+#: fast-glob metacharacters prettier would interpret in an explicit
+#: path argument (e.g. Next.js route files like ``pages/[id].ts``).
+_GLOB_CHARS = re.compile(r"[*?\[\]{}()!]")
 
 
-def emit_files(tree_path: pathlib.Path, formatter_cmd: Sequence[str] | None = None) -> None:
+def emit_files(tree_path: pathlib.Path,
+               formatter_cmd: Sequence[str] | None = None,
+               paths: Sequence[str] | None = None) -> None:
+    """Format the merged tree. ``formatter_cmd`` is target-free (no
+    trailing ``.``). ``paths=None`` formats the whole tree (the
+    reference's behavior); a list formats only those files —
+    touched-scope mode (``[engine] formatter_scope = "touched"``), which
+    leaves every unvisited file byte-identical. An empty list skips the
+    formatter entirely. A touched path containing glob metacharacters
+    would be misread as a pattern by prettier, so such merges fall back
+    to whole-tree formatting rather than silently skipping the file."""
     tree_path = pathlib.Path(tree_path)
-    cmd = list(formatter_cmd) if formatter_cmd else list(DEFAULT_FORMATTER)
+    base_cmd = list(formatter_cmd) if formatter_cmd else list(DEFAULT_FORMATTER)
+    if paths is not None and any(_GLOB_CHARS.search(p) for p in paths):
+        logger.debug("touched path contains glob metacharacters; "
+                     "formatting the whole tree")
+        paths = None
+    if paths is not None:
+        existing = sorted(p for p in paths if (tree_path / p).is_file())
+        if not existing:
+            return
+        cmd = base_cmd + existing
+    else:
+        cmd = base_cmd + ["."]
     try:
         subprocess.run(cmd, cwd=tree_path, check=True,
                        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
@@ -28,3 +56,7 @@ def emit_files(tree_path: pathlib.Path, formatter_cmd: Sequence[str] | None = No
         logger.debug("Formatter %s not available; skipping", cmd[0])
     except subprocess.CalledProcessError as exc:
         logger.warning("Formatter exited with code %s", exc.returncode)
+    except OSError as exc:
+        # E2BIG on huge touched lists and friends — formatting never
+        # fails a merge ([FBK-003] posture).
+        logger.warning("Formatter could not run: %s", exc)

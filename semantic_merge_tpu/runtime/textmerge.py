@@ -46,14 +46,16 @@ def tar_file_map(tar_bytes: bytes) -> Dict[str, bytes]:
 def apply_text_fallback(merged_tree: pathlib.Path, base_tar: bytes,
                         left_tar: bytes, right_tar: bytes, *,
                         indexed_extensions=None,
-                        ) -> Tuple[List[Conflict], List[str]]:
+                        ) -> Tuple[List[Conflict], List[str], List[str]]:
     """Textually merge non-indexed files into ``merged_tree``.
 
     ``indexed_extensions`` is the *active backend's* extension set —
     only those files belong to the semantic pipeline; everything else
     (including other backends' languages) falls back to text merge.
-    Returns ``(conflicts, deleted_paths)``; the caller must propagate
-    deletions when copying the merged tree elsewhere (``--inplace``).
+    Returns ``(conflicts, deleted_paths, written_paths)``; the caller
+    must propagate deletions when copying the merged tree elsewhere
+    (``--inplace``), and ``written_paths`` feeds touched-scope
+    formatting.
     """
     merged_tree = pathlib.Path(merged_tree)
     indexed = (frozenset(indexed_extensions) if indexed_extensions is not None
@@ -64,6 +66,7 @@ def apply_text_fallback(merged_tree: pathlib.Path, base_tar: bytes,
 
     conflicts: List[Conflict] = []
     deleted: List[str] = []
+    written: List[str] = []
     paths = sorted((set(left) | set(right) | set(base)))
     for path in paths:
         if pathlib.PurePosixPath(path).suffix in indexed:
@@ -96,7 +99,8 @@ def apply_text_fallback(merged_tree: pathlib.Path, base_tar: bytes,
             continue  # already on disk from the base tree
         target.parent.mkdir(parents=True, exist_ok=True)
         target.write_bytes(resolved)
-    return conflicts, deleted
+        written.append(path)
+    return conflicts, deleted, written
 
 
 def _resolve(path: str, base: Optional[bytes], a: Optional[bytes],

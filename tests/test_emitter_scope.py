@@ -1,0 +1,111 @@
+"""Touched-scope formatting (`[engine] formatter_scope = "touched"`).
+
+The reference formats the WHOLE merged tree (prettier --write .,
+reference ``semmerge/emitter.py:14-25``) — every merge reformats files
+it never visited. Touched scope formats only what the merge wrote, so
+untouched files stay byte-identical; "tree" remains the parity default.
+"""
+import json
+import subprocess
+import sys
+
+from semantic_merge_tpu.runtime.emitter import emit_files
+
+RECORDER = """\
+import json, sys
+with open({log!r}, "a") as fh:
+    fh.write(json.dumps(sys.argv[1:]) + "\\n")
+"""
+
+
+def _recorder_cmd(tmp_path):
+    log = tmp_path / "fmt.log"
+    script = tmp_path / "rec.py"
+    script.write_text(RECORDER.format(log=str(log)))
+    return [sys.executable, str(script)], log
+
+
+def test_emit_files_paths_formats_only_listed(tmp_path):
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "a.ts").write_text("x\n")
+    (tree / "b.ts").write_text("y\n")
+    cmd, log = _recorder_cmd(tmp_path)
+    emit_files(tree, cmd, paths=["b.ts", "missing.ts"])
+    (args,) = [json.loads(line) for line in log.read_text().splitlines()]
+    # Touched mode appends the touched list instead of tree mode's ".";
+    # missing files are dropped rather than passed to the tool.
+    assert args == ["b.ts"]
+
+
+def test_emit_files_tree_mode_appends_dot(tmp_path):
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    cmd, log = _recorder_cmd(tmp_path)
+    emit_files(tree, cmd)
+    (args,) = [json.loads(line) for line in log.read_text().splitlines()]
+    assert args == ["."]
+
+
+def test_emit_files_empty_touched_skips_formatter(tmp_path):
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    cmd, log = _recorder_cmd(tmp_path)
+    emit_files(tree, cmd, paths=[])
+    assert not log.exists()
+
+
+def test_emit_files_glob_metachar_falls_back_to_tree(tmp_path):
+    # prettier reads explicit args as fast-glob patterns: a touched
+    # pages/[id].ts would match pages/i.ts instead of itself. Tree mode
+    # is the safe fallback.
+    tree = tmp_path / "tree"
+    (tree / "pages").mkdir(parents=True)
+    (tree / "pages" / "[id].ts").write_text("x\n")
+    cmd, log = _recorder_cmd(tmp_path)
+    emit_files(tree, cmd, paths=["pages/[id].ts"])
+    (args,) = [json.loads(line) for line in log.read_text().splitlines()]
+    assert args == ["."]
+
+
+def test_cli_touched_scope_end_to_end(tmp_path, monkeypatch):
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    cmd, log = _recorder_cmd(tmp_path)
+    (tmp_path / ".semmerge.toml").write_text(
+        "[engine]\nformatter_scope = \"touched\"\n"
+        "[languages.typescript]\nenabled = true\n"
+        f"formatter_cmd = {json.dumps(cmd)}\n")
+    (tmp_path / "touched.ts").write_text(
+        "export function foo(n: number): number { return n; }\n")
+    (tmp_path / "untouched.ts").write_text(
+        "export function other(s: string): string { return s; }\n")
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "t@e")
+    git("config", "user.name", "t")
+    git("add", "-A")
+    git("commit", "-qm", "base")
+    git("branch", "basebr")
+    git("checkout", "-qb", "ba")
+    (tmp_path / "touched.ts").write_text(
+        "export function bar(n: number): number { return n; }\n")
+    git("commit", "-qam", "rename")
+    git("checkout", "-q", "main")
+    git("checkout", "-qb", "bb")
+    (tmp_path / "notes.txt").write_text("text file both sides keep\nplus\n")
+    git("add", "-A")
+    git("commit", "-qm", "side")
+    git("checkout", "-q", "main")
+
+    monkeypatch.chdir(tmp_path)
+    from semantic_merge_tpu.cli import main
+    rc = main(["semmerge", "basebr", "ba", "bb", "--backend", "host"])
+    assert rc == 0
+    (args,) = [json.loads(line) for line in log.read_text().splitlines()]
+    assert "touched.ts" in args
+    assert "untouched.ts" not in args
+    # Text-fallback writes outside the backend's indexed extensions
+    # (notes.txt) must not reach the formatter as explicit args.
+    assert "notes.txt" not in args

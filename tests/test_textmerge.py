@@ -199,8 +199,9 @@ def test_one_sided_added_indexed_file_materializes(tmp_path):
     left = _tarb({"a.ts": "export function bar(): void {}\n"})
     right = _tarb({"a.ts": "export function foo(): void {}\n",
                    "b.ts": "export function extra(s: string): string { return s; }\n"})
-    conflicts, deleted = apply_text_fallback(merged, base, left, right)
+    conflicts, deleted, written = apply_text_fallback(merged, base, left, right)
     assert conflicts == [] and deleted == []
+    assert written == ["b.ts"]
     assert (merged / "b.ts").read_text().startswith("export function extra")
     # Indexed files the op pipeline already owns stay untouched.
     assert (merged / "a.ts").read_text() == "export function bar(): void {}\n"
@@ -216,5 +217,5 @@ def test_both_sided_divergent_added_indexed_file_conflicts(tmp_path):
     base = _tarb({})
     left = _tarb({"n.ts": "export const a = 1;\n"})
     right = _tarb({"n.ts": "export const a = 2;\n"})
-    conflicts, _ = apply_text_fallback(merged, base, left, right)
+    conflicts, _, _ = apply_text_fallback(merged, base, left, right)
     assert conflicts, "divergent both-sided add must conflict"

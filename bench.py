@@ -33,6 +33,8 @@ from semantic_merge_tpu.utils.jaxenv import enable_compile_cache  # noqa: E402
 enable_compile_cache()
 
 from semantic_merge_tpu.frontend.snapshot import Snapshot  # noqa: E402
+from semantic_merge_tpu.obs import metrics as obs_metrics  # noqa: E402
+from semantic_merge_tpu.obs import spans as obs_spans  # noqa: E402
 
 
 _SIG_TYPES = ("string", "number", "boolean", "bigint", "symbol", "object",
@@ -102,10 +104,10 @@ def synth_repo(n_files: int, decls_per_file: int, divergent: bool = False):
     return Snapshot(files=base), Snapshot(files=left), Snapshot(files=right)
 
 
-def run_merge(backend, base, left, right, phases=None):
+def run_merge(backend, base, left, right):
     from semantic_merge_tpu.backends.base import run_merge as _rm
     return _rm(backend, base, left, right, base_rev="bench", seed="bench",
-               timestamp="2026-01-01T00:00:00Z", phases=phases)
+               timestamp="2026-01-01T00:00:00Z")
 
 
 def serialize_payload(result) -> int:
@@ -120,29 +122,35 @@ def serialize_payload(result) -> int:
             + len(OpLog(result.op_log_right).to_json_bytes()))
 
 
-def run_merge_to_payload(backend, base, left, right, phases=None):
-    result, composed, conflicts = run_merge(backend, base, left, right,
-                                            phases=phases)
-    t0 = time.perf_counter()
+def run_merge_to_payload(backend, base, left, right):
+    result, composed, conflicts = run_merge(backend, base, left, right)
     # Serialize first: the notes payloads need only the two op streams,
     # so under SEMMERGE_SPLIT_FETCH the composed view's chain columns
     # keep streaming device→host during this work (the deferred-fetch
     # pipeline seam). Identical deliverables either way; this is a
     # schedule, not a shortcut.
-    n_bytes = serialize_payload(result)
-    if phases is not None:
-        phases["serialize"] = (phases.get("serialize", 0.0)
-                               + time.perf_counter() - t0)
-        t0 = time.perf_counter()
+    with obs_spans.span("serialize", layer="runtime"):
+        n_bytes = serialize_payload(result)
     # Consume the composed stream the way the CLI's applier does
     # (apply_ops iterates every op): on the device path this
     # materializes the lazy ComposedOpView, so BOTH paths pay for a
     # fully-realized composed op sequence inside the timed window.
-    composed = list(composed)
-    if phases is not None:
-        phases["compose_materialize"] = (phases.get("compose_materialize", 0.0)
-                                         + time.perf_counter() - t0)
+    with obs_spans.span("compose_materialize", layer="ops"):
+        composed = list(composed)
     return result, composed, conflicts, n_bytes
+
+
+def instrumented_phases(backend, base, left, right):
+    """One instrumented merge-to-payload run; per-phase wall-times come
+    from the shared obs metrics registry — the same spine the CLI's
+    ``--trace`` reads — so BENCH ``phases_ms`` and CLI trace artifacts
+    share one timing code path (no hand-rolled phase dicts). Activating
+    a SpanRecorder switches the fused engine into detailed mode (kernel
+    sync fences), exactly like a ``--trace`` CLI run."""
+    before = obs_metrics.phase_totals()
+    with obs_spans.activated(obs_spans.SpanRecorder()):
+        run_merge_to_payload(backend, base, left, right)
+    return obs_metrics.phase_totals_since(before)
 
 
 def time_merge(backend, base, left, right, *, repeats: int = 3) -> float:
@@ -390,9 +398,7 @@ def run_incremental_bench(record: dict, args, n_changed: int,
     t_full_dev = time_cold("tpu", base, left, right)
     t_full_host = time_cold("host", base, left, right)
 
-    phases: dict = {}
-    run_merge_to_payload(get_backend("tpu"), base_r, left_r, right_r,
-                         phases=phases)
+    phases = instrumented_phases(get_backend("tpu"), base_r, left_r, right_r)
 
     import jax
     platform = jax.devices()[0].platform
@@ -520,12 +526,11 @@ def main() -> int:
     )
 
     # Phase split (VERDICT r3 #1a): one instrumented warm merge per
-    # path. The fused device path reports scan_encode/h2d/kernel/fetch/
-    # materialize/compose_decode; the host path build_and_diff/compose.
-    tpu_phases: dict = {}
-    run_merge_to_payload(tpu, base, left, right, phases=tpu_phases)
-    host_phases: dict = {}
-    run_merge_to_payload(host, base, left, right, phases=host_phases)
+    # path, read back from the shared obs metrics registry. The fused
+    # device path reports scan_encode/h2d/kernel/fetch/materialize/
+    # compose_decode; the host path build_and_diff/compose.
+    tpu_phases = instrumented_phases(tpu, base, left, right)
+    host_phases = instrumented_phases(host, base, left, right)
 
     tpu_s = time_merge(tpu, base, left, right)
     host_s = time_merge(host, base, left, right)

@@ -1,0 +1,196 @@
+#!/usr/bin/env python3
+"""Validate semmerge observability artifacts against the documented
+schema (runbook.md, "Observability").
+
+Checks a ``.semmerge-trace.json`` trace artifact and (optionally) a
+``.semmerge-events.jsonl`` span/event stream. Run standalone::
+
+    python scripts/check_trace_schema.py .semmerge-trace.json \
+        [.semmerge-events.jsonl]
+
+Exit 0 when both conform, 1 with one line per violation otherwise. The
+tier-1 suite imports :func:`validate_trace` / :func:`validate_events`
+directly (``tests/test_trace_schema.py``), so trace-format drift fails
+CI before it reaches a consumer.
+
+Dependency-free on purpose: the schema IS this file plus the runbook
+table, not a jsonschema document that could drift separately.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, List
+
+SPAN_STATUS = ("ok", "error")
+
+#: Required keys of the trace artifact (``Tracer.to_dict``).
+TRACE_REQUIRED = ("schema", "phases", "counters", "total_seconds", "device")
+
+#: Required keys of one span row (trace ``spans[]`` / events ``type: span``).
+SPAN_REQUIRED = ("name", "t_start", "seconds", "depth", "span_id",
+                 "parent_id", "thread", "status", "meta")
+
+#: Required keys of the ``device`` telemetry block.
+DEVICE_REQUIRED = ("jax_imported", "platform", "device_count",
+                   "transfer_bytes", "transfer_count")
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_span(row: dict, where: str) -> List[str]:
+    errors = []
+    for key in SPAN_REQUIRED:
+        if key not in row:
+            errors.append(f"{where}: span missing key {key!r}")
+    if not isinstance(row.get("name"), str) or not row.get("name"):
+        errors.append(f"{where}: span name must be a non-empty string")
+    layer = row.get("layer")
+    if layer is not None and not isinstance(layer, str):
+        errors.append(f"{where}: span layer must be a string or null")
+    for key in ("t_start", "seconds"):
+        if key in row and (not _is_num(row[key]) or row[key] < 0):
+            errors.append(f"{where}: span {key} must be a number >= 0")
+    for key in ("depth", "span_id", "parent_id"):
+        if key in row and not isinstance(row[key], int):
+            errors.append(f"{where}: span {key} must be an int")
+    if row.get("depth", 0) < 0:
+        errors.append(f"{where}: span depth must be >= 0")
+    if "status" in row and row["status"] not in SPAN_STATUS:
+        errors.append(f"{where}: span status {row['status']!r} not in "
+                      f"{SPAN_STATUS}")
+    if "meta" in row and not isinstance(row["meta"], dict):
+        errors.append(f"{where}: span meta must be an object")
+    return errors
+
+
+def validate_metrics(data: Any, where: str = "metrics") -> List[str]:
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return [f"{where}: must be an object"]
+    for kind in ("counters", "gauges"):
+        for name, m in data.get(kind, {}).items():
+            for i, s in enumerate(m.get("series", [])):
+                if not isinstance(s.get("labels"), dict):
+                    errors.append(f"{where}.{kind}.{name}[{i}]: labels must "
+                                  f"be an object")
+                if not _is_num(s.get("value")):
+                    errors.append(f"{where}.{kind}.{name}[{i}]: value must "
+                                  f"be a number")
+    for name, m in data.get("histograms", {}).items():
+        buckets = m.get("buckets")
+        if (not isinstance(buckets, list) or not buckets
+                or sorted(buckets) != buckets):
+            errors.append(f"{where}.histograms.{name}: buckets must be a "
+                          f"sorted non-empty array")
+            continue
+        for i, s in enumerate(m.get("series", [])):
+            counts = s.get("counts")
+            if not isinstance(counts, list) or len(counts) != len(buckets) + 1:
+                errors.append(f"{where}.histograms.{name}[{i}]: counts must "
+                              f"have len(buckets)+1 entries")
+            elif sum(counts) != s.get("count"):
+                errors.append(f"{where}.histograms.{name}[{i}]: counts do "
+                              f"not sum to count")
+    return errors
+
+
+def validate_trace(data: Any) -> List[str]:
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["trace: top level must be a JSON object"]
+    for key in TRACE_REQUIRED:
+        if key not in data:
+            errors.append(f"trace: missing key {key!r}")
+    if "schema" in data and data["schema"] != 1:
+        errors.append(f"trace: unknown schema version {data['schema']!r}")
+    phases = data.get("phases", [])
+    if not isinstance(phases, list):
+        errors.append("trace: phases must be an array")
+        phases = []
+    for i, p in enumerate(phases):
+        if not isinstance(p, dict) or not isinstance(p.get("name"), str):
+            errors.append(f"trace: phases[{i}] needs a string name")
+            continue
+        if not _is_num(p.get("seconds")) or p["seconds"] < 0:
+            errors.append(f"trace: phases[{i}] seconds must be a number >= 0")
+        if "meta" in p and not isinstance(p["meta"], dict):
+            errors.append(f"trace: phases[{i}] meta must be an object")
+    if not isinstance(data.get("counters", {}), dict):
+        errors.append("trace: counters must be an object")
+    if "total_seconds" in data and not _is_num(data["total_seconds"]):
+        errors.append("trace: total_seconds must be a number")
+    device = data.get("device")
+    if device is not None:
+        if not isinstance(device, dict):
+            errors.append("trace: device must be an object")
+        else:
+            for key in DEVICE_REQUIRED:
+                if key not in device:
+                    errors.append(f"trace: device missing key {key!r}")
+    for i, row in enumerate(data.get("spans", [])):
+        errors.extend(validate_span(row, f"trace.spans[{i}]"))
+    if "metrics" in data:
+        errors.extend(validate_metrics(data["metrics"]))
+    return errors
+
+
+def validate_events(lines: List[str]) -> List[str]:
+    errors: List[str] = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        where = f"events line {i + 1}"
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError as exc:
+            errors.append(f"{where}: not valid JSON ({exc})")
+            continue
+        if not isinstance(row, dict):
+            errors.append(f"{where}: must be a JSON object")
+            continue
+        kind = row.get("type")
+        if kind == "span":
+            errors.extend(validate_span(row, where))
+        elif kind == "event":
+            if not isinstance(row.get("name"), str):
+                errors.append(f"{where}: event needs a string name")
+            if not _is_num(row.get("t_start")):
+                errors.append(f"{where}: event t_start must be a number")
+            if not isinstance(row.get("fields", {}), dict):
+                errors.append(f"{where}: event fields must be an object")
+        else:
+            errors.append(f"{where}: type must be 'span' or 'event', "
+                          f"got {kind!r}")
+    return errors
+
+
+def main(argv: List[str]) -> int:
+    if not argv or len(argv) > 2:
+        print(__doc__.strip().splitlines()[0])
+        print("usage: check_trace_schema.py TRACE_JSON [EVENTS_JSONL]",
+              file=sys.stderr)
+        return 2
+    errors: List[str] = []
+    try:
+        with open(argv[0], encoding="utf-8") as fh:
+            errors.extend(validate_trace(json.load(fh)))
+    except (OSError, json.JSONDecodeError) as exc:
+        errors.append(f"trace: unreadable ({exc})")
+    if len(argv) == 2:
+        try:
+            with open(argv[1], encoding="utf-8") as fh:
+                errors.extend(validate_events(fh.read().splitlines()))
+        except OSError as exc:
+            errors.append(f"events: unreadable ({exc})")
+    for err in errors:
+        print(err, file=sys.stderr)
+    if not errors:
+        print("ok")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

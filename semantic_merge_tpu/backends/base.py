@@ -22,6 +22,8 @@ from typing import Callable, Dict, List, Protocol
 
 from ..core.ops import Op
 from ..frontend.snapshot import Snapshot
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
 
 
 @dataclass
@@ -66,35 +68,47 @@ def run_merge(backend: Backend, base: Snapshot, left: Snapshot,
               right: Snapshot, *, base_rev: str = "base", seed: str = "0",
               timestamp: str | None = None, change_signature: bool = False,
               structured_apply: bool = False, signature_matcher=None,
-              statement_ops: bool = False,
-              phases: Dict | None = None):
+              statement_ops: bool = False):
     """Full 3-way merge through a backend: uses the backend's fused
     ``merge`` entry point when it has one (the TPU backend's
     one-round-trip program), otherwise ``build_and_diff`` + ``compose``.
+    Phase wall-times flow into :mod:`semantic_merge_tpu.obs` (spans +
+    the shared metrics registry) — the single timing spine both
+    ``--trace`` and ``bench.py`` read.
     Returns ``(BuildAndDiffResult, composed_ops, conflicts)``."""
+    name = getattr(backend, "name", "?")
     merge = getattr(backend, "merge", None)
     if merge is not None:
-        return merge(base, left, right, base_rev=base_rev, seed=seed,
-                     timestamp=timestamp, change_signature=change_signature,
-                     structured_apply=structured_apply,
-                     signature_matcher=signature_matcher,
-                     statement_ops=statement_ops, phases=phases)
-    import time
-    t0 = time.perf_counter()
-    result = backend.build_and_diff(
-        base, left, right, base_rev=base_rev, seed=seed, timestamp=timestamp,
-        change_signature=change_signature, structured_apply=structured_apply,
-        signature_matcher=signature_matcher, statement_ops=statement_ops)
-    if phases is not None:
-        phases["build_and_diff"] = (phases.get("build_and_diff", 0.0)
-                                    + time.perf_counter() - t0)
-        t0 = time.perf_counter()
-    compose = getattr(backend, "compose", None) or host_compose
-    composed, conflicts = compose(result.op_log_left, result.op_log_right)
-    if phases is not None:
-        phases["compose"] = (phases.get("compose", 0.0)
-                             + time.perf_counter() - t0)
-    return result, composed, conflicts
+        result, composed, conflicts = merge(
+            base, left, right, base_rev=base_rev, seed=seed,
+            timestamp=timestamp, change_signature=change_signature,
+            structured_apply=structured_apply,
+            signature_matcher=signature_matcher,
+            statement_ops=statement_ops)
+    else:
+        with obs_spans.span("build_and_diff", layer="backend", backend=name):
+            result = backend.build_and_diff(
+                base, left, right, base_rev=base_rev, seed=seed,
+                timestamp=timestamp, change_signature=change_signature,
+                structured_apply=structured_apply,
+                signature_matcher=signature_matcher,
+                statement_ops=statement_ops)
+        compose = getattr(backend, "compose", None) or host_compose
+        with obs_spans.span("compose", layer="backend", backend=name):
+            composed, conflicts = compose(result.op_log_left,
+                                          result.op_log_right)
+    reg = obs_metrics.REGISTRY
+    reg.counter("semmerge_merges_total",
+                "Three-way merges run, by backend").inc(1, backend=name)
+    reg.counter("semmerge_ops_total",
+                "Ops emitted by diff, by side").inc(
+        len(result.op_log_left), side="left")
+    reg.counter("semmerge_ops_total").inc(len(result.op_log_right),
+                                          side="right")
+    conflict_list = conflicts if isinstance(conflicts, list) else list(conflicts)
+    reg.counter("semmerge_conflicts_total",
+                "Merge conflicts surfaced").inc(len(conflict_list))
+    return result, composed, conflict_list
 
 
 def symbol_map(nodes) -> List[dict]:

@@ -27,6 +27,8 @@ from ..core.ops import Op
 from ..frontend.scanner import DeclNode, scan_snapshot_keyed
 from ..frontend.snapshot import Snapshot
 from ..frontend.snapshot import TS_EXTENSIONS
+from ..obs import device as obs_device
+from ..obs import spans as obs_spans
 from .ts_host import ts_files
 from ..ops.diff import (KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME,
                         DiffOpsTensor, diff_lift_device, diff_lift_device_pair)
@@ -47,6 +49,9 @@ class TpuTSBackend:
         # accelerator present) is a supported degraded mode, not an error.
         import jax
         devices = jax.devices()
+        # JAX is definitively up here: mirror compile/compile-cache
+        # monitoring into the shared metrics registry.
+        obs_device.ensure_jax_listeners()
         if mesh is None and len(devices) > 1:
             # Multi-chip: shard the merge kernels' decl/op axis over a
             # dp mesh by default (BASELINE north star: the file/decl
@@ -298,8 +303,7 @@ class TpuTSBackend:
               change_signature: bool = False,
               structured_apply: bool = False,
               signature_matcher=None,
-              statement_ops: bool = False,
-              phases: Dict | None = None):
+              statement_ops: bool = False):
         """Full 3-way merge in ONE device round trip when eligible (see
         :mod:`semantic_merge_tpu.ops.fused`): diff, deterministic op
         identity, and composition all stay on device; one compact fetch.
@@ -308,8 +312,8 @@ class TpuTSBackend:
         structured-apply, statement ops, or a changeSignature merge
         whose rows actually contain a foldable delete+add pair — fall
         back to the two-program path with identical observable output.
+        Phase timings flow through :mod:`semantic_merge_tpu.obs`.
         Returns ``(BuildAndDiffResult, composed_ops, conflicts)``."""
-        import time
         ts = timestamp or EPOCH_ISO
         self._maybe_reset_interner()
         if not structured_apply and not statement_ops:
@@ -320,13 +324,10 @@ class TpuTSBackend:
             # columnar-ly on the fetched rows below; the overwhelmingly
             # common no-candidate merge keeps the one-round-trip result
             # (its op stream is bit-identical to the refined one).
-            t0 = time.perf_counter()
-            base_t, base_nodes, base_key = self._scan_encode_keyed(base)
-            left_t, left_nodes, left_key = self._scan_encode_keyed(left)
-            right_t, right_nodes, right_key = self._scan_encode_keyed(right)
-            if phases is not None:
-                phases["scan_encode"] = (phases.get("scan_encode", 0.0)
-                                         + time.perf_counter() - t0)
+            with obs_spans.span("scan_encode", layer="frontend"):
+                base_t, base_nodes, base_key = self._scan_encode_keyed(base)
+                left_t, left_nodes, left_key = self._scan_encode_keyed(left)
+                right_t, right_nodes, right_key = self._scan_encode_keyed(right)
             # symbolMaps are independent host work — build them while
             # the device executes the fused program (pipeline staging).
             maps: Dict[str, list] = {}
@@ -337,11 +338,13 @@ class TpuTSBackend:
                 maps["right"] = self._symbol_map_cached(right_nodes,
                                                         right_key)
 
-            fused = self._fused_engine().merge(
-                base_t, base_key, base_nodes, left_t, left_key, left_nodes,
-                right_t, right_key, right_nodes,
-                seed=seed, base_rev=base_rev, timestamp=ts,
-                overlap_work=build_symbol_maps, phases=phases)
+            with obs_spans.span("fused_merge", layer="backend",
+                                backend=self.name):
+                fused = self._fused_engine().merge(
+                    base_t, base_key, base_nodes, left_t, left_key,
+                    left_nodes, right_t, right_key, right_nodes,
+                    seed=seed, base_rev=base_rev, timestamp=ts,
+                    overlap_work=build_symbol_maps)
             if fused is not None:
                 ops_l, ops_r, composed, conflicts = fused
                 if change_signature and (
@@ -357,22 +360,17 @@ class TpuTSBackend:
                         symbol_maps=maps,
                     )
                     return result, composed, conflicts
-        t0 = time.perf_counter()
-        result = self.build_and_diff(
-            base, left, right, base_rev=base_rev, seed=seed, timestamp=ts,
-            change_signature=change_signature,
-            structured_apply=structured_apply,
-            signature_matcher=signature_matcher,
-            statement_ops=statement_ops)
-        if phases is not None:
-            phases["build_and_diff"] = (phases.get("build_and_diff", 0.0)
-                                        + time.perf_counter() - t0)
-            t0 = time.perf_counter()
-        composed, conflicts = self.compose(result.op_log_left,
-                                           result.op_log_right)
-        if phases is not None:
-            phases["compose"] = (phases.get("compose", 0.0)
-                                 + time.perf_counter() - t0)
+        with obs_spans.span("build_and_diff", layer="backend",
+                            backend=self.name):
+            result = self.build_and_diff(
+                base, left, right, base_rev=base_rev, seed=seed, timestamp=ts,
+                change_signature=change_signature,
+                structured_apply=structured_apply,
+                signature_matcher=signature_matcher,
+                statement_ops=statement_ops)
+        with obs_spans.span("compose", layer="backend", backend=self.name):
+            composed, conflicts = self.compose(result.op_log_left,
+                                               result.op_log_right)
         return result, composed, conflicts
 
     def close(self) -> None:

@@ -99,6 +99,20 @@ def build_parser() -> argparse.ArgumentParser:
     p_rebase.add_argument("onto", help="Revision to replay onto")
     p_rebase.add_argument("--inplace", action="store_true")
 
+    p_stats = sub.add_parser("stats",
+                             help="Pretty-print a semmerge trace/metrics "
+                                  "artifact (.semmerge-trace.json, "
+                                  ".semmerge-events.jsonl, or a "
+                                  "SEMMERGE_METRICS dump)")
+    p_stats.add_argument("artifact", nargs="?", default=".semmerge-trace.json",
+                         help="Artifact path (default .semmerge-trace.json)")
+    p_stats.add_argument("--json", action="store_true",
+                         help="Emit the artifact back as JSON instead of "
+                              "the pretty rendering")
+    p_stats.add_argument("--prometheus", action="store_true",
+                         help="Render the artifact's metrics as Prometheus "
+                              "text exposition")
+
     p_train = sub.add_parser("train-matcher",
                              help="Train the decl-similarity matcher (orbax "
                                   "checkpoints; resumes from the latest)")
@@ -138,6 +152,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             return cmd_semrebase(args)
         if args.command == "train-matcher":
             return cmd_train_matcher(args)
+        if args.command == "stats":
+            return cmd_stats(args)
     except subprocess.CalledProcessError as exc:
         cmd = exc.cmd if isinstance(exc.cmd, str) else " ".join(map(str, exc.cmd))
         print(f"error: subprocess failed ({cmd}): exit {exc.returncode}", file=sys.stderr)
@@ -414,6 +430,117 @@ def cmd_semrebase(args: argparse.Namespace) -> int:
     finally:
         _cleanup([base_tree])
     return 0
+
+
+def cmd_stats(args: argparse.Namespace) -> int:
+    """Pretty-print an observability artifact: a ``.semmerge-trace.json``
+    trace, a ``.semmerge-events.jsonl`` span/event stream, or a metrics
+    registry dump (``SEMMERGE_METRICS=path``). Rendering reads only the
+    file — it works on artifacts from long-gone processes."""
+    path = pathlib.Path(args.artifact)
+    if not path.is_file():
+        print(f"error: no artifact at {path} (run `semmerge ... --trace` "
+              f"or set SEMMERGE_METRICS=path first)", file=sys.stderr)
+        return 1
+    text = path.read_text(encoding="utf-8")
+    if path.suffix == ".jsonl":
+        rows = [json.loads(line) for line in text.splitlines() if line.strip()]
+        data = {"events_jsonl": rows}
+    else:
+        data = json.loads(text)
+    if args.json:
+        print(json.dumps(data, indent=2))
+        return 0
+    if args.prometheus:
+        from .obs.metrics import render_prometheus_from_dict
+        metrics = data.get("metrics") if "metrics" in data else data
+        if not isinstance(metrics, dict) or not any(
+                k in metrics for k in ("counters", "gauges", "histograms")):
+            print("error: artifact carries no metrics section", file=sys.stderr)
+            return 1
+        print(render_prometheus_from_dict(metrics), end="")
+        return 0
+    try:
+        for line in _render_stats(data):
+            print(line)
+    except BrokenPipeError:  # stats | head is a normal way to read it
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+    return 0
+
+
+def _render_stats(data: dict) -> List[str]:
+    out: List[str] = []
+
+    def _spans_table(rows) -> None:
+        agg: dict = {}
+        for r in rows:
+            key = (r.get("layer") or "-", r["name"])
+            n, total = agg.get(key, (0, 0.0))
+            agg[key] = (n + 1, total + float(r.get("seconds", 0.0)))
+        out.append(f"{'layer':<10} {'span':<24} {'count':>5} {'total ms':>10}")
+        for (layer, name), (n, total) in sorted(
+                agg.items(), key=lambda kv: -kv[1][1]):
+            out.append(f"{layer:<10} {name:<24} {n:>5} {total * 1e3:>10.1f}")
+
+    if "events_jsonl" in data:  # .semmerge-events.jsonl
+        rows = data["events_jsonl"]
+        spans = [r for r in rows if r.get("type") == "span"]
+        events = [r for r in rows if r.get("type") == "event"]
+        out.append(f"events stream: {len(spans)} spans, {len(events)} events")
+        _spans_table(spans)
+        for e in events:
+            out.append(f"event {e.get('name')} @{e.get('t_start')}s "
+                       f"{e.get('fields', {})}")
+        return out
+
+    if "phases" in data:  # .semmerge-trace.json
+        out.append(f"trace (schema {data.get('schema', 0)}): "
+                   f"total {data.get('total_seconds', 0.0) * 1e3:.1f} ms")
+        out.append(f"{'phase':<24} {'ms':>10}  meta")
+        for p in data["phases"]:
+            out.append(f"{p['name']:<24} {p['seconds'] * 1e3:>10.1f}  "
+                       f"{p.get('meta', '')}")
+        counters = data.get("counters", {})
+        if counters:
+            out.append("counters: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(counters.items())))
+        spans = data.get("spans")
+        if spans:
+            out.append(f"spans ({len(spans)}):")
+            _spans_table(spans)
+        device = data.get("device")
+        if isinstance(device, dict):
+            out.append("device: " + "  ".join(
+                f"{k}={device[k]}" for k in sorted(device)
+                if not isinstance(device[k], (dict, list))))
+            for k in ("transfer_bytes", "transfer_count",
+                      "compile_cache_events"):
+                if device.get(k):
+                    out.append(f"device.{k}: " + "  ".join(
+                        f"{kk}={vv}" for kk, vv in sorted(device[k].items())))
+        return out
+
+    if any(k in data for k in ("counters", "gauges", "histograms")):
+        # SEMMERGE_METRICS registry dump.
+        for kind in ("counters", "gauges"):
+            for name, m in sorted(data.get(kind, {}).items()):
+                for s in m.get("series", []):
+                    labels = ",".join(f"{k}={v}" for k, v in
+                                      sorted(s.get("labels", {}).items()))
+                    out.append(f"{name}{{{labels}}} {s['value']}")
+        for name, m in sorted(data.get("histograms", {}).items()):
+            for s in m.get("series", []):
+                labels = ",".join(f"{k}={v}" for k, v in
+                                  sorted(s.get("labels", {}).items()))
+                out.append(f"{name}{{{labels}}} count={s['count']} "
+                           f"sum={s['sum']:.6f}")
+        return out
+
+    out.append("unrecognized artifact shape; try --json")
+    return out
 
 
 def cmd_train_matcher(args: argparse.Namespace) -> int:

@@ -44,6 +44,13 @@ from .ops import Op, Target
 
 
 def compose_oplogs(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List[Conflict]]:
+    from ..obs import spans as obs_spans
+    with obs_spans.span("compose_oplogs", layer="ops",
+                        n_a=len(delta_a), n_b=len(delta_b)):
+        return _compose_oplogs(delta_a, delta_b)
+
+
+def _compose_oplogs(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List[Conflict]]:
     ops_a = sorted(delta_a, key=Op.sort_key)
     ops_b = sorted(delta_b, key=Op.sort_key)
 

@@ -39,6 +39,8 @@ from dataclasses import dataclass
 from typing import Dict, Hashable, List, Sequence
 
 from ..core.ids import symbol_id_from_signature
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
 from .tokenizer import IDENT, PUNCT, Token, tokenize
 
 KIND_FUNCTION = "FunctionDeclaration"
@@ -136,13 +138,32 @@ def scan_snapshot_keyed(files: Sequence[dict]
     mean "no stable identity" (cache disabled)."""
     from .declcache import global_cache
     cache = global_cache()
+    hits0 = cache.hits if cache is not None else 0
+    with obs_spans.span("scan", layer="frontend", files=len(files)):
+        if cache is not None:
+            keyed = _scan_snapshot_cached(files, cache)
+        else:
+            from . import native  # local import: native binds against this module
+            nodes = native.try_scan_snapshot(files)
+            if nodes is None:
+                nodes = scan_snapshot_py(files)
+            keyed = _group_unkeyed(files, nodes)
+    reg = obs_metrics.REGISTRY
+    reg.counter("semmerge_files_scanned_total",
+                "Snapshot files handed to the decl scanner").inc(len(files))
+    reg.counter("semmerge_decls_indexed_total",
+                "Declarations indexed by the scanner").inc(
+        sum(len(nodes) for _, nodes in keyed))
     if cache is not None:
-        return _scan_snapshot_cached(files, cache)
-    from . import native  # local import: native binds against this module
-    nodes = native.try_scan_snapshot(files)
-    if nodes is None:
-        nodes = scan_snapshot_py(files)
-    return _group_unkeyed(files, nodes)
+        reg.counter("semmerge_decl_cache_hits_total",
+                    "Decl-cache hits during snapshot scans").inc(
+            cache.hits - hits0)
+        reg.gauge("semmerge_decl_cache_entries",
+                  "Cumulative decl-cache hit/miss counters of the "
+                  "process-wide cache").set(cache.hits, kind="hits")
+        reg.gauge("semmerge_decl_cache_entries").set(cache.misses,
+                                                     kind="misses")
+    return keyed
 
 
 def _group_unkeyed(files: Sequence[dict], nodes: List[DeclNode]):

@@ -53,12 +53,14 @@ def filter_files(snap: Snapshot, extensions) -> List[Dict[str, str]]:
 
 
 def snapshot_tree(root: pathlib.Path) -> Snapshot:
+    from ..obs import spans as obs_spans
     root = pathlib.Path(root)
     files = []
-    for path in sorted(root.rglob("*")):
-        if path.is_file() and path.suffix in SOURCE_EXTENSIONS:
-            files.append({
-                "path": path.relative_to(root).as_posix(),
-                "content": path.read_text(encoding="utf-8"),
-            })
+    with obs_spans.span("snapshot_tree", layer="frontend"):
+        for path in sorted(root.rglob("*")):
+            if path.is_file() and path.suffix in SOURCE_EXTENSIONS:
+                files.append({
+                    "path": path.relative_to(root).as_posix(),
+                    "content": path.read_text(encoding="utf-8"),
+                })
     return Snapshot(files=files)

@@ -1,0 +1,27 @@
+"""Unified observability layer (reference ``requirements.md:182``
+[NFR-OBS-002]; ``architecture.md:248-249``).
+
+One instrumentation spine for the whole merge pipeline, three pieces:
+
+- :mod:`~semantic_merge_tpu.obs.spans` — nestable, thread-safe spans
+  and events with monotonic wall-time, emitted as JSONL
+  (``.semmerge-events.jsonl``) and summarized into
+  ``.semmerge-trace.json``. The CLI ``Tracer`` is a thin adapter over a
+  :class:`~semantic_merge_tpu.obs.spans.SpanRecorder`.
+- :mod:`~semantic_merge_tpu.obs.metrics` — process-global counters,
+  gauges, and fixed-bucket histograms with labels; Prometheus text and
+  JSON rendering; ``SEMMERGE_METRICS=path`` exit dump. ``bench.py``
+  derives its ``phases_ms`` from this registry, so BENCH JSON and CLI
+  traces share one timing code path.
+- :mod:`~semantic_merge_tpu.obs.device` — JAX backend/platform capture,
+  compile-cache counters, host↔device transfer accounting, live-buffer
+  high-water marks; attached to the trace artifact.
+
+Import cost is intentionally trivial (stdlib only — no JAX, no numpy),
+so every layer can import ``obs`` at module top without touching the
+host path's cold-start budget.
+"""
+from . import device, metrics, spans  # noqa: F401
+from .metrics import REGISTRY, registry  # noqa: F401
+from .spans import (SpanRecorder, activate, activated, active,  # noqa: F401
+                    current, deactivate, event, record, span)

@@ -1,0 +1,148 @@
+"""Device telemetry — the accelerator-facing third of
+:mod:`semantic_merge_tpu.obs`.
+
+Captures, per run: the JAX backend/platform actually in use, compile
+activity and compilation-cache hits (via ``jax.monitoring`` listeners),
+host↔device transfer bytes/counts (recorded at this framework's own
+``device_put``/fetch call sites — the fused engine and CRDT paths), and
+live-device-buffer high-water marks. Everything lands in the shared
+metrics registry, and :func:`snapshot` summarizes it for the
+``.semmerge-trace.json`` artifact.
+
+Never imports JAX on its own: the CLI's host path deliberately avoids
+the multi-second JAX import, so :func:`snapshot` only reports device
+state when some other layer has already brought JAX up
+(``sys.modules`` probe). All listener installation is best-effort —
+``jax.monitoring`` is not a stable API, so a shape change degrades to
+"no compile counters", never to a broken merge.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, Optional
+
+from . import metrics
+
+_TRANSFER_BYTES = "semmerge_device_transfer_bytes_total"
+_TRANSFER_COUNT = "semmerge_device_transfers_total"
+_LIVE_BYTES_HWM = "semmerge_device_live_buffer_bytes_hwm"
+_COMPILE_CACHE = "semmerge_jax_compile_cache_events_total"
+_COMPILE_SECONDS = "semmerge_jax_compile_seconds_total"
+
+_listeners_installed = False
+
+
+def record_transfer(direction: str, nbytes: int, count: int = 1) -> None:
+    """Account one host↔device transfer. ``direction`` is ``"h2d"`` or
+    ``"d2h"``; call sites are this framework's own device_put/fetch
+    points, so the numbers measure the merge pipeline, not unrelated
+    JAX traffic."""
+    metrics.REGISTRY.counter(
+        _TRANSFER_BYTES, "Bytes moved between host and device by the "
+        "merge pipeline").inc(float(nbytes), direction=direction)
+    metrics.REGISTRY.counter(
+        _TRANSFER_COUNT, "Host<->device transfer operations"
+    ).inc(float(count), direction=direction)
+
+
+def update_live_buffer_hwm() -> Optional[int]:
+    """Refresh the live-device-buffer high-water mark from
+    ``jax.live_arrays()``. Costs a full live-array walk — call from
+    timed paths only when :func:`spans.active` (the Tracer/bench do).
+    Returns the current live byte count, or ``None`` without JAX."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        live = int(sum(getattr(a, "nbytes", 0) or 0
+                       for a in jax.live_arrays()))
+    except Exception:
+        return None
+    metrics.REGISTRY.gauge(
+        _LIVE_BYTES_HWM, "High-water mark of live device buffer bytes"
+    ).max(float(live))
+    return live
+
+
+def ensure_jax_listeners() -> None:
+    """Install ``jax.monitoring`` listeners that mirror compile-cache
+    hits/misses and compile wall-time into the registry. Idempotent;
+    call from code that has already imported JAX (the TPU backend's
+    constructor does)."""
+    global _listeners_installed
+    if _listeners_installed or "jax" not in sys.modules:
+        return
+    _listeners_installed = True
+    try:
+        from jax import monitoring as _mon
+
+        def _on_event(event: str, **kw) -> None:
+            if "compilation_cache" in event:
+                metrics.REGISTRY.counter(
+                    _COMPILE_CACHE, "jax compilation-cache events"
+                ).inc(1.0, event=event.rsplit("/", 1)[-1])
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if "compil" in event:
+                metrics.REGISTRY.counter(
+                    _COMPILE_SECONDS, "Cumulative JAX compile seconds"
+                ).inc(float(duration), event=event.rsplit("/", 1)[-1])
+
+        _mon.register_event_listener(_on_event)
+        _mon.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # monitoring API drift — degrade to no counters
+        pass
+
+
+def _counter_by_label(name: str, label: str) -> Dict[str, float]:
+    metric = metrics.REGISTRY.counter(name)
+    out: Dict[str, float] = {}
+    for key, value in metric._labelled():
+        out[dict(key).get(label, "?")] = float(value)
+    return out
+
+
+def snapshot() -> dict:
+    """One JSON-able record of device state for the trace artifact.
+
+    Shape is stable (every key always present) so downstream parsers
+    need no existence checks; fields that require JAX are ``None``/zero
+    when JAX was never imported by this process."""
+    out = {
+        "jax_imported": False,
+        "platform": None,
+        "device_count": 0,
+        "device_kinds": [],
+        "process_index": 0,
+        "process_count": 1,
+        "live_buffer_bytes": None,
+        "live_buffer_bytes_hwm": metrics.REGISTRY.gauge(
+            _LIVE_BYTES_HWM).value(),
+        "transfer_bytes": _counter_by_label(_TRANSFER_BYTES, "direction"),
+        "transfer_count": _counter_by_label(_TRANSFER_COUNT, "direction"),
+        "compile_cache_events": _counter_by_label(_COMPILE_CACHE, "event"),
+        "compile_seconds": _counter_by_label(_COMPILE_SECONDS, "event"),
+    }
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return out
+    try:
+        devices = jax.devices()
+        out.update(
+            jax_imported=True,
+            platform=jax.default_backend(),
+            device_count=len(devices),
+            device_kinds=sorted({getattr(d, "device_kind", "?")
+                                 for d in devices}),
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+    except Exception:
+        # A half-initialized runtime (failed plugin bring-up) must not
+        # take the trace artifact down with it.
+        out["jax_imported"] = True
+    live = update_live_buffer_hwm()
+    out["live_buffer_bytes"] = live
+    out["live_buffer_bytes_hwm"] = metrics.REGISTRY.gauge(
+        _LIVE_BYTES_HWM).value()
+    return out

@@ -1,0 +1,328 @@
+"""Process-global metrics registry.
+
+The shared numeric spine of the observability layer
+(:mod:`semantic_merge_tpu.obs`): counters, gauges, and fixed-bucket
+histograms with label support, renderable as Prometheus text exposition
+and as JSON. Every instrumented layer (frontend scanner, compose
+kernels, fused merge engine, parallel paths, backends, runtime applier)
+records here unconditionally — recording is a dict update under a lock,
+cheap enough to leave always-on — and three consumers read it:
+
+- ``bench.py`` derives its ``phases_ms`` from :func:`phase_totals`
+  deltas, so BENCH JSON and CLI ``--trace`` artifacts share one timing
+  code path instead of hand-rolled ``phases`` dicts;
+- the :class:`~semantic_merge_tpu.runtime.trace.Tracer` embeds
+  :meth:`Registry.to_dict` into ``.semmerge-trace.json``;
+- ``SEMMERGE_METRICS=path`` dumps the registry on interpreter exit
+  (JSON, or Prometheus text when the path ends in ``.prom``), and the
+  ``semmerge stats`` subcommand pretty-prints either form.
+
+Semantics follow the Prometheus data model: histogram buckets are
+cumulative upper bounds (a value lands in every bucket whose ``le`` it
+does not exceed), ``_sum``/``_count`` accompany each labeled series.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+#: Default bucket ladder for phase wall-times (seconds): sub-ms host
+#: hops up to the reference's 40 s cold-start budget.
+PHASE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 40.0)
+
+#: Byte-size ladder for transfer histograms.
+BYTE_BUCKETS = (256.0, 1024.0, 4096.0, 16384.0, 65536.0, 262144.0,
+                1048576.0, 4194304.0, 16777216.0, 67108864.0)
+
+
+def _label_key(labels: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: Dict[LabelKey, object] = {}
+
+    def _labelled(self) -> List[Tuple[LabelKey, object]]:
+        with self._lock:
+            return list(self._series.items())
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels: object) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def value(self, **labels: object) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: object) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def max(self, value: float, **labels: object) -> None:
+        """High-water-mark update: keep the larger of current/new."""
+        key = _label_key(labels)
+        with self._lock:
+            prev = self._series.get(key)
+            if prev is None or value > prev:
+                self._series[key] = float(value)
+
+    def value(self, **labels: object) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Iterable[float] = PHASE_BUCKETS) -> None:
+        super().__init__(name, help)
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        if not self.buckets:
+            raise ValueError(f"histogram {name!r} needs at least one bucket")
+
+    def observe(self, value: float, **labels: object) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                # counts has one slot per finite bucket plus +Inf.
+                series = {"counts": [0] * (len(self.buckets) + 1),
+                          "sum": 0.0, "count": 0}
+                self._series[key] = series
+            # Cumulative-upper-bound semantics: the first bucket whose
+            # bound is >= value owns the observation (bisect_left puts a
+            # value exactly on a bound INTO that bound's bucket).
+            series["counts"][bisect_left(self.buckets, value)] += 1
+            series["sum"] += value
+            series["count"] += 1
+
+    def sum(self, **labels: object) -> float:
+        with self._lock:
+            series = self._series.get(_label_key(labels))
+            return float(series["sum"]) if series else 0.0
+
+    def label_sums(self) -> Dict[LabelKey, float]:
+        with self._lock:
+            return {k: float(v["sum"]) for k, v in self._series.items()}
+
+
+class Registry:
+    """Named metric store. ``counter``/``gauge``/``histogram`` are
+    get-or-create: re-registering a name returns the existing metric
+    (a kind mismatch raises — two layers disagreeing about a metric's
+    type is a bug worth failing loudly on)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: "Dict[str, _Metric]" = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}, not {cls.kind}")
+                return existing
+            metric = cls(name, help, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Iterable[float] = PHASE_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def reset(self) -> None:
+        """Drop every metric — test isolation only."""
+        with self._lock:
+            self._metrics.clear()
+
+    def to_dict(self) -> dict:
+        """JSON form: the schema ``scripts/check_trace_schema.py``
+        validates and ``render_prometheus_from_dict`` renders — the
+        round-trip contract."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in self.metrics():
+            if isinstance(m, Histogram):
+                out["histograms"][m.name] = {
+                    "help": m.help,
+                    "buckets": list(m.buckets),
+                    "series": [
+                        {"labels": dict(key), "counts": list(s["counts"]),
+                         "sum": s["sum"], "count": s["count"]}
+                        for key, s in m._labelled()
+                    ],
+                }
+            else:
+                bucket = out["counters" if isinstance(m, Counter) else "gauges"]
+                bucket[m.name] = {
+                    "help": m.help,
+                    "series": [{"labels": dict(key), "value": v}
+                               for key, v in m._labelled()],
+                }
+        return out
+
+    def render_prometheus(self) -> str:
+        return render_prometheus_from_dict(self.to_dict())
+
+
+def _fmt_labels(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus_from_dict(data: dict) -> str:
+    """Prometheus text exposition (format 0.0.4) of a
+    :meth:`Registry.to_dict` payload. A module function (not a method)
+    so ``semmerge stats --prometheus`` can render archived artifacts
+    from processes long gone."""
+    lines: List[str] = []
+    for kind in ("counters", "gauges"):
+        for name in sorted(data.get(kind, ())):
+            m = data[kind][name]
+            if m.get("help"):
+                lines.append(f"# HELP {name} {m['help']}")
+            lines.append(f"# TYPE {name} {'counter' if kind == 'counters' else 'gauge'}")
+            for s in m["series"]:
+                lines.append(f"{name}{_fmt_labels(s['labels'])} "
+                             f"{_fmt_value(s['value'])}")
+    for name in sorted(data.get("histograms", ())):
+        m = data["histograms"][name]
+        if m.get("help"):
+            lines.append(f"# HELP {name} {m['help']}")
+        lines.append(f"# TYPE {name} histogram")
+        bounds = [_fmt_value(b) for b in m["buckets"]] + ["+Inf"]
+        for s in m["series"]:
+            cum = 0
+            for bound, count in zip(bounds, s["counts"]):
+                cum += count
+                le = 'le="%s"' % bound
+                lines.append(f"{name}_bucket{_fmt_labels(s['labels'], le)} "
+                             f"{cum}")
+            lines.append(f"{name}_sum{_fmt_labels(s['labels'])} "
+                         f"{_fmt_value(s['sum'])}")
+            lines.append(f"{name}_count{_fmt_labels(s['labels'])} "
+                         f"{s['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+#: The process-global registry every instrumented layer records into.
+REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    return REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# Phase timing — the spine shared by spans, --trace, and bench.py.
+
+PHASE_HISTOGRAM = "semmerge_phase_seconds"
+
+
+def observe_phase(name: str, seconds: float) -> None:
+    REGISTRY.histogram(
+        PHASE_HISTOGRAM, "Wall seconds per instrumented pipeline phase",
+        buckets=PHASE_BUCKETS).observe(seconds, phase=name)
+
+
+def phase_totals() -> Dict[str, float]:
+    """Cumulative wall seconds per phase name since process start."""
+    hist = REGISTRY.histogram(PHASE_HISTOGRAM,
+                              "Wall seconds per instrumented pipeline phase",
+                              buckets=PHASE_BUCKETS)
+    out: Dict[str, float] = {}
+    for key, total in hist.label_sums().items():
+        labels = dict(key)
+        out[labels.get("phase", "?")] = out.get(labels.get("phase", "?"),
+                                                0.0) + total
+    return out
+
+
+def phase_totals_since(before: Dict[str, float]) -> Dict[str, float]:
+    """Per-phase wall-seconds delta against a :func:`phase_totals`
+    snapshot — how ``bench.py`` scopes one instrumented merge out of a
+    process that has already run warmups and parity gates."""
+    now = phase_totals()
+    out = {}
+    for name, total in now.items():
+        delta = total - before.get(name, 0.0)
+        if delta > 0.0:
+            out[name] = delta
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Exit dump (SEMMERGE_METRICS=path)
+
+def dump(path: str) -> None:
+    """Write the registry to ``path``: Prometheus text when the name
+    ends in ``.prom``, JSON otherwise."""
+    if str(path).endswith(".prom"):
+        payload = REGISTRY.render_prometheus()
+    else:
+        payload = json.dumps(REGISTRY.to_dict(), indent=2)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(payload)
+
+
+def _install_exit_dump() -> None:
+    path = os.environ.get("SEMMERGE_METRICS")
+    if not path:
+        return
+
+    def _dump_at_exit() -> None:
+        try:
+            dump(path)
+        except OSError:  # dumping diagnostics must never mask an exit
+            pass
+
+    atexit.register(_dump_at_exit)
+
+
+_install_exit_dump()

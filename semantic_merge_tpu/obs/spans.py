@@ -1,0 +1,249 @@
+"""Structured spans and events — the timing layer of
+:mod:`semantic_merge_tpu.obs`.
+
+A :class:`SpanRecorder` collects nestable, thread-safe span records
+(monotonic wall-time, depth/parent links, ok/error status, free-form
+meta) and point events. One recorder can be *activated* process-wide;
+the module-level :func:`span` context manager then records into it from
+any layer without plumbing a handle through every call signature — the
+CLI ``Tracer`` activates one for ``--trace``/``--profile`` runs, and
+``bench.py`` activates one around its instrumented merge.
+
+Two always-on guarantees keep instrumentation writable in hot paths:
+
+- :func:`span` and :func:`record` feed the phase histogram of
+  :mod:`semantic_merge_tpu.obs.metrics` unconditionally (a dict update),
+  so cumulative per-phase timing exists even without a recorder;
+- full span records (nesting, meta, JSONL emission) are built only
+  while a recorder is active, so dark runs pay two ``perf_counter``
+  calls per span and nothing else.
+
+Code that needs *expensive* timing fences (``block_until_ready`` on
+device buffers) gates them on :func:`active` — detailed device phase
+splits exist exactly when someone asked for them.
+
+Artifacts: the recorder serializes to JSONL rows (``.semmerge-events.jsonl``,
+written by ``Tracer.write``) and to the ``spans`` array summarized into
+``.semmerge-trace.json``. Schemas are documented in ``runbook.md`` and
+enforced by ``scripts/check_trace_schema.py``.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import pathlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import metrics
+
+#: Default events artifact name (next to ``.semmerge-trace.json``).
+EVENTS_ARTIFACT = ".semmerge-events.jsonl"
+
+_state_lock = threading.Lock()
+_active: "Optional[SpanRecorder]" = None
+_tls = threading.local()
+
+
+@dataclass(slots=True)
+class SpanRecord:
+    """One completed span. ``t_start`` is seconds since the recorder's
+    epoch (monotonic clock); ``parent_id`` is ``-1`` for roots."""
+
+    name: str
+    layer: Optional[str]
+    t_start: float
+    seconds: float
+    depth: int
+    span_id: int
+    parent_id: int
+    thread: str
+    status: str  # "ok" | "error"
+    error: Optional[str]
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "layer": self.layer,
+            "t_start": round(self.t_start, 6),
+            "seconds": round(self.seconds, 6),
+            "depth": self.depth,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "thread": self.thread,
+            "status": self.status,
+            "error": self.error,
+            "meta": self.meta,
+        }
+
+
+class SpanRecorder:
+    """Thread-safe sink for spans and events of one observed run."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self.epoch = time.perf_counter()
+        self.spans: List[SpanRecord] = []
+        self.events: List[dict] = []
+
+    def _new_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    def _add_span(self, rec: SpanRecord) -> None:
+        with self._lock:
+            self.spans.append(rec)
+
+    def add_event(self, name: str, fields: Dict[str, Any]) -> None:
+        row = {"name": name, "t_start": round(time.perf_counter() - self.epoch, 6),
+               "thread": threading.current_thread().name, "fields": fields}
+        with self._lock:
+            self.events.append(row)
+
+    # -- views ------------------------------------------------------------
+
+    def span_dicts(self) -> List[dict]:
+        with self._lock:
+            return [s.to_dict() for s in
+                    sorted(self.spans, key=lambda s: s.t_start)]
+
+    def phase_totals(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        with self._lock:
+            for s in self.spans:
+                out[s.name] = out.get(s.name, 0.0) + s.seconds
+        return out
+
+    def layers(self) -> set:
+        with self._lock:
+            return {s.layer for s in self.spans if s.layer}
+
+    def event_rows(self) -> List[dict]:
+        """Every record as one JSONL-able row, time-ordered: spans carry
+        ``type: "span"``, point events ``type: "event"``."""
+        rows = [dict(s.to_dict(), type="span") for s in self.spans]
+        with self._lock:
+            rows += [dict(e, type="event") for e in self.events]
+        rows.sort(key=lambda r: r["t_start"])
+        return rows
+
+    def write_jsonl(self, path: pathlib.Path | str) -> None:
+        lines = [json.dumps(row, default=str) for row in self.event_rows()]
+        pathlib.Path(path).write_text("\n".join(lines) + ("\n" if lines else ""),
+                                      encoding="utf-8")
+
+
+# ---------------------------------------------------------------------------
+# Global activation
+
+def current() -> Optional[SpanRecorder]:
+    return _active
+
+
+def active() -> bool:
+    """True when a recorder is collecting — the gate for timing work
+    with side effects (device sync fences, ``jax.live_arrays`` walks)."""
+    return _active is not None
+
+
+def activate(recorder: SpanRecorder) -> None:
+    global _active
+    with _state_lock:
+        _active = recorder
+
+
+def deactivate(recorder: Optional[SpanRecorder] = None) -> None:
+    """Deactivate ``recorder`` (or whatever is active). A stale handle —
+    some other recorder has since been activated — is a no-op, so
+    overlapping Tracer lifetimes cannot clobber each other."""
+    global _active
+    with _state_lock:
+        if recorder is None or _active is recorder:
+            _active = None
+
+
+@contextlib.contextmanager
+def activated(recorder: SpanRecorder):
+    activate(recorder)
+    try:
+        yield recorder
+    finally:
+        deactivate(recorder)
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+# ---------------------------------------------------------------------------
+# Recording API
+
+@contextlib.contextmanager
+def span(name: str, layer: Optional[str] = None, **meta: Any):
+    """Time a block. Always feeds the phase histogram; records a full
+    :class:`SpanRecord` (with nesting links) when a recorder is active.
+    Exceptions propagate and mark the span ``status="error"``."""
+    rec = _active
+    frame = None
+    if rec is not None:
+        stack = _stack()
+        parent_id = stack[-1][1] if stack and stack[-1][0] is rec else -1
+        depth = sum(1 for r, _ in stack if r is rec)
+        frame = (rec, rec._new_id())
+        stack.append(frame)
+    status, error = "ok", None
+    t0 = time.perf_counter()
+    try:
+        yield
+    except BaseException as exc:
+        status, error = "error", type(exc).__name__
+        raise
+    finally:
+        dt = time.perf_counter() - t0
+        metrics.observe_phase(name, dt)
+        if frame is not None:
+            stack = _stack()
+            if frame in stack:
+                stack.remove(frame)
+            rec._add_span(SpanRecord(
+                name=name, layer=layer,
+                t_start=t0 - rec.epoch, seconds=dt,
+                depth=depth, span_id=frame[1], parent_id=parent_id,
+                thread=threading.current_thread().name,
+                status=status, error=error, meta=dict(meta)))
+
+
+def record(name: str, seconds: float, layer: Optional[str] = None,
+           **meta: Any) -> None:
+    """Record an already-measured duration as a span — for call sites
+    whose timing interleaves with retries or deferred work and cannot
+    be a ``with`` block (the fused engine's phase splits)."""
+    metrics.observe_phase(name, seconds)
+    rec = _active
+    if rec is None:
+        return
+    stack = _stack()
+    parent_id = stack[-1][1] if stack and stack[-1][0] is rec else -1
+    depth = sum(1 for r, _ in stack if r is rec)
+    rec._add_span(SpanRecord(
+        name=name, layer=layer,
+        t_start=max(time.perf_counter() - rec.epoch - seconds, 0.0),
+        seconds=seconds, depth=depth, span_id=rec._new_id(),
+        parent_id=parent_id, thread=threading.current_thread().name,
+        status="ok", error=None, meta=dict(meta)))
+
+
+def event(name: str, **fields: Any) -> None:
+    """Point event (no duration) — recorded only while a recorder is
+    active; use a metrics counter for always-on occurrence counts."""
+    rec = _active
+    if rec is not None:
+        rec.add_event(name, dict(fields))

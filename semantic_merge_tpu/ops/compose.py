@@ -327,13 +327,16 @@ def encode_compose_inputs(delta_a: List[Op], delta_b: List[Op],
 
 def compose_oplogs_device(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List[Conflict]]:
     """Device-composed twin of :func:`core.compose.compose_oplogs`."""
+    from ..obs import spans as obs_spans
     if not delta_a and not delta_b:
         return [], []
-    interner, ta, tb, na, nb = encode_compose_inputs(delta_a, delta_b)
-    out = np.asarray(_compose_kernel(
-        _pad_op_tensor(ta, na), _pad_op_tensor(tb, nb),
-        np.int32(ta.n), np.int32(tb.n), na, nb))
-    return decode_compose_output(out, delta_a, delta_b, interner, na, nb)
+    with obs_spans.span("compose_device", layer="ops",
+                        n_a=len(delta_a), n_b=len(delta_b)):
+        interner, ta, tb, na, nb = encode_compose_inputs(delta_a, delta_b)
+        out = np.asarray(_compose_kernel(
+            _pad_op_tensor(ta, na), _pad_op_tensor(tb, nb),
+            np.int32(ta.n), np.int32(tb.n), na, nb))
+        return decode_compose_output(out, delta_a, delta_b, interner, na, nb)
 
 
 def decode_compose_output(out: np.ndarray, delta_a: List[Op], delta_b: List[Op],

@@ -62,6 +62,9 @@ import numpy as np
 from ..core.conflict import Conflict, divergent_rename_conflict
 from ..core.encode import NULL_ID, PAD_ID, DeclTensor, Interner, bucket_size, pad_to
 from ..core.ops import Op
+from ..obs import device as obs_device
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
 from .compose import (_PAD_PREC, _local_seg_scan,
                       _rename_candidate_query, _rename_candidate_tables,
                       _rename_pairs, _sort_perm)
@@ -125,6 +128,7 @@ class DeviceStrings:
             self._n_hashed = n
         if self._dev is None:
             self._dev = self._put(self._host)
+            obs_device.record_transfer("h2d", self._host.nbytes)
             self._n_dev = n
         elif n > self._n_dev:
             # Ship only the delta, padded to a bucket-ladder row count
@@ -132,10 +136,12 @@ class DeviceStrings:
             rows = bucket_size(n - self._n_dev, minimum=8)
             if self._n_dev + rows > self.cap:
                 self._dev = self._put(self._host)
+                obs_device.record_transfer("h2d", self._host.nbytes)
             else:
                 upd = self._host[self._n_dev:self._n_dev + rows]
                 self._dev = _dev_update2(self._dev, upd,
                                          np.int32(self._n_dev))
+                obs_device.record_transfer("h2d", upd.nbytes)
             self._n_dev = n
         return self._dev
 
@@ -548,6 +554,7 @@ class FusedMergeEngine:
             entry = (jax.device_put(stacked, self._decl_sharding), bucket)
         else:
             entry = (jax.device_put(stacked), bucket)
+        obs_device.record_transfer("h2d", stacked.nbytes)
         if identity is not None:
             self._decl_cache[identity] = entry
             while len(self._decl_cache) > 12:
@@ -575,6 +582,7 @@ class FusedMergeEngine:
             C = self._bucket(max(self._cap_hint, 8))
             flat = np.asarray(_fused_diff_kernel(
                 dev_b, dev_s, hash_tab, dig, nb=nb, ns=ns, C=C))
+            obs_device.record_transfer("d2h", flat.nbytes)
             n_ops = int(flat[0])
             if not flat[1]:
                 break
@@ -598,7 +606,7 @@ class FusedMergeEngine:
               left_t: DeclTensor, left_key, left_nodes,
               right_t: DeclTensor, right_key, right_nodes,
               *, seed: str, base_rev: str, timestamp: str,
-              overlap_work=None, phases: Dict | None = None
+              overlap_work=None
               ) -> Optional[Tuple[List[Op], List[Op], List[Op], List[Conflict]]]:
         """Run the one-round-trip merge; ``None`` only when the op
         capacity retries exhaust — the caller falls back to the
@@ -611,10 +619,18 @@ class FusedMergeEngine:
         pipeline-staging seam (SURVEY §2.3 PP): the caller's
         independent host work (e.g. symbolMaps construction) overlaps
         device compute instead of serializing after it.
+
+        Detailed phase splits (h2d/kernel/fetch/materialize/
+        compose_decode) are recorded through
+        :mod:`semantic_merge_tpu.obs` only while a span recorder is
+        active (``--trace`` / bench instrumented runs): the kernel
+        split needs a ``block_until_ready`` fence that would otherwise
+        serialize the dispatch/fetch overlap this path exists for.
         """
         import time
 
         from ..core.ids import op_id_prefix_digest
+        detailed = obs_spans.active()
         t0 = time.perf_counter()
         hash_tab = self.strings.sync()
         dig_l = np.frombuffer(op_id_prefix_digest(seed + "/L", base_rev),
@@ -624,8 +640,8 @@ class FusedMergeEngine:
         dev_b, nb = self._device_decl(base_t, base_key)
         dev_l, nl = self._device_decl(left_t, left_key)
         dev_r, nr = self._device_decl(right_t, right_key)
-        if phases is not None:
-            phases["h2d"] = phases.get("h2d", 0.0) + time.perf_counter() - t0
+        if detailed:
+            obs_spans.record("h2d", time.perf_counter() - t0, layer="ops")
 
         # Split-fetch mode: the kernel returns (head, mid, chains) so
         # the host can materialize the op streams from head — and
@@ -656,10 +672,10 @@ class FusedMergeEngine:
                 # with the device execution.
                 overlap_work()
                 overlap_work = None  # once per merge, not per retry
-            if phases is not None:
+            if detailed:
                 head_dev.block_until_ready()
-                phases["kernel"] = (phases.get("kernel", 0.0)
-                                    + time.perf_counter() - t0)
+                obs_spans.record("kernel", time.perf_counter() - t0,
+                                 layer="ops")
                 t0 = time.perf_counter()
             if split:
                 for d in (head_dev, mid_dev, chains_dev):
@@ -668,9 +684,10 @@ class FusedMergeEngine:
                     except AttributeError:
                         pass
             flat = np.asarray(head_dev)
-            if phases is not None:
-                phases["fetch"] = (phases.get("fetch", 0.0)
-                                   + time.perf_counter() - t0)
+            obs_device.record_transfer("d2h", flat.nbytes)
+            if detailed:
+                obs_spans.record("fetch", time.perf_counter() - t0,
+                                 layer="ops")
             n_l, n_r = int(flat[0]), int(flat[1])
             if not flat[4]:  # no overflow
                 break
@@ -703,9 +720,9 @@ class FusedMergeEngine:
                              base_nodes, right_nodes, prov,
                              base_tbl_ref=base_ref,
                              side_tbl_ref=(self._tbl_cache, right_key))
-        if phases is not None:
-            phases["materialize"] = (phases.get("materialize", 0.0)
-                                     + time.perf_counter() - t0)
+        if detailed:
+            obs_spans.record("materialize", time.perf_counter() - t0,
+                             layer="ops")
             t0 = time.perf_counter()
 
         if split:
@@ -715,9 +732,10 @@ class FusedMergeEngine:
             # phase), overlapping whatever the caller does first
             # (typically serializing the op-log payloads off ``head``).
             fm = np.asarray(mid_dev)
-            if phases is not None:
-                phases["fetch"] = (phases.get("fetch", 0.0)
-                                   + time.perf_counter() - t0)
+            obs_device.record_transfer("d2h", fm.nbytes)
+            if detailed:
+                obs_spans.record("fetch", time.perf_counter() - t0,
+                                 layer="ops")
                 t0 = time.perf_counter()
             permL, permR = fm[:C], fm[C:2 * C]
             ref = fm[2 * C:]
@@ -823,6 +841,7 @@ class FusedMergeEngine:
                 c_addr, c_file, c_name = chain_cols
             else:
                 fc = np.asarray(chains_dev)
+                obs_device.record_transfer("d2h", fc.nbytes)
                 c_addr, c_file, c_name = (fc[:2 * C], fc[2 * C:4 * C],
                                           fc[4 * C:])
             # One object-array gather per chain column (NULL_ID wraps
@@ -835,12 +854,12 @@ class FusedMergeEngine:
                 name_o[i] = v
             if keep is not None:
                 addr_o, file_o, name_o = addr_o[keep], file_o[keep], name_o[keep]
-            if phases is not None and split:
+            if detailed and split:
                 # On the one-buffer path this work already sits inside
                 # the compose_decode window; a separate key would
                 # double-count it.
-                phases["chain_decode"] = (phases.get("chain_decode", 0.0)
-                                          + time.perf_counter() - t1)
+                obs_spans.record("chain_decode", time.perf_counter() - t1,
+                                 layer="ops")
             return addr_o.tolist(), file_o.tolist(), name_o.tolist()
 
         if split:
@@ -851,7 +870,16 @@ class FusedMergeEngine:
             addr_s, file_s, name_s = decode_chains()
             composed = ComposedOpView(sides_np.tolist(), idxs_np.tolist(),
                                       addr_s, file_s, name_s, ops_l, ops_r)
-        if phases is not None:
-            phases["compose_decode"] = (phases.get("compose_decode", 0.0)
-                                        + time.perf_counter() - t0)
+        if detailed:
+            obs_spans.record("compose_decode", time.perf_counter() - t0,
+                             layer="ops")
+            obs_device.update_live_buffer_hwm()
+        reg = obs_metrics.REGISTRY
+        reg.counter("semmerge_composed_ops_total",
+                    "Composed ops emitted by the fused merge path").inc(
+            len(sides_np))
+        if conflicts:
+            reg.counter("semmerge_fused_conflicts_total",
+                        "DivergentRename conflicts from the fused path"
+                        ).inc(len(conflicts))
         return ops_l, ops_r, composed, conflicts

@@ -333,25 +333,29 @@ def _bucket(n: int, k: int) -> int:
 def diff_lift_device_sharded(base: DeclTensor, side: DeclTensor,
                              mesh: Mesh) -> DiffOpsTensor:
     """Mesh twin of :func:`semantic_merge_tpu.ops.diff.diff_lift_device`."""
+    from ..obs import spans as obs_spans
     k = _dp_size(mesh)
     nb, ns = _bucket(base.n, k), _bucket(side.n, k)
-    fn = _sharded_diff_fn(mesh, nb, ns, k)
-    out = np.asarray(fn(*_padded_cols(base, nb), *_padded_cols(side, ns)))
-    return _decode_stacked(out)
+    with obs_spans.span("diff_sharded", layer="ops", shards=k):
+        fn = _sharded_diff_fn(mesh, nb, ns, k)
+        out = np.asarray(fn(*_padded_cols(base, nb), *_padded_cols(side, ns)))
+        return _decode_stacked(out)
 
 
 def diff_lift_device_pair_sharded(base: DeclTensor, left: DeclTensor,
                                   right: DeclTensor, mesh: Mesh
                                   ) -> tuple[DiffOpsTensor, DiffOpsTensor]:
     """Mesh twin of :func:`semantic_merge_tpu.ops.diff.diff_lift_device_pair`."""
+    from ..obs import spans as obs_spans
     k = _dp_size(mesh)
     nb = _bucket(base.n, k)
     nl = _bucket(left.n, k)
     nr = _bucket(right.n, k)
-    fn = _sharded_diff_pair_fn(mesh, nb, nl, nr, k)
-    out = np.asarray(fn(*_padded_cols(base, nb), *_padded_cols(left, nl),
-                        *_padded_cols(right, nr)))
-    return _decode_stacked(out[0]), _decode_stacked(out[1])
+    with obs_spans.span("diff_pair_sharded", layer="ops", shards=k):
+        fn = _sharded_diff_pair_fn(mesh, nb, nl, nr, k)
+        out = np.asarray(fn(*_padded_cols(base, nb), *_padded_cols(left, nl),
+                            *_padded_cols(right, nr)))
+        return _decode_stacked(out[0]), _decode_stacked(out[1])
 
 
 # --------------------------------------------------------------------------
@@ -452,12 +456,15 @@ def compose_oplogs_device_sharded(delta_a: List[Op], delta_b: List[Op],
                                   ) -> Tuple[List[Op], List[Conflict]]:
     """Mesh twin of
     :func:`semantic_merge_tpu.ops.compose.compose_oplogs_device`."""
+    from ..obs import spans as obs_spans
     if not delta_a and not delta_b:
         return [], []
     k = _dp_size(mesh)
-    interner, ta, tb, na, nb = encode_compose_inputs(
-        delta_a, delta_b, shard_multiple=k)
-    fn = _sharded_compose_fn(mesh, na, nb, k)
-    out = np.asarray(fn(_pad_op_tensor(ta, na), _pad_op_tensor(tb, nb),
-                        np.int32(ta.n), np.int32(tb.n)))
-    return decode_compose_output(out, delta_a, delta_b, interner, na, nb)
+    with obs_spans.span("compose_device_sharded", layer="ops", shards=k,
+                        n_a=len(delta_a), n_b=len(delta_b)):
+        interner, ta, tb, na, nb = encode_compose_inputs(
+            delta_a, delta_b, shard_multiple=k)
+        fn = _sharded_compose_fn(mesh, na, nb, k)
+        out = np.asarray(fn(_pad_op_tensor(ta, na), _pad_op_tensor(tb, nb),
+                            np.int32(ta.n), np.int32(tb.n)))
+        return decode_compose_output(out, delta_a, delta_b, interner, na, nb)

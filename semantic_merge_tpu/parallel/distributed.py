@@ -85,13 +85,21 @@ def init_distributed(config: Optional[DistributedConfig] = None) -> DistributedC
     global _initialized
     config = config or resolve_distributed_config()
     if config.multi_host and not _initialized:
+        from ..obs import metrics as obs_metrics, spans as obs_spans
         import jax
-        jax.distributed.initialize(
-            coordinator_address=config.coordinator_address,
-            num_processes=config.num_processes,
-            process_id=config.process_id,
-        )
+        with obs_spans.span("init_distributed", layer="parallel",
+                            processes=config.num_processes,
+                            process_id=config.process_id):
+            jax.distributed.initialize(
+                coordinator_address=config.coordinator_address,
+                num_processes=config.num_processes,
+                process_id=config.process_id,
+            )
         _initialized = True
+        obs_metrics.REGISTRY.gauge(
+            "semmerge_distributed_processes",
+            "Process count of the jax.distributed job").set(
+            config.num_processes)
         logger.info("jax.distributed up: process %d/%d via %s",
                     config.process_id, config.num_processes,
                     config.coordinator_address)

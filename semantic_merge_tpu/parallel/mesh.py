@@ -163,4 +163,14 @@ def build_mesh(devices: Sequence[jax.Device] | None = None,
             f"axis sizes {dict(zip(MESH_AXES, sizes))} use "
             f"{math.prod(sizes)} of {n} devices")
     arr = np.asarray(devices).reshape(sizes)
+    from ..obs import event as obs_event, metrics as obs_metrics
+    axis_sizes = dict(zip(MESH_AXES, sizes))
+    gauge = obs_metrics.REGISTRY.gauge(
+        "semmerge_mesh_axis_size", "Device-mesh axis sizes of the last "
+        "mesh built (shard counts per parallelism axis)")
+    for name, size in axis_sizes.items():
+        gauge.set(size, axis=name)
+    obs_metrics.REGISTRY.gauge(
+        "semmerge_mesh_devices", "Devices in the last mesh built").set(n)
+    obs_event("mesh_built", devices=n, **axis_sizes)
     return MergeMesh(mesh=Mesh(arr, MESH_AXES))

@@ -104,10 +104,16 @@ def ring_attention(q, k, v, kmask, mesh: Mesh, *, axis_name: str = "sp",
         pallas = pallas_mode()
     qkv_spec = P("dp", axis_name, "tp", None)
     mask_spec = P("dp", axis_name)
-    return jax.shard_map(
-        partial(_ring_attention_local, axis_name=axis_name, pallas=pallas),
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec,
-        check_vma=False,
-    )(q, k, v, kmask)
+    from ..obs import spans as obs_spans
+    # Span covers the dispatch (JAX execution is async — the collective
+    # itself overlaps whatever the host does next); per-step ring cost
+    # shows up in the profiler timeline, not here.
+    with obs_spans.span("ring_attention", layer="parallel", axis=axis_name,
+                        seq=int(q.shape[1]), pallas=str(pallas)):
+        return jax.shard_map(
+            partial(_ring_attention_local, axis_name=axis_name, pallas=pallas),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )(q, k, v, kmask)

@@ -18,6 +18,8 @@ import tempfile
 from typing import Iterable
 
 from ..core.ops import Op
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
 from ..utils.loggingx import logger
 
 
@@ -31,10 +33,19 @@ def apply_ops(base_tree: pathlib.Path, ops: Iterable[Op],
     (:func:`semantic_merge_tpu.ops.crdt.materialize_batch`) instead of
     per-list host insert scans; output is identical (parity-tested).
     """
-    base_tree = pathlib.Path(base_tree)
+    ops = list(ops)
+    obs_metrics.REGISTRY.counter(
+        "semmerge_ops_applied_total",
+        "Composed ops handed to the tree applier").inc(len(ops))
+    with obs_spans.span("apply_ops", layer="runtime", ops=len(ops),
+                        device_crdt=device_crdt):
+        return _apply_ops(pathlib.Path(base_tree), ops, device_crdt)
+
+
+def _apply_ops(base_tree: pathlib.Path, ops: list,
+               device_crdt: bool) -> pathlib.Path:
     out = pathlib.Path(tempfile.mkdtemp(prefix="semmerge_merged_"))
     shutil.copytree(base_tree, out, dirs_exist_ok=True)
-    ops = list(ops)
     resolved_orders = _resolve_reorder_orders(ops, device_crdt)
 
     # Structured-apply span edits (delete/changeSignature carrying

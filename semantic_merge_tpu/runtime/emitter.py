@@ -36,6 +36,7 @@ def emit_files(tree_path: pathlib.Path,
     formatter entirely. A touched path containing glob metacharacters
     would be misread as a pattern by prettier, so such merges fall back
     to whole-tree formatting rather than silently skipping the file."""
+    from ..obs import spans as obs_spans
     tree_path = pathlib.Path(tree_path)
     base_cmd = list(formatter_cmd) if formatter_cmd else list(DEFAULT_FORMATTER)
     if paths is not None and any(_GLOB_CHARS.search(p) for p in paths):
@@ -47,16 +48,19 @@ def emit_files(tree_path: pathlib.Path,
         if not existing:
             return
         cmd = base_cmd + existing
+        scope = len(existing)
     else:
         cmd = base_cmd + ["."]
-    try:
-        subprocess.run(cmd, cwd=tree_path, check=True,
-                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    except FileNotFoundError:
-        logger.debug("Formatter %s not available; skipping", cmd[0])
-    except subprocess.CalledProcessError as exc:
-        logger.warning("Formatter exited with code %s", exc.returncode)
-    except OSError as exc:
-        # E2BIG on huge touched lists and friends — formatting never
-        # fails a merge ([FBK-003] posture).
-        logger.warning("Formatter could not run: %s", exc)
+        scope = -1  # whole tree
+    with obs_spans.span("emit_files", layer="runtime", files=scope):
+        try:
+            subprocess.run(cmd, cwd=tree_path, check=True,
+                           stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        except FileNotFoundError:
+            logger.debug("Formatter %s not available; skipping", cmd[0])
+        except subprocess.CalledProcessError as exc:
+            logger.warning("Formatter exited with code %s", exc.returncode)
+        except OSError as exc:
+            # E2BIG on huge touched lists and friends — formatting never
+            # fails a merge ([FBK-003] posture).
+            logger.warning("Formatter could not run: %s", exc)

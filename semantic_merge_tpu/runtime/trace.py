@@ -1,16 +1,31 @@
-"""Per-phase tracing and metrics.
+"""Per-phase tracing — thin adapter over :mod:`semantic_merge_tpu.obs`.
 
 The reference specifies a ``--trace`` mode dumping op logs, decisions,
 and per-phase timings (reference ``requirements.md:182`` [NFR-OBS-002];
-``architecture.md:248-249``) but implements none of it. Here every CLI
-run can carry a :class:`Tracer`; with tracing enabled it writes a
-machine-readable ``.semmerge-trace.json`` artifact containing phase
-wall-times and counters. With ``profile_dir`` set (CLI ``--profile
-DIR``), the run is additionally captured by the JAX profiler: a
-``jax.profiler.start_trace``/``stop_trace`` session wraps the run and
-every tracer phase annotates the timeline via
-``jax.profiler.TraceAnnotation``, so device kernels line up with
-engine phases in TensorBoard/XProf.
+``architecture.md:248-249``). Every CLI run carries a :class:`Tracer`;
+its public surface (``phase`` / ``count`` / ``write`` / ``close``) is
+unchanged from the original CLI-local implementation, but the timing
+now flows through the unified observability layer: ``phase`` opens an
+:func:`obs.spans.span`, and while the tracer is *collecting* (``--trace``
+or ``--profile``) a :class:`~semantic_merge_tpu.obs.spans.SpanRecorder`
+is active process-wide, so spans emitted deep inside the scanner,
+compose kernels, fused engine, backends, and applier all land in the
+same artifact.
+
+Artifacts written by :meth:`Tracer.write`:
+
+- ``.semmerge-trace.json`` — top-level CLI phases (back-compat shape),
+  counters, the full span tree, device telemetry
+  (:func:`obs.device.snapshot`), and the metrics registry;
+- ``.semmerge-events.jsonl`` — one JSON row per span/event, time-ordered;
+- with ``--profile DIR``, the same trace JSON additionally lands in
+  ``DIR/semmerge-trace.json`` **even without ``--trace``** — previously
+  a profiled run silently discarded every phase wall-time.
+
+With ``profile_dir`` set the run is also captured by the JAX profiler
+(``jax.profiler.start_trace``/``stop_trace``) and every phase annotates
+the timeline via ``jax.profiler.TraceAnnotation``, so device kernels
+line up with engine phases in TensorBoard/XProf.
 """
 from __future__ import annotations
 
@@ -20,6 +35,12 @@ import pathlib
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List
+
+from ..obs import device as obs_device
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+
+TRACE_SCHEMA_VERSION = 1
 
 
 @dataclass
@@ -36,8 +57,12 @@ class Tracer:
     phases: List[PhaseRecord] = field(default_factory=list)
     counters: Dict[str, Any] = field(default_factory=dict)
     _profiling: bool = field(default=False, repr=False)
+    _recorder: obs_spans.SpanRecorder | None = field(default=None, repr=False)
 
     def __post_init__(self) -> None:
+        if self.enabled or self.profile_dir:
+            self._recorder = obs_spans.SpanRecorder()
+            obs_spans.activate(self._recorder)
         if self.profile_dir:
             try:
                 import jax
@@ -57,7 +82,7 @@ class Tracer:
                 pass
         start = time.perf_counter()
         try:
-            with annotation:
+            with annotation, obs_spans.span(name, layer="cli", **meta):
                 yield
         finally:
             self.phases.append(PhaseRecord(name, time.perf_counter() - start, dict(meta)))
@@ -66,20 +91,27 @@ class Tracer:
         self.counters[key] = value
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
+            "schema": TRACE_SCHEMA_VERSION,
             "phases": [
                 {"name": p.name, "seconds": round(p.seconds, 6), **({"meta": p.meta} if p.meta else {})}
                 for p in self.phases
             ],
             "counters": self.counters,
             "total_seconds": round(sum(p.seconds for p in self.phases), 6),
+            "device": obs_device.snapshot(),
+            "metrics": obs_metrics.REGISTRY.to_dict(),
         }
+        if self._recorder is not None:
+            out["spans"] = self._recorder.span_dicts()
+        return out
 
     def close(self) -> None:
-        """Stop the profiler session if one is open. Idempotent; must
-        run on every exit path (the CLI calls it in ``finally``) or an
-        aborted run loses the capture and poisons later start_trace
-        calls in the same process."""
+        """Stop the profiler session if one is open and release the
+        global span recorder. Idempotent; must run on every exit path
+        (the CLI calls it in ``finally``) or an aborted run loses the
+        capture and poisons later start_trace calls in the same
+        process."""
         if self._profiling:
             try:
                 import jax
@@ -87,9 +119,29 @@ class Tracer:
             except Exception:
                 pass
             self._profiling = False
+        if self._recorder is not None:
+            obs_spans.deactivate(self._recorder)
 
     def write(self, path: pathlib.Path | str = ".semmerge-trace.json") -> None:
         self.close()
+        if not self.enabled and not self.profile_dir:
+            return
+        payload = json.dumps(self.to_dict(), indent=2, default=str)
+        if self.profile_dir:
+            # A profiled run keeps its phase timings next to the device
+            # capture, --trace or not (the device timeline is unreadable
+            # without the engine phases that produced it).
+            prof = pathlib.Path(self.profile_dir)
+            try:
+                prof.mkdir(parents=True, exist_ok=True)
+                (prof / "semmerge-trace.json").write_text(
+                    payload, encoding="utf-8")
+            except OSError:
+                pass
         if not self.enabled:
             return
-        pathlib.Path(path).write_text(json.dumps(self.to_dict(), indent=2), encoding="utf-8")
+        path = pathlib.Path(path)
+        path.write_text(payload, encoding="utf-8")
+        if self._recorder is not None:
+            self._recorder.write_jsonl(
+                path.with_name(obs_spans.EVENTS_ARTIFACT))

@@ -16,4 +16,15 @@ if not logger.handlers:
     _handler = logging.StreamHandler()
     _handler.setFormatter(logging.Formatter("%(levelname)s %(message)s"))
     logger.addHandler(_handler)
-logger.setLevel(os.environ.get("SEMMERGE_LOG", "INFO"))
+
+_raw_level = os.environ.get("SEMMERGE_LOG", "INFO")
+try:
+    # Accept names case-insensitively and numeric levels ("10").
+    logger.setLevel(int(_raw_level) if _raw_level.isdigit()
+                    else _raw_level.upper())
+except (ValueError, TypeError):
+    # An invalid value must degrade, not raise at import time and kill
+    # every entry point (SEMMERGE_LOG=verbose used to do exactly that).
+    logger.setLevel(logging.INFO)
+    logger.warning("invalid SEMMERGE_LOG=%r; falling back to INFO",
+                   _raw_level)

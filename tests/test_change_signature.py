@@ -167,11 +167,12 @@ def test_change_signature_fused_when_no_candidates():
 
     kw = dict(base_rev="r", seed="s", timestamp="2026-01-01T00:00:00Z",
               change_signature=True)
-    phases = {}
+    from semantic_merge_tpu.obs import spans as obs_spans
     bk = TpuTSBackend(mesh=False)
-    res_f, comp_f, conf_f = run_merge(bk, base, left, right,
-                                      phases=phases, **kw)
-    assert "kernel" in phases, "fused path must have been taken"
+    rec = obs_spans.SpanRecorder()
+    with obs_spans.activated(rec):
+        res_f, comp_f, conf_f = run_merge(bk, base, left, right, **kw)
+    assert "kernel" in rec.phase_totals(), "fused path must have been taken"
     # Oracle: the host backend's two-program change_signature path.
     from semantic_merge_tpu.backends.base import get_backend
     res_h, comp_h, conf_h = run_merge(get_backend("host"),
@@ -199,11 +200,13 @@ def test_change_signature_candidates_fall_back_and_refine():
 
     kw = dict(base_rev="r", seed="s", timestamp="2026-01-01T00:00:00Z",
               change_signature=True)
-    phases = {}
+    from semantic_merge_tpu.obs import spans as obs_spans
     bk = TpuTSBackend(mesh=False)
-    res_f, comp_f, conf_f = run_merge(bk, base, left, right,
-                                      phases=phases, **kw)
-    assert "build_and_diff" in phases, "candidates must force the fallback"
+    rec = obs_spans.SpanRecorder()
+    with obs_spans.activated(rec):
+        res_f, comp_f, conf_f = run_merge(bk, base, left, right, **kw)
+    assert "build_and_diff" in rec.phase_totals(), \
+        "candidates must force the fallback"
     types = [o.type for o in res_f.op_log_left]
     assert types == ["changeSignature"]
     from semantic_merge_tpu.backends.base import get_backend

@@ -1,0 +1,303 @@
+"""Unit tests for the unified observability layer (semantic_merge_tpu.obs):
+span nesting/exception paths, histogram bucket edges, Prometheus text
+rendering round-trip, device telemetry shape, the Tracer adapter's
+--profile fix, the SEMMERGE_LOG fallback, and the `semmerge stats`
+subcommand."""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from semantic_merge_tpu.obs import device as obs_device
+from semantic_merge_tpu.obs import metrics as obs_metrics
+from semantic_merge_tpu.obs import spans as obs_spans
+
+
+# ---------------------------------------------------------------------------
+# spans
+
+
+def test_span_nesting_depth_and_parent_links():
+    rec = obs_spans.SpanRecorder()
+    with obs_spans.activated(rec):
+        with obs_spans.span("outer", layer="cli"):
+            with obs_spans.span("inner", layer="ops", k=1):
+                pass
+            with obs_spans.span("inner2", layer="ops"):
+                pass
+    by_name = {s.name: s for s in rec.spans}
+    assert by_name["outer"].depth == 0
+    assert by_name["outer"].parent_id == -1
+    assert by_name["inner"].depth == 1
+    assert by_name["inner"].parent_id == by_name["outer"].span_id
+    assert by_name["inner2"].parent_id == by_name["outer"].span_id
+    assert by_name["inner"].meta == {"k": 1}
+    # Children complete (and record) before their parent.
+    assert rec.spans.index(by_name["inner"]) < rec.spans.index(by_name["outer"])
+
+
+def test_span_exception_path_marks_error_and_propagates():
+    rec = obs_spans.SpanRecorder()
+    with obs_spans.activated(rec):
+        with pytest.raises(ValueError):
+            with obs_spans.span("boom", layer="ops"):
+                raise ValueError("nope")
+    (span,) = rec.spans
+    assert span.status == "error"
+    assert span.error == "ValueError"
+    assert span.seconds >= 0
+
+
+def test_span_records_metrics_even_without_recorder():
+    before = obs_metrics.phase_totals().get("dark_phase_xyz", 0.0)
+    with obs_spans.span("dark_phase_xyz"):
+        pass
+    after = obs_metrics.phase_totals()["dark_phase_xyz"]
+    assert after >= before
+    # But no span record was built anywhere.
+    assert obs_spans.current() is None
+
+
+def test_stale_deactivate_is_noop_for_other_recorder():
+    a, b = obs_spans.SpanRecorder(), obs_spans.SpanRecorder()
+    obs_spans.activate(a)
+    obs_spans.deactivate(b)  # stale handle: must not clobber a
+    assert obs_spans.current() is a
+    obs_spans.deactivate(a)
+    assert obs_spans.current() is None
+
+
+def test_phase_totals_since_scopes_one_run():
+    before = obs_metrics.phase_totals()
+    with obs_spans.span("scoped_phase_abc"):
+        pass
+    delta = obs_metrics.phase_totals_since(before)
+    assert "scoped_phase_abc" in delta
+    assert delta["scoped_phase_abc"] >= 0
+
+
+def test_events_jsonl_round_trip(tmp_path):
+    rec = obs_spans.SpanRecorder()
+    with obs_spans.activated(rec):
+        with obs_spans.span("alpha", layer="frontend"):
+            obs_spans.event("marker", detail="x")
+    path = tmp_path / "events.jsonl"
+    rec.write_jsonl(path)
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    kinds = {r["type"] for r in rows}
+    assert kinds == {"span", "event"}
+    span_row = next(r for r in rows if r["type"] == "span")
+    assert span_row["name"] == "alpha" and span_row["layer"] == "frontend"
+
+
+# ---------------------------------------------------------------------------
+# metrics
+
+
+def test_histogram_bucket_edges_are_inclusive_upper_bounds():
+    h = obs_metrics.Histogram("t_hist", buckets=(1.0, 2.0, 4.0))
+    h.observe(1.0)   # exactly on a bound -> that bucket
+    h.observe(1.5)
+    h.observe(2.0)
+    h.observe(4.0001)  # past the last finite bound -> +Inf
+    series = h._series[()]
+    assert series["counts"] == [1, 2, 0, 1]
+    assert series["count"] == 4
+    assert series["sum"] == pytest.approx(8.5001)
+
+
+def test_counter_gauge_labels_and_kind_mismatch():
+    reg = obs_metrics.Registry()
+    c = reg.counter("hits", "help text")
+    c.inc(2, kind="a")
+    c.inc(3, kind="b")
+    assert c.value(kind="a") == 2 and c.value(kind="b") == 3
+    g = reg.gauge("hwm")
+    g.max(5)
+    g.max(3)  # smaller -> ignored
+    assert g.value() == 5
+    with pytest.raises(TypeError):
+        reg.gauge("hits")  # registered as a counter
+
+
+def _parse_prometheus(text):
+    """Minimal exposition parser: {(name, frozenset(labels)): value}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^([A-Za-z0-9_:]+)(\{(.*)\})? (.+)$", line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, _, labels_raw, value = m.groups()
+        labels = frozenset(
+            tuple(p.split("=", 1)) for p in
+            re.findall(r'[A-Za-z0-9_]+="[^"]*"', labels_raw or ""))
+        out[(name, labels)] = float(value)
+    return out
+
+
+def test_prometheus_rendering_round_trip():
+    reg = obs_metrics.Registry()
+    reg.counter("rt_total", "a counter").inc(3, phase="x")
+    reg.counter("rt_total").inc(1.5, phase="y")
+    reg.gauge("rt_gauge").set(7)
+    h = reg.histogram("rt_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05, phase="x")
+    h.observe(0.5, phase="x")
+    h.observe(2.0, phase="x")
+
+    text = reg.render_prometheus()
+    parsed = _parse_prometheus(text)
+    assert parsed[("rt_total", frozenset({("phase", '"x"')}))] == 3
+    assert parsed[("rt_total", frozenset({("phase", '"y"')}))] == 1.5
+    assert parsed[("rt_gauge", frozenset())] == 7
+    # Histogram: cumulative buckets, _sum, _count survive the round trip.
+    assert parsed[("rt_seconds_bucket",
+                   frozenset({("phase", '"x"'), ("le", '"0.1"')}))] == 1
+    assert parsed[("rt_seconds_bucket",
+                   frozenset({("phase", '"x"'), ("le", '"1"')}))] == 2
+    assert parsed[("rt_seconds_bucket",
+                   frozenset({("phase", '"x"'), ("le", '"+Inf"')}))] == 3
+    assert parsed[("rt_seconds_count", frozenset({("phase", '"x"')}))] == 3
+    assert parsed[("rt_seconds_sum",
+                   frozenset({("phase", '"x"')}))] == pytest.approx(2.55)
+    # The JSON form renders identically through the artifact-side path.
+    assert obs_metrics.render_prometheus_from_dict(reg.to_dict()) == text
+
+
+def test_metrics_dump_json_and_prom(tmp_path):
+    obs_metrics.REGISTRY.counter("dump_probe_total").inc(1)
+    jpath = tmp_path / "m.json"
+    obs_metrics.dump(str(jpath))
+    data = json.loads(jpath.read_text())
+    assert "dump_probe_total" in data["counters"]
+    ppath = tmp_path / "m.prom"
+    obs_metrics.dump(str(ppath))
+    assert "dump_probe_total" in ppath.read_text()
+
+
+# ---------------------------------------------------------------------------
+# device telemetry
+
+
+def test_device_snapshot_shape_is_stable():
+    snap = obs_device.snapshot()
+    for key in ("jax_imported", "platform", "device_count", "transfer_bytes",
+                "transfer_count", "live_buffer_bytes_hwm",
+                "compile_cache_events"):
+        assert key in snap
+    obs_device.record_transfer("h2d", 128)
+    snap2 = obs_device.snapshot()
+    assert snap2["transfer_bytes"].get("h2d", 0) >= 128
+
+
+# ---------------------------------------------------------------------------
+# Tracer adapter
+
+
+def test_tracer_profile_dir_writes_phase_json_without_trace(tmp_path,
+                                                            monkeypatch):
+    """--profile DIR without --trace must still persist phase timings
+    into DIR (they were silently discarded before)."""
+    import semantic_merge_tpu.runtime.trace as trace_mod
+
+    # Keep the unit test off the real JAX profiler.
+    monkeypatch.setattr(
+        trace_mod.Tracer, "__post_init__",
+        lambda self: (self.enabled or self.profile_dir) and obs_spans.activate(
+            self.__dict__.setdefault("_recorder", obs_spans.SpanRecorder())))
+    prof = tmp_path / "profdir"
+    tracer = trace_mod.Tracer(enabled=False, profile_dir=str(prof))
+    with tracer.phase("snapshot"):
+        pass
+    tracer.write(tmp_path / "unused-trace.json")
+    written = json.loads((prof / "semmerge-trace.json").read_text())
+    assert [p["name"] for p in written["phases"]] == ["snapshot"]
+    # Not --trace: the cwd artifact must NOT appear.
+    assert not (tmp_path / "unused-trace.json").exists()
+    assert obs_spans.current() is None
+
+
+def test_tracer_enabled_writes_trace_events_and_spans(tmp_path):
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    tracer = trace_mod.Tracer(enabled=True)
+    with tracer.phase("merge", backend="host"):
+        with obs_spans.span("scan", layer="frontend"):
+            pass
+    tracer.count("conflicts", 0)
+    out = tmp_path / ".semmerge-trace.json"
+    tracer.write(out)
+    data = json.loads(out.read_text())
+    assert data["schema"] == 1
+    assert data["counters"] == {"conflicts": 0}
+    names = {s["name"] for s in data["spans"]}
+    assert {"merge", "scan"} <= names
+    assert "device" in data and "metrics" in data
+    events = tmp_path / ".semmerge-events.jsonl"
+    assert events.exists()
+    assert obs_spans.current() is None
+
+
+# ---------------------------------------------------------------------------
+# SEMMERGE_LOG fallback (satellite fix: invalid level must not kill
+# every entry point at import time)
+
+
+def _logger_level(env_value):
+    env = dict(os.environ, SEMMERGE_LOG=env_value)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from semantic_merge_tpu.utils.loggingx import logger; "
+         "print(logger.level)"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=60)
+    return proc
+
+
+def test_invalid_semmerge_log_falls_back_to_info():
+    proc = _logger_level("verbose")
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "20"  # INFO
+    assert "invalid SEMMERGE_LOG" in proc.stderr
+
+
+def test_lowercase_and_numeric_semmerge_log_accepted():
+    proc = _logger_level("debug")
+    assert proc.returncode == 0 and proc.stdout.strip() == "10"
+    proc = _logger_level("30")
+    assert proc.returncode == 0 and proc.stdout.strip() == "30"
+
+
+# ---------------------------------------------------------------------------
+# stats subcommand
+
+
+def test_stats_renders_trace_metrics_and_events(tmp_path, monkeypatch, capsys):
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    from semantic_merge_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    tracer = trace_mod.Tracer(enabled=True)
+    with tracer.phase("merge"):
+        with obs_spans.span("scan", layer="frontend"):
+            pass
+    tracer.write(".semmerge-trace.json")
+
+    assert main(["stats"]) == 0
+    out = capsys.readouterr().out
+    assert "merge" in out and "frontend" in out
+
+    assert main(["stats", ".semmerge-events.jsonl"]) == 0
+    assert "spans" in capsys.readouterr().out
+
+    assert main(["stats", "--prometheus"]) == 0
+    assert "semmerge_phase_seconds_bucket" in capsys.readouterr().out
+
+    obs_metrics.dump(str(tmp_path / "metrics.json"))
+    assert main(["stats", "metrics.json"]) == 0
+    assert "semmerge_phase_seconds" in capsys.readouterr().out
+
+    assert main(["stats", "missing.json"]) == 1

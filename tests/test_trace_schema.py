@@ -1,0 +1,97 @@
+"""Wires scripts/check_trace_schema.py into tier-1: trace/events
+artifacts produced by the real Tracer must validate, and schema drift
+(malformed spans, histogram count mismatches) must be rejected."""
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from semantic_merge_tpu.obs import spans as obs_spans
+
+_SCRIPT = (pathlib.Path(__file__).resolve().parent.parent
+           / "scripts" / "check_trace_schema.py")
+
+
+@pytest.fixture(scope="module")
+def schema():
+    spec = importlib.util.spec_from_file_location("check_trace_schema",
+                                                  _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def artifacts(tmp_path):
+    """Real artifacts from the real Tracer — what the CLI writes."""
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    tracer = trace_mod.Tracer(enabled=True)
+    with tracer.phase("snapshot"):
+        pass
+    with tracer.phase("merge", backend="host"):
+        with obs_spans.span("scan", layer="frontend", files=2):
+            pass
+        obs_spans.event("cache", hits=1)
+    tracer.count("conflicts", 0)
+    trace = tmp_path / ".semmerge-trace.json"
+    tracer.write(trace)
+    return trace, tmp_path / ".semmerge-events.jsonl"
+
+
+def test_real_artifacts_validate(schema, artifacts):
+    trace, events = artifacts
+    assert schema.validate_trace(json.loads(trace.read_text())) == []
+    assert schema.validate_events(events.read_text().splitlines()) == []
+
+
+def test_script_cli_exit_codes(artifacts):
+    trace, events = artifacts
+    ok = subprocess.run([sys.executable, str(_SCRIPT), str(trace),
+                         str(events)], capture_output=True, text=True,
+                        timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    bad = trace.with_name("bad.json")
+    bad.write_text("{}")
+    fail = subprocess.run([sys.executable, str(_SCRIPT), str(bad)],
+                          capture_output=True, text=True, timeout=60)
+    assert fail.returncode == 1
+    assert "missing key" in fail.stderr
+
+
+def test_drifted_trace_is_rejected(schema, artifacts):
+    trace, _ = artifacts
+    data = json.loads(trace.read_text())
+
+    broken = dict(data)
+    broken.pop("phases")
+    assert any("phases" in e for e in schema.validate_trace(broken))
+
+    broken = json.loads(trace.read_text())
+    broken["phases"][0]["seconds"] = "fast"
+    assert any("seconds" in e for e in schema.validate_trace(broken))
+
+    broken = json.loads(trace.read_text())
+    broken["spans"][0]["status"] = "meh"
+    assert any("status" in e for e in schema.validate_trace(broken))
+
+    broken = json.loads(trace.read_text())
+    hists = broken["metrics"]["histograms"]
+    name = next(iter(hists))
+    hists[name]["series"][0]["count"] += 1  # counts no longer sum up
+    assert any("sum to count" in e for e in schema.validate_trace(broken))
+
+
+def test_drifted_events_are_rejected(schema, artifacts):
+    _, events = artifacts
+    lines = events.read_text().splitlines()
+    assert schema.validate_events(lines + ['{"type": "mystery"}'])
+    assert schema.validate_events(["not json"])
+    row = json.loads(next(line for line in lines
+                          if '"type": "span"' in line or
+                          '"type":"span"' in line))
+    row.pop("thread")
+    assert any("thread" in e
+               for e in schema.validate_events([json.dumps(row)]))

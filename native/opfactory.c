@@ -7,21 +7,29 @@
  * Python at ~2 us/op — the largest host phase left after the native
  * JSON serializer. This extension builds the same objects with the
  * CPython C API: Op/Target instances via tp_new-free __new__ +
- * slot SetAttr, params/guards/effects as presized dicts, field
- * strings decoded from the cached node string tables
- * (oplog_view._node_table layout: 4 UTF-8 fields per node, int64
- * offsets).
+ * slot SetAttr, params/guards/effects as small dicts.
  *
- * Two entry points:
- *   stream_ops(kind, a_slot, b_slot, words, base_blob, base_offs,
- *              side_blob, side_offs, prov, op_cls, target_cls) -> list[Op]
- *   composed_ops(<left stream args...>, <right stream args...>,
- *                sides, idxs, addr_ov, file_ov, name_ov,
+ * v2 (host-tail pipelining): field strings come from per-snapshot
+ * Python STRING LISTS (one list per node column: symbolId, addressId,
+ * name, file — built once per snapshot and cached by the engine)
+ * instead of being UTF-8-decoded out of a byte blob per op. A 46k-op
+ * composed stream used to allocate ~230k fresh field strings per
+ * materialize; now every field is a borrowed PyList_GET_ITEM + the
+ * dict insert's incref. Only the op id (uuid) and the summary string
+ * are created per op.
+ *
+ * Two entry points (STREAM = kind, a_slot, b_slot, words,
+ * b_sym, b_addr, b_name, b_file, s_sym, s_addr, s_name, s_file):
+ *   stream_ops(STREAM, prov, op_cls, target_cls) -> list[Op]
+ *   composed_ops(STREAM_left, STREAM_right, sides, idxs,
+ *                addr_ov, file_ov, name_ov,
  *                prov_left, prov_right, op_cls, target_cls) -> list[Op]
  * composed_ops applies the chain-override rules of
  * oplog_view._materialize_decoded row-by-row, building each final
  * composed op directly — the intermediate per-side stream objects are
- * never created. Byte-for-byte to_dict parity with the Python
+ * never created. ``sides``/``idxs`` may be any row range (the tail
+ * pipeline materializes shard slices independently and concatenates
+ * in shard order). Byte-for-byte to_dict parity with the Python
  * materializers is fuzz-tested in tests/test_oplog_view.py.
  */
 #define PY_SSIZE_T_CLEAN
@@ -39,15 +47,12 @@ static PyObject *SUM_add, *SUM_del, *ARROW, *SUM_ren_prefix, *SUM_mov_prefix;
 static PyObject *ONE;
 
 typedef struct {
-  const char *blob;
-  Py_ssize_t blob_len;
-  const int64_t *offs;
-} NodeTab;
-
-typedef struct {
   const int32_t *kind, *a_slot, *b_slot;
   const int32_t *words; /* n*4 */
-  NodeTab bt, st;
+  /* Borrowed per-node field lists: [0..3] base sym/addr/name/file,
+   * [4..7] side sym/addr/name/file. */
+  PyObject *bf[4], *sf[4];
+  Py_ssize_t nb, ns; /* node counts (list lengths) */
 } Stream;
 
 /* Slot descriptors fetched once per entry call: setting through
@@ -100,14 +105,14 @@ static void factory_clear(Factory *f) {
   for (int i = 0; i < 2; i++) Py_XDECREF(f->tgt_d[i]);
 }
 
-/* Decode field f (0 sym, 1 addr, 2 name, 3 file) of node as str. */
-static PyObject *field(const NodeTab *t, int64_t node, int f) {
-  int64_t a = t->offs[node * 4 + f], b = t->offs[node * 4 + f + 1];
-  if (a < 0 || b < a || b > t->blob_len) {
-    PyErr_SetString(PyExc_ValueError, "node table offset out of range");
+/* Borrowed field f (0 sym, 1 addr, 2 name, 3 file) of a node. */
+static PyObject *fld(PyObject *const lists[4], Py_ssize_t n, Py_ssize_t node,
+                     int f) {
+  if (node < 0 || node >= n) {
+    PyErr_SetString(PyExc_ValueError, "node index out of range");
     return NULL;
   }
-  return PyUnicode_DecodeUTF8(t->blob + a, b - a, "strict");
+  return PyList_GET_ITEM(lists[f], node);
 }
 
 static const char HEXD[] = "0123456789abcdef";
@@ -130,6 +135,7 @@ static PyObject *uuid_str(const int32_t *w4) {
   return PyUnicode_FromStringAndSize(buf, 36);
 }
 
+/* sym/addr borrowed; result owned by caller. */
 static PyObject *make_target(const Factory *f, PyObject *sym,
                              PyObject *addr) {
   PyObject *t = f->tgt_t->tp_alloc(f->tgt_t, 0);
@@ -141,8 +147,8 @@ static PyObject *make_target(const Factory *f, PyObject *sym,
   return t;
 }
 
-/* Assemble one Op. Steals NO references; all borrowed/owned by caller.
- * effects/guards/params are owned dict refs passed in (steals them). */
+/* Assemble one Op. op_id/type/prov borrowed;
+ * target/params/guards/effects are owned refs STOLEN from the caller. */
 static PyObject *make_op(const Factory *f, PyObject *op_id, PyObject *type,
                          PyObject *target /* stolen */,
                          PyObject *params /* stolen */,
@@ -173,7 +179,7 @@ fail:
   return NULL;
 }
 
-static PyObject *guards_for(PyObject *addr) {
+static PyObject *guards_for(PyObject *addr /* borrowed */) {
   PyObject *g = PyDict_New();
   if (!g) return NULL;
   if (PyDict_SetItem(g, S_exists, Py_True) < 0 ||
@@ -215,7 +221,8 @@ static PyObject *effects_summary(PyObject *summary /* stolen */) {
 /* Build op i of a stream, applying composed-row overrides when
  * addr_ov/file_ov/name_ov are non-NULL (borrowed, may be Py_None).
  * Override semantics mirror oplog_view._materialize_decoded exactly,
- * except ops are always built fresh (value-identical). */
+ * except ops are always built fresh (value-identical). All field
+ * strings are borrowed from the stream's node field lists. */
 static PyObject *build_op(const Stream *s, Py_ssize_t i, PyObject *prov,
                           const Factory *f, PyObject *addr_ov,
                           PyObject *file_ov, PyObject *name_ov) {
@@ -228,34 +235,24 @@ static PyObject *build_op(const Stream *s, Py_ssize_t i, PyObject *prov,
   int has_name = name_ov && name_ov != Py_None;
 
   if (k == 0 || k == 1) { /* renameSymbol / moveDecl */
-    int64_t an = s->a_slot[i], bn = s->b_slot[i];
-    PyObject *a_sym = field(&s->bt, an, 0), *a_addr = field(&s->bt, an, 1);
-    if (!a_sym || !a_addr) {
-      Py_XDECREF(a_sym);
-      Py_XDECREF(a_addr);
-      goto done;
-    }
-    PyObject *t_addr = has_addr ? addr_ov : a_addr;
-    PyObject *target = make_target(f, a_sym, t_addr);
+    Py_ssize_t an = s->a_slot[i], bn = s->b_slot[i];
+    PyObject *a_sym = fld(s->bf, s->nb, an, 0);
+    PyObject *a_addr = fld(s->bf, s->nb, an, 1);
+    if (!a_sym || !a_addr) goto done;
+    PyObject *target = make_target(f, a_sym, has_addr ? addr_ov : a_addr);
     PyObject *guards = guards_for(a_addr);
     if (!target || !guards) {
       Py_XDECREF(target);
       Py_XDECREF(guards);
-      Py_DECREF(a_sym);
-      Py_DECREF(a_addr);
       goto done;
     }
     if (k == 0) { /* renameSymbol */
-      PyObject *a_name = field(&s->bt, an, 2), *b_name = field(&s->st, bn, 2),
-               *b_file = field(&s->st, bn, 3);
+      PyObject *a_name = fld(s->bf, s->nb, an, 2);
+      PyObject *b_name = fld(s->sf, s->ns, bn, 2);
+      PyObject *b_file = fld(s->sf, s->ns, bn, 3);
       if (!a_name || !b_name || !b_file) {
-        Py_XDECREF(a_name);
-        Py_XDECREF(b_name);
-        Py_XDECREF(b_file);
         Py_DECREF(target);
         Py_DECREF(guards);
-        Py_DECREF(a_sym);
-        Py_DECREF(a_addr);
         goto done;
       }
       PyObject *params = PyDict_New();
@@ -272,11 +269,6 @@ static PyObject *build_op(const Stream *s, Py_ssize_t i, PyObject *prov,
       PyObject *effects =
           ok ? effects_summary(summary3(SUM_ren_prefix, a_name, b_name))
              : NULL;
-      Py_DECREF(a_name);
-      Py_DECREF(b_name);
-      Py_DECREF(b_file);
-      Py_DECREF(a_sym);
-      Py_DECREF(a_addr);
       if (!ok || !effects) {
         Py_XDECREF(params);
         Py_XDECREF(effects);
@@ -287,16 +279,12 @@ static PyObject *build_op(const Stream *s, Py_ssize_t i, PyObject *prov,
       result = make_op(f, op_id, T_renameSymbol, target, params, guards,
                        effects, prov);
     } else { /* moveDecl */
-      PyObject *b_addr = field(&s->st, bn, 1), *a_file = field(&s->bt, an, 3),
-               *b_file = field(&s->st, bn, 3);
+      PyObject *b_addr = fld(s->sf, s->ns, bn, 1);
+      PyObject *a_file = fld(s->bf, s->nb, an, 3);
+      PyObject *b_file = fld(s->sf, s->ns, bn, 3);
       if (!b_addr || !a_file || !b_file) {
-        Py_XDECREF(b_addr);
-        Py_XDECREF(a_file);
-        Py_XDECREF(b_file);
         Py_DECREF(target);
         Py_DECREF(guards);
-        Py_DECREF(a_sym);
-        Py_DECREF(a_addr);
         goto done;
       }
       PyObject *params = PyDict_New();
@@ -311,11 +299,6 @@ static PyObject *build_op(const Stream *s, Py_ssize_t i, PyObject *prov,
       PyObject *effects =
           ok ? effects_summary(summary3(SUM_mov_prefix, a_addr, b_addr))
              : NULL;
-      Py_DECREF(b_addr);
-      Py_DECREF(a_file);
-      Py_DECREF(b_file);
-      Py_DECREF(a_sym);
-      Py_DECREF(a_addr);
       if (!ok || !effects) {
         Py_XDECREF(params);
         Py_XDECREF(effects);
@@ -327,18 +310,14 @@ static PyObject *build_op(const Stream *s, Py_ssize_t i, PyObject *prov,
                        effects, prov);
     }
   } else { /* addDecl (2) / deleteDecl (3) */
-    const NodeTab *tab = (k == 2) ? &s->st : &s->bt;
-    int64_t node = (k == 2) ? s->b_slot[i] : s->a_slot[i];
-    PyObject *sym = field(tab, node, 0), *addr = field(tab, node, 1),
-             *fil = field(tab, node, 3);
-    if (!sym || !addr || !fil) {
-      Py_XDECREF(sym);
-      Py_XDECREF(addr);
-      Py_XDECREF(fil);
-      goto done;
-    }
-    PyObject *t_addr = has_addr ? addr_ov : addr;
-    PyObject *target = make_target(f, sym, t_addr);
+    PyObject *const *lists = (k == 2) ? s->sf : s->bf;
+    Py_ssize_t nn = (k == 2) ? s->ns : s->nb;
+    Py_ssize_t node = (k == 2) ? s->b_slot[i] : s->a_slot[i];
+    PyObject *sym = fld(lists, nn, node, 0);
+    PyObject *addr = fld(lists, nn, node, 1);
+    PyObject *fil = fld(lists, nn, node, 3);
+    if (!sym || !addr || !fil) goto done;
+    PyObject *target = make_target(f, sym, has_addr ? addr_ov : addr);
     PyObject *params = PyDict_New();
     int ok = target && params && PyDict_SetItem(params, S_file, fil) == 0;
     if (ok && has_name)
@@ -350,9 +329,6 @@ static PyObject *build_op(const Stream *s, Py_ssize_t i, PyObject *prov,
       Py_INCREF(sum);
       effects = effects_summary(sum);
     }
-    Py_DECREF(sym);
-    Py_DECREF(addr);
-    Py_DECREF(fil);
     if (!ok || !guards || !effects) {
       Py_XDECREF(target);
       Py_XDECREF(params);
@@ -371,57 +347,56 @@ done:
 /* ---- argument plumbing ---- */
 
 typedef struct {
-  Py_buffer kind, a_slot, b_slot, words, b_offs, s_offs;
-  Py_buffer b_blob, s_blob;
+  Py_buffer kind, a_slot, b_slot, words;
   Stream s;
   Py_ssize_t n;
   int held;
 } StreamArgs;
 
+/* One stream is 12 consecutive args: 4 int32 column buffers followed
+ * by 8 field lists (base sym/addr/name/file, side sym/addr/name/file). */
 static int get_stream(PyObject *args, Py_ssize_t off, StreamArgs *sa) {
   PyObject *kind = PyTuple_GET_ITEM(args, off);
   PyObject *a_slot = PyTuple_GET_ITEM(args, off + 1);
   PyObject *b_slot = PyTuple_GET_ITEM(args, off + 2);
   PyObject *words = PyTuple_GET_ITEM(args, off + 3);
-  PyObject *b_blob = PyTuple_GET_ITEM(args, off + 4);
-  PyObject *b_offs = PyTuple_GET_ITEM(args, off + 5);
-  PyObject *s_blob = PyTuple_GET_ITEM(args, off + 6);
-  PyObject *s_offs = PyTuple_GET_ITEM(args, off + 7);
   memset(sa, 0, sizeof(*sa));
+  for (int i = 0; i < 8; i++) {
+    PyObject *lst = PyTuple_GET_ITEM(args, off + 4 + i);
+    if (!PyList_Check(lst)) {
+      PyErr_SetString(PyExc_TypeError, "node field columns must be lists");
+      return -1;
+    }
+    if (i < 4)
+      sa->s.bf[i] = lst;
+    else
+      sa->s.sf[i - 4] = lst;
+  }
+  sa->s.nb = PyList_GET_SIZE(sa->s.bf[0]);
+  sa->s.ns = PyList_GET_SIZE(sa->s.sf[0]);
+  for (int i = 1; i < 4; i++) {
+    if (PyList_GET_SIZE(sa->s.bf[i]) != sa->s.nb ||
+        PyList_GET_SIZE(sa->s.sf[i]) != sa->s.ns) {
+      PyErr_SetString(PyExc_ValueError, "node field list length mismatch");
+      return -1;
+    }
+  }
   if (PyObject_GetBuffer(kind, &sa->kind, PyBUF_C_CONTIGUOUS) < 0) return -1;
   if (PyObject_GetBuffer(a_slot, &sa->a_slot, PyBUF_C_CONTIGUOUS) < 0) goto f1;
   if (PyObject_GetBuffer(b_slot, &sa->b_slot, PyBUF_C_CONTIGUOUS) < 0) goto f2;
   if (PyObject_GetBuffer(words, &sa->words, PyBUF_C_CONTIGUOUS) < 0) goto f3;
-  if (PyObject_GetBuffer(b_blob, &sa->b_blob, PyBUF_C_CONTIGUOUS) < 0) goto f4;
-  if (PyObject_GetBuffer(b_offs, &sa->b_offs, PyBUF_C_CONTIGUOUS) < 0) goto f5;
-  if (PyObject_GetBuffer(s_blob, &sa->s_blob, PyBUF_C_CONTIGUOUS) < 0) goto f6;
-  if (PyObject_GetBuffer(s_offs, &sa->s_offs, PyBUF_C_CONTIGUOUS) < 0) goto f7;
   sa->n = sa->kind.len / 4;
   if (sa->a_slot.len != sa->kind.len || sa->b_slot.len != sa->kind.len ||
       sa->words.len != sa->kind.len * 4) {
     PyErr_SetString(PyExc_ValueError, "column length mismatch");
-    goto f8;
+    goto f4;
   }
   sa->s.kind = (const int32_t *)sa->kind.buf;
   sa->s.a_slot = (const int32_t *)sa->a_slot.buf;
   sa->s.b_slot = (const int32_t *)sa->b_slot.buf;
   sa->s.words = (const int32_t *)sa->words.buf;
-  sa->s.bt.blob = (const char *)sa->b_blob.buf;
-  sa->s.bt.blob_len = sa->b_blob.len;
-  sa->s.bt.offs = (const int64_t *)sa->b_offs.buf;
-  sa->s.st.blob = (const char *)sa->s_blob.buf;
-  sa->s.st.blob_len = sa->s_blob.len;
-  sa->s.st.offs = (const int64_t *)sa->s_offs.buf;
   sa->held = 1;
   return 0;
-f8:
-  PyBuffer_Release(&sa->s_offs);
-f7:
-  PyBuffer_Release(&sa->s_blob);
-f6:
-  PyBuffer_Release(&sa->b_offs);
-f5:
-  PyBuffer_Release(&sa->b_blob);
 f4:
   PyBuffer_Release(&sa->words);
 f3:
@@ -439,25 +414,21 @@ static void release_stream(StreamArgs *sa) {
   PyBuffer_Release(&sa->a_slot);
   PyBuffer_Release(&sa->b_slot);
   PyBuffer_Release(&sa->words);
-  PyBuffer_Release(&sa->b_blob);
-  PyBuffer_Release(&sa->b_offs);
-  PyBuffer_Release(&sa->s_blob);
-  PyBuffer_Release(&sa->s_offs);
   sa->held = 0;
 }
 
 static PyObject *py_stream_ops(PyObject *self, PyObject *args) {
   (void)self;
-  if (PyTuple_GET_SIZE(args) != 11) {
-    PyErr_SetString(PyExc_TypeError, "stream_ops expects 11 args");
+  if (PyTuple_GET_SIZE(args) != 15) {
+    PyErr_SetString(PyExc_TypeError, "stream_ops expects 15 args");
     return NULL;
   }
   StreamArgs sa;
   if (get_stream(args, 0, &sa) < 0) return NULL;
-  PyObject *prov = PyTuple_GET_ITEM(args, 8);
+  PyObject *prov = PyTuple_GET_ITEM(args, 12);
   Factory fac;
-  if (factory_init(&fac, PyTuple_GET_ITEM(args, 9),
-                   PyTuple_GET_ITEM(args, 10)) < 0) {
+  if (factory_init(&fac, PyTuple_GET_ITEM(args, 13),
+                   PyTuple_GET_ITEM(args, 14)) < 0) {
     factory_clear(&fac);
     release_stream(&sa);
     return NULL;
@@ -485,26 +456,26 @@ static PyObject *py_stream_ops(PyObject *self, PyObject *args) {
 
 static PyObject *py_composed_ops(PyObject *self, PyObject *args) {
   (void)self;
-  if (PyTuple_GET_SIZE(args) != 25) {
-    PyErr_SetString(PyExc_TypeError, "composed_ops expects 25 args");
+  if (PyTuple_GET_SIZE(args) != 33) {
+    PyErr_SetString(PyExc_TypeError, "composed_ops expects 33 args");
     return NULL;
   }
   StreamArgs left, right;
   if (get_stream(args, 0, &left) < 0) return NULL;
-  if (get_stream(args, 8, &right) < 0) {
+  if (get_stream(args, 12, &right) < 0) {
     release_stream(&left);
     return NULL;
   }
-  PyObject *sides = PyTuple_GET_ITEM(args, 16);
-  PyObject *idxs = PyTuple_GET_ITEM(args, 17);
-  PyObject *addr_ov = PyTuple_GET_ITEM(args, 18);
-  PyObject *file_ov = PyTuple_GET_ITEM(args, 19);
-  PyObject *name_ov = PyTuple_GET_ITEM(args, 20);
-  PyObject *prov_l = PyTuple_GET_ITEM(args, 21);
-  PyObject *prov_r = PyTuple_GET_ITEM(args, 22);
+  PyObject *sides = PyTuple_GET_ITEM(args, 24);
+  PyObject *idxs = PyTuple_GET_ITEM(args, 25);
+  PyObject *addr_ov = PyTuple_GET_ITEM(args, 26);
+  PyObject *file_ov = PyTuple_GET_ITEM(args, 27);
+  PyObject *name_ov = PyTuple_GET_ITEM(args, 28);
+  PyObject *prov_l = PyTuple_GET_ITEM(args, 29);
+  PyObject *prov_r = PyTuple_GET_ITEM(args, 30);
   Factory fac;
-  int fac_ok = factory_init(&fac, PyTuple_GET_ITEM(args, 23),
-                            PyTuple_GET_ITEM(args, 24)) == 0;
+  int fac_ok = factory_init(&fac, PyTuple_GET_ITEM(args, 31),
+                            PyTuple_GET_ITEM(args, 32)) == 0;
   PyObject *out = NULL;
   if (!fac_ok) {
     factory_clear(&fac);
@@ -563,8 +534,8 @@ static PyMethodDef Methods[] = {
     {"stream_ops", py_stream_ops, METH_VARARGS,
      "Build one op stream's Op objects from its columns."},
     {"composed_ops", py_composed_ops, METH_VARARGS,
-     "Build the composed Op sequence from two streams' columns + "
-     "per-row chain overrides."},
+     "Build the composed Op sequence (any row range) from two streams' "
+     "columns + per-row chain overrides."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,

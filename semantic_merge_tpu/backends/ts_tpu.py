@@ -66,6 +66,10 @@ class TpuTSBackend:
         # shared decl cache (keyed by scan identity + interner token).
         self._interner = Interner()
         self._fused = None
+        # [engine] host_workers — host-tail pipeline width for the
+        # fused path (None until configure(); the engine resolves the
+        # SEMMERGE_HOST_WORKERS env override and the auto default).
+        self._host_workers: int | None = None
         # Snapshot-level encode cache: (interner token, per-file scan
         # keys) → (DeclTensor, flat node list). Repeated merges against
         # an unchanged tree skip interning + concatenation entirely
@@ -96,8 +100,10 @@ class TpuTSBackend:
     def _fused_engine(self):
         from ..ops.fused import FusedMergeEngine
         if (self._fused is None or self._fused.interner is not self._interner
-                or self._fused.mesh is not self._mesh):
-            self._fused = FusedMergeEngine(self._interner, mesh=self._mesh)
+                or self._fused.mesh is not self._mesh
+                or self._fused.host_workers_cfg != self._host_workers):
+            self._fused = FusedMergeEngine(self._interner, mesh=self._mesh,
+                                           host_workers=self._host_workers)
         return self._fused
 
     def _scan_encode(self, snapshot: Snapshot):
@@ -173,6 +179,8 @@ class TpuTSBackend:
         auto dp mesh, and ``"hybrid:dcn=dp,dp=4,..."`` builds the
         multi-slice mesh whose ``dcn`` axis crosses slices over DCN
         while every other axis rides ICI."""
+        workers = int(getattr(config.engine, "host_workers", 0) or 0)
+        self._host_workers = workers if workers > 0 else None
         shape = getattr(config.engine, "mesh_shape", "auto")
         try:
             from ..parallel.mesh import build_mesh, parse_mesh_spec

@@ -364,12 +364,18 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
             touched = None
             if config.engine.formatter_scope == "touched":
                 # Everything the merge wrote: the op stream's path
-                # params plus text-fallback writes of formatter-relevant
-                # (indexed) extensions — a text-merged notes.txt or
-                # binary must not reach prettier as an explicit arg.
-                # Untouched files keep their bytes.
+                # params plus text-fallback writes of FORMATTER-parseable
+                # suffixes. The filter must be the formatter's language
+                # set, not the backend's indexed extensions — text
+                # fallback only ever writes files OUTSIDE the indexed
+                # set, so the two are disjoint by construction and the
+                # old filter dropped every text-merged .json/.md/.css
+                # while letting notes.txt through when no backend set
+                # existed. A text-merged notes.txt or binary must not
+                # reach prettier as an explicit arg. Untouched files
+                # keep their bytes.
                 from .runtime.applier import _normalize_relpath
-                exts = getattr(backend, "extensions", None)
+                from .runtime.emitter import PRETTIER_EXTENSIONS
                 touched = {str(_normalize_relpath(v))
                            for op in composed
                            for k in ("file", "oldFile", "newFile",
@@ -377,8 +383,8 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                            if isinstance((v := op.params.get(k)), str) and v}
                 touched.update(
                     str(_normalize_relpath(p)) for p in text_written
-                    if exts is None
-                    or pathlib.PurePosixPath(p).suffix in exts)
+                    if pathlib.PurePosixPath(p).suffix.lower()
+                    in PRETTIER_EXTENSIONS)
             emit_files(merged_tree, formatter, paths=touched)
         with tracer.phase("typecheck"):
             if config.ci.require_typecheck:

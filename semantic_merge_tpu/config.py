@@ -30,6 +30,9 @@ Schema (superset of the reference's documented schema at reference
                                    # (implied by conflict_mode = "strict")
     structured_apply = false       # ops carry decl text/spans; applier splices
                                    # add/delete/changeSignature structurally
+    host_workers = 0               # host-tail pipeline worker threads
+                                   # (0 => auto: min(8, cpu_count);
+                                   # SEMMERGE_HOST_WORKERS overrides)
     max_nodes_per_bucket = 2048    # padding bucket sizes, powers of two
     mesh_shape = "auto"            # or e.g. "dp=4,tp=2"
 
@@ -82,6 +85,11 @@ class EngineConfig:
     # actually wrote — untouched files keep their bytes (comment/format
     # preservation for the 99% of a large repo a merge never visits).
     formatter_scope: str = "tree"
+    # Host-tail pipeline worker threads (chunked decode/materialize/
+    # serialize of the fused merge's post-kernel tail). 0 = auto
+    # (min(8, cpu_count)); the SEMMERGE_HOST_WORKERS env var overrides
+    # both (see ops.fused.resolve_host_workers).
+    host_workers: int = 0
     max_nodes_per_bucket: int = 2048
     mesh_shape: str = "auto"
     # Model-scored changeSignature pairing for renamed+retyped decls
@@ -162,6 +170,8 @@ def load_config(start: pathlib.Path | None = None) -> Config:
         formatter_scope=_validated(
             str(engine.get("formatter_scope", config.engine.formatter_scope)),
             "engine.formatter_scope", ("tree", "touched")),
+        host_workers=int(
+            engine.get("host_workers", config.engine.host_workers)),
         max_nodes_per_bucket=int(
             engine.get("max_nodes_per_bucket", config.engine.max_nodes_per_bucket)
         ),

@@ -350,6 +350,21 @@ def _decl_block(text: str) -> str:
 _IDENT_CHARS = frozenset(
     "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789_$")
 
+#: Minimum normalized-block size for motion evidence: at least this
+#: many statements (``;`` count) or strictly more characters. A
+#: trivial shared block — the bare ``return null;`` class — occurs in
+#: unrelated declarations by coincidence, and since ``blockHash`` is
+#: content-only, opposite-side trivial "motions" join into a false
+#: ExtractVsInline strict-mode abort of a clean merge (ADVICE round 5).
+_MIN_MOTION_STMTS = 2
+_MIN_MOTION_CHARS = 15
+
+
+def _block_significant(block: str) -> bool:
+    """Whether a normalized block is big enough to be motion evidence."""
+    return (len(block) > _MIN_MOTION_CHARS
+            or block.count(";") >= _MIN_MOTION_STMTS)
+
 
 def _block_in(block: str, text: str) -> bool:
     """True when ``block`` occurs in ``text`` at identifier boundaries:
@@ -398,13 +413,31 @@ def body_motions(diffs, stmt_ops: List[Op], sources,
     anything. One motion per added/deleted decl (first matching edit in
     stream order wins); ids continue the statement stream's index
     sequence, keeping the whole op stream a deterministic function of
-    (seed, rev, content)."""
+    (seed, rev, content).
+
+    Blocks below the minimum size (:func:`_block_significant`) are not
+    motion evidence; and the edit bodies are pre-indexed by a cheap
+    fingerprint — each body normalized exactly once, a length bucket
+    (a block cannot occur in a body shorter than itself), and one
+    NUL-joined haystack of every body so the common no-motion candidate
+    is rejected by a single C-speed substring scan instead of
+    per-edit boundary-aware scans (O(adds×edits×body) before)."""
     base_map, side_map = sources
     edits = [op for op in stmt_ops if op.type == "editStmtBlock"]
     ops: List[Op] = []
     idx = start_idx
+    if not edits:
+        return ops
     prov = {"rev": base_rev, "timestamp": timestamp}
     from .ids import stable_hash_hex
+    norm_old = [" ".join(str(e.params.get("oldBody", "")).split())
+                for e in edits]
+    norm_new = [" ".join(str(e.params.get("newBody", "")).split())
+                for e in edits]
+    max_body = max(map(len, norm_old + norm_new))
+    # '\x00' never survives whitespace normalization of source text, so
+    # a block cannot falsely match across two bodies' boundary.
+    haystack = "\x00".join(norm_old + norm_new)
     for d in diffs:
         if d.kind == "add" and d.b is not None:
             node, src = d.b, side_map.get(d.b.file)
@@ -415,11 +448,11 @@ def body_motions(diffs, stmt_ops: List[Op], sources,
         if src is None:
             continue
         block = _decl_block(src[node.pos:node.end])
-        if not block:
+        if not block or not _block_significant(block):
             continue
-        for e in edits:
-            old = " ".join(str(e.params.get("oldBody", "")).split())
-            new = " ".join(str(e.params.get("newBody", "")).split())
+        if len(block) > max_body or block not in haystack:
+            continue  # no body contains the block — no scan needed
+        for e, old, new in zip(edits, norm_old, norm_new):
             if d.kind == "add" and _block_in(block, old) \
                     and not _block_in(block, new):
                 ops.append(Op.new(

@@ -230,6 +230,20 @@ def bucket_size(n: int, minimum: int = 8) -> int:
     return size
 
 
+def shard_ranges(n: int, rows_per_shard: int) -> list[tuple[int, int]]:
+    """Split ``n`` rows into contiguous ``(lo, hi)`` ranges of at most
+    ``rows_per_shard`` rows — the host-tail pipeline's shard plan. The
+    split is a pure function of ``(n, rows_per_shard)`` so every
+    consumer (chain decode, op materialization, shard serialization)
+    agrees on shard boundaries, and the deterministic shard-order merge
+    of per-shard results reproduces the serial output byte-for-byte.
+    ``n = 0`` yields no shards (the empty-stream fast paths)."""
+    if n <= 0:
+        return []
+    rows = max(1, int(rows_per_shard))
+    return [(lo, min(lo + rows, n)) for lo in range(0, n, rows)]
+
+
 def shard_bucket(n: int, k: int = 1) -> int:
     """Bucket that divides evenly into ``k`` shards: ``k`` × a ladder
     value ≥ ceil(n/k), at least 8 rows total. For ``k = 1`` this equals

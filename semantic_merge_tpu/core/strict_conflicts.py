@@ -59,13 +59,24 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
                             ) -> Tuple[List[Op], List[Op], List[Conflict]]:
     """Full-stream conflict join; returns the two streams with
     conflicting ops dropped plus the conflict records (stable order:
-    by first involved A-op's stream position)."""
+    by first involved A-op's stream position; detection-order ties keep
+    emission order). Every conflict records which A-stream op it
+    involves at emission time, and the final list sorts on that
+    position — so the documented ordering holds even though the
+    motion pass runs before the per-symbol loops."""
     by_sym_a = _group(delta_a)
     by_sym_b = _group(delta_b)
 
     drop_a: set = set()
     drop_b: set = set()
-    conflicts: List[Conflict] = []
+    # (A-op stream position, conflict) pairs; sorted (stably) at the
+    # end so the motion-pass-first detection schedule does not leak
+    # into the output order.
+    pos_a = {id(op): i for i, op in enumerate(delta_a)}
+    keyed: List[Tuple[int, Conflict]] = []
+
+    def emit(a_op: Op, conflict: Conflict) -> None:
+        keyed.append((pos_a.get(id(a_op), len(delta_a)), conflict))
 
     # Body-motion pass first (cross-symbol join on blockHash): an
     # ExtractVsInline conflict consumes the motion's companion
@@ -76,7 +87,7 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
     # their established pairing behavior.
     consumed_a: set = set()
     consumed_b: set = set()
-    _motion_pass(delta_a, delta_b, consumed_a, consumed_b, conflicts)
+    _motion_pass(delta_a, delta_b, consumed_a, consumed_b, emit)
     drop_a |= consumed_a
     drop_b |= consumed_b
 
@@ -90,7 +101,7 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
         for op_a in ren_a:
             for op_b in ren_b:
                 if op_a.params.get("newName") != op_b.params.get("newName"):
-                    conflicts.append(divergent_rename_conflict(op_a, op_b))
+                    emit(op_a, divergent_rename_conflict(op_a, op_b))
                     drop_a.add(id(op_a))
                     drop_b.add(id(op_b))
 
@@ -99,7 +110,7 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
         for op_a in mov_a:
             for op_b in mov_b:
                 if op_a.params.get("newAddress") != op_b.params.get("newAddress"):
-                    conflicts.append(divergent_move_conflict(op_a, op_b))
+                    emit(op_a, divergent_move_conflict(op_a, op_b))
                     drop_a.add(id(op_a))
                     drop_b.add(id(op_b))
 
@@ -108,7 +119,7 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
         for op_a in sig_a:
             for op_b in sig_b:
                 if op_a.params.get("newSignature") != op_b.params.get("newSignature"):
-                    conflicts.append(incompatible_signature_conflict(op_a, op_b))
+                    emit(op_a, incompatible_signature_conflict(op_a, op_b))
                     drop_a.add(id(op_a))
                     drop_b.add(id(op_b))
 
@@ -127,7 +138,7 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
                 if (op_a.target.addressId == op_b.target.addressId
                         and op_a.params.get("newBodyHash")
                         != op_b.params.get("newBodyHash")):
-                    conflicts.append(concurrent_stmt_edit_conflict(op_a, op_b))
+                    emit(op_a, concurrent_stmt_edit_conflict(op_a, op_b))
                     drop_a.add(id(op_a))
                     drop_b.add(id(op_b))
 
@@ -139,25 +150,26 @@ def detect_conflicts_strict(delta_a: List[Op], delta_b: List[Op],
             for op_edit in edit_b:
                 if id(op_del) in consumed_a and id(op_edit) in consumed_b:
                     continue
-                conflicts.append(delete_vs_edit_conflict(op_del, op_edit, "A"))
+                emit(op_del, delete_vs_edit_conflict(op_del, op_edit, "A"))
                 drop_a.add(id(op_del))
                 drop_b.add(id(op_edit))
         for op_del in del_b:
             for op_edit in edit_a:
                 if id(op_del) in consumed_b and id(op_edit) in consumed_a:
                     continue
-                conflicts.append(delete_vs_edit_conflict(op_del, op_edit, "B"))
+                emit(op_edit, delete_vs_edit_conflict(op_del, op_edit, "B"))
                 drop_b.add(id(op_del))
                 drop_a.add(id(op_edit))
 
     kept_a = [op for op in delta_a if id(op) not in drop_a]
     kept_b = [op for op in delta_b if id(op) not in drop_b]
-    return kept_a, kept_b, conflicts
+    keyed.sort(key=lambda t: t[0])  # stable: ties keep emission order
+    return kept_a, kept_b, [c for _, c in keyed]
 
 
 def _motion_pass(delta_a: List[Op], delta_b: List[Op],
                  consumed_a: set, consumed_b: set,
-                 conflicts: List[Conflict]) -> None:
+                 emit) -> None:
     """ExtractVsInline detection plus the [RES-004] extract dedup.
 
     Both rules join ``extractMethod``/``inlineMethod`` markers on
@@ -201,7 +213,10 @@ def _motion_pass(delta_a: List[Op], delta_b: List[Op],
         if (not ext.params.get("blockHash")
                 or ext.params.get("blockHash") != inl.params.get("blockHash")):
             continue
-        conflicts.append(extract_vs_inline_conflict(ext, inl, side))
+        # The A-stream op of the pair keys the output ordering: the
+        # extract marker when A extracted ("A" side), else A's inline.
+        emit(ext if side == "A" else inl,
+             extract_vs_inline_conflict(ext, inl, side))
         ext_stream, ext_set = ((delta_a, consumed_a) if side == "A"
                                else (delta_b, consumed_b))
         inl_stream, inl_set = ((delta_b, consumed_b) if side == "A"

@@ -356,18 +356,29 @@ def decode_compose_output(out: np.ndarray, delta_a: List[Op], delta_b: List[Op],
     # and `.tolist()` turns the int32 rows into plain ints once — the
     # per-op numpy-scalar indexing this replaces was the hot loop at the
     # 1k-file rung (VERDICT round 1, Weak #3).
-    strings = interner.object_table()
     sides = out_side[:n_out].tolist()
     rows = out_row[:n_out].tolist()
-    addr_s = strings[chain_addr[:n_out]].tolist() if n_out else []
-    file_s = strings[chain_file[:n_out]].tolist() if n_out else []
-    name_s = strings[chain_name[:n_out]].tolist() if n_out else []
 
-    composed: List[Op] = []
-    for side, row, new_addr, new_file, rename_ctx in zip(
-            sides, rows, addr_s, file_s, name_s):
-        op = (sorted_a if side == 0 else sorted_b)[row]
-        composed.append(_materialize_decoded(op, new_addr, new_file, rename_ctx))
+    # Vectorized no-override fast path: a row with all three chain
+    # columns NULL passes its stream op through unchanged
+    # (_materialize_decoded's identity case), so the common
+    # chains-don't-fire merge never calls it at all — the composed list
+    # assembles as plain gathers and only override rows pay the
+    # per-op clone.
+    ca, cf, cn = chain_addr[:n_out], chain_file[:n_out], chain_name[:n_out]
+    composed: List[Op] = [
+        (sorted_a if side == 0 else sorted_b)[row]
+        for side, row in zip(sides, rows)]
+    override_rows = np.nonzero(
+        (ca != NULL_ID) | (cf != NULL_ID) | (cn != NULL_ID))[0]
+    if len(override_rows):
+        strings = interner.object_table()
+        addr_s = strings[ca[override_rows]].tolist()
+        file_s = strings[cf[override_rows]].tolist()
+        name_s = strings[cn[override_rows]].tolist()
+        for k, i in enumerate(override_rows.tolist()):
+            composed[i] = _materialize_decoded(
+                composed[i], addr_s[k], file_s[k], name_s[k])
 
     conflicts: List[Conflict] = []
     for k in range(n_conf):

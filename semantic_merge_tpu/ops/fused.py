@@ -51,7 +51,10 @@ Replaces the hot path of reference ``workers/ts/src/diff.ts:5-31``,
 from __future__ import annotations
 
 import os
+import threading
+import time
 from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 from functools import lru_cache, partial
 from typing import Dict, List, Optional, Tuple
 
@@ -60,7 +63,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.conflict import Conflict, divergent_rename_conflict
-from ..core.encode import NULL_ID, PAD_ID, DeclTensor, Interner, bucket_size, pad_to
+from ..core.encode import (NULL_ID, PAD_ID, DeclTensor, Interner,
+                           bucket_size, pad_to, shard_ranges)
 from ..core.ops import Op
 from ..obs import device as obs_device
 from ..obs import metrics as obs_metrics
@@ -70,7 +74,7 @@ from .compose import (_PAD_PREC, _local_seg_scan,
                       _rename_pairs, _sort_perm)
 from .diff import KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME, _diff_plan
 from .oplog_view import (ComposedOpView, OpStreamView,
-                         cursor_walk_conflicts_columnar)
+                         cursor_walk_conflicts_renames_only)
 from .sha256 import sha256_device
 
 #: OP_PRECEDENCE of each KIND_* code (core/ops.py).
@@ -79,6 +83,212 @@ _PREC_BY_KIND = np.asarray([11, 10, 30, 31], dtype=np.int32)
 #: Byte length of the fixed op-id payload (core.ids.deterministic_op_id):
 #: prefix digest 16 + idx 4 + type code 1 + 3×10-byte string digests.
 _ID_PAYLOAD_LEN = 51
+
+
+# --------------------------------------------------------------------------
+# Host-tail pipeline: chunked decode → materialize → serialize workers
+# --------------------------------------------------------------------------
+
+def resolve_host_workers(configured: Optional[int] = None) -> int:
+    """Worker count for the host-tail pipeline. Resolution order:
+    ``SEMMERGE_HOST_WORKERS`` env var, then the ``[engine]
+    host_workers`` config value (``configured``), then the default
+    ``min(8, cpu_count)``. Always ≥ 1 (1 = serial execution through
+    the same shard plan — byte-identical output)."""
+    env = os.environ.get("SEMMERGE_HOST_WORKERS", "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            from ..utils.loggingx import logger
+            logger.warning("invalid SEMMERGE_HOST_WORKERS=%r ignored", env)
+    if configured:
+        return max(1, int(configured))
+    return min(8, os.cpu_count() or 1)
+
+
+_pool_lock = threading.Lock()
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_size = 0
+
+
+def _host_pool(workers: int) -> ThreadPoolExecutor:
+    """Process-shared tail worker pool, resized on demand (merges are
+    sequential per process; the pool outlives engines so warm merges
+    skip thread startup)."""
+    global _pool, _pool_size
+    with _pool_lock:
+        if _pool is None or _pool_size != workers:
+            if _pool is not None:
+                _pool.shutdown(wait=False)
+            _pool = ThreadPoolExecutor(max_workers=workers,
+                                       thread_name_prefix="semmerge-tail")
+            _pool_size = workers
+        return _pool
+
+
+class _Immediate:
+    """Future-shaped wrapper that runs its thunk at ``result()`` — the
+    inline (non-pooled) execution mode of :class:`TailPlan` shards."""
+
+    __slots__ = ("_fn", "_val", "_done")
+
+    def __init__(self, fn) -> None:
+        self._fn = fn
+        self._val = None
+        self._done = False
+
+    def result(self):
+        if not self._done:
+            self._val = self._fn()
+            self._done = True
+            self._fn = None
+        return self._val
+
+
+class _OnceCell:
+    """Thread-safe memoized thunk — shards share one chains fetch and
+    one interner table snapshot without racing the producers."""
+
+    __slots__ = ("_fn", "_lock", "_val", "_done")
+
+    def __init__(self, fn) -> None:
+        self._fn = fn
+        self._lock = threading.Lock()
+        self._val = None
+        self._done = False
+
+    def get(self):
+        if self._done:
+            return self._val
+        with self._lock:
+            if not self._done:
+                self._val = self._fn()
+                self._done = True
+                self._fn = None
+        return self._val
+
+
+class TailPipeline:
+    """Worker pool + shard geometry for the post-kernel host tail.
+
+    One instance per engine; attached to the op-stream/composed views
+    so chain decode, op materialization, and op-log serialization all
+    run as row-range shards over the same pool. ``shard_rows`` (env
+    ``SEMMERGE_TAIL_SHARD_ROWS``, default 8192) bounds shard size; the
+    per-shard results merge in deterministic shard order, so output is
+    byte-identical for every worker count."""
+
+    __slots__ = ("workers", "shard_rows", "eager_overlap")
+
+    def __init__(self, workers: Optional[int] = None,
+                 shard_rows: Optional[int] = None) -> None:
+        self.workers = workers if workers else resolve_host_workers()
+        if shard_rows is None:
+            env = os.environ.get("SEMMERGE_TAIL_SHARD_ROWS", "").strip()
+            shard_rows = int(env) if env.isdigit() and int(env) > 0 else 8192
+        self.shard_rows = shard_rows
+        # Whether to pre-submit shard decodes at merge return and to
+        # fan serialization out across the pool (overlapping the
+        # caller's work). Requires BOTH more than one worker and more
+        # than one core: with a single worker there is nothing to
+        # overlap with, and on a single-core host pooled jobs only
+        # time-slice against the very phases they would hide behind —
+        # shards run lazily in submission order instead (same plan,
+        # same deterministic output). A plain attribute so tests can
+        # force the concurrent schedule on any host.
+        self.eager_overlap = self.workers > 1 and (os.cpu_count() or 1) > 1
+
+    def submit(self, fn, *args):
+        return _host_pool(self.workers).submit(fn, *args)
+
+
+class TailPlan:
+    """Shard plan for ONE merge's composed stream: the ranges, the
+    chain-decode function, and memoized per-shard decode results.
+
+    ``decode_fn(lo, hi)`` returns the shard's decoded chain-override
+    columns ``(addr, file, name)`` (local indexing). The plan may be
+    driven eagerly (:meth:`prefetch` — the producer/consumer overlap:
+    decodes run in workers while the caller serializes op-log payloads,
+    and on a real accelerator link while later shards' chain bytes are
+    still in flight) or lazily (first materialize/chain access). A
+    queued-but-unstarted decode future is cancelled and computed inline
+    by its consumer, so shard consumers never deadlock behind their own
+    pool (workers=1 included). Worker execution is recorded under the
+    ``materialize_overlap`` phase."""
+
+    def __init__(self, pipeline: TailPipeline, n: int, decode_fn) -> None:
+        self.pipeline = pipeline
+        self.ranges = shard_ranges(n, pipeline.shard_rows)
+        self._decode_fn = decode_fn
+        self._lock = threading.Lock()
+        self._decoded: Dict[Tuple[int, int], object] = {}
+
+    def prefetch(self) -> None:
+        """Submit every shard's chain decode to the pool now."""
+        with self._lock:
+            for r in self.ranges:
+                if r not in self._decoded:
+                    self._decoded[r] = self.pipeline.submit(
+                        self._timed_decode, *r)
+
+    def _timed_decode(self, lo: int, hi: int):
+        t0 = time.perf_counter()
+        out = self._decode_fn(lo, hi)
+        obs_spans.record("materialize_overlap", time.perf_counter() - t0,
+                         layer="ops", stage="decode", rows=hi - lo)
+        return out
+
+    def _shard_overrides(self, lo: int, hi: int):
+        key = (lo, hi)
+        with self._lock:
+            ent = self._decoded.get(key)
+        if isinstance(ent, tuple):
+            return ent
+        if ent is not None:
+            if ent.cancel():  # queued but unstarted: compute inline
+                out = self._timed_decode(lo, hi)
+            else:
+                out = ent.result()
+        else:
+            out = self._timed_decode(lo, hi)
+        with self._lock:
+            self._decoded[key] = out
+        return out
+
+    def submit_materialize(self, lo: int, hi: int, build_fn):
+        """Submit one shard's materialization (``build_fn(lo, hi,
+        overrides) -> list``); the job resolves its own shard's decode
+        first (cached, cancelled-inline, or computed). Without
+        ``eager_overlap`` the shard runs inline in the consumer thread
+        instead — on a single core the pool's GIL hand-offs between
+        blocked workers only add cost, and the shard plan (hence the
+        output) is identical either way."""
+        def run():
+            overrides = self._shard_overrides(lo, hi)
+            t0 = time.perf_counter()
+            ops = build_fn(lo, hi, overrides)
+            obs_spans.record("materialize_overlap",
+                             time.perf_counter() - t0, layer="ops",
+                             stage="materialize", rows=hi - lo)
+            return ops
+        if not self.pipeline.eager_overlap:
+            return _Immediate(run)
+        return self.pipeline.submit(run)
+
+    def decode_all(self) -> Tuple[list, list, list]:
+        """All shards' override columns concatenated in shard order —
+        the full-column view for single-op access paths."""
+        addr: list = []
+        file: list = []
+        name: list = []
+        for lo, hi in self.ranges:
+            a, f, nm = self._shard_overrides(lo, hi)
+            addr.extend(a)
+            file.extend(f)
+            name.extend(nm)
+        return addr, file, name
 
 
 class DeviceStrings:
@@ -489,9 +699,10 @@ def _sharded_fn(mesh, nb: int, nl: int, nr: int,
                 C: int, k: int, split: bool = False):
     from jax.sharding import PartitionSpec as P
 
+    from ..utils.jaxenv import shard_map_compat
     from .sharded import AXIS
     decl = P(None, AXIS)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         partial(_fused_merge_sharded_core, nb=nb, nl=nl, nr=nr,
                 C=C, k=k, split=split),
         mesh=mesh, in_specs=(decl, decl, decl, P(), P(), P()),
@@ -513,9 +724,15 @@ class FusedMergeEngine:
     identity — warm merges ship zero input bytes), and the learned op
     capacity hint that sizes the compact output."""
 
-    def __init__(self, interner: Interner, mesh=None) -> None:
+    def __init__(self, interner: Interner, mesh=None,
+                 host_workers: Optional[int] = None) -> None:
         self.interner = interner
         self.mesh = mesh
+        #: Config-level worker request (None = auto); the resolved
+        #: pipeline lives in _tail. Kept so backends can detect a
+        #: config change and rebuild the engine.
+        self.host_workers_cfg = host_workers
+        self._tail = TailPipeline(resolve_host_workers(host_workers))
         self._dp = 1
         self._decl_sharding = None
         self._repl_sharding = None
@@ -600,7 +817,8 @@ class FusedMergeEngine:
                             base_nodes, side_nodes,
                             {"rev": base_rev, "timestamp": timestamp},
                             base_tbl_ref=(self._tbl_cache, base_key),
-                            side_tbl_ref=(self._tbl_cache, side_key))
+                            side_tbl_ref=(self._tbl_cache, side_key),
+                            pipeline=self._tail)
 
     def merge(self, base_t: DeclTensor, base_key, base_nodes,
               left_t: DeclTensor, left_key, left_nodes,
@@ -620,6 +838,19 @@ class FusedMergeEngine:
         independent host work (e.g. symbolMaps construction) overlaps
         device compute instead of serializing after it.
 
+        The post-kernel HOST TAIL is pipelined: the composed stream is
+        split into row-range shards (a :class:`TailPlan` over the
+        engine's :class:`TailPipeline` worker pool, ``[engine]
+        host_workers`` / ``SEMMERGE_HOST_WORKERS``), and each shard's
+        chain decode → op materialization runs as an independent pool
+        job — pre-submitted at merge return when more than one worker
+        is available, so shard decodes overlap the caller's op-log
+        serialization (itself sharded over the same pool) and, on a
+        real accelerator link, the still-in-flight chain-column
+        transfer. Shard results merge in deterministic shard order:
+        output is byte-identical for every worker count. Worker-side
+        execution is recorded under the ``materialize_overlap`` phase.
+
         Detailed phase splits (h2d/kernel/fetch/materialize/
         compose_decode) are recorded through
         :mod:`semantic_merge_tpu.obs` only while a span recorder is
@@ -627,8 +858,6 @@ class FusedMergeEngine:
         split needs a ``block_until_ready`` fence that would otherwise
         serialize the dispatch/fetch overlap this path exists for.
         """
-        import time
-
         from ..core.ids import op_id_prefix_digest
         detailed = obs_spans.active()
         t0 = time.perf_counter()
@@ -655,6 +884,7 @@ class FusedMergeEngine:
         # packed fetch.
         split = os.environ.get("SEMMERGE_SPLIT_FETCH", "1") == "1"
         flat = mid_dev = chains_dev = None
+        warm_caches = True
         for _attempt in range(4):
             C = self._bucket(max(self._cap_hint, 8 * self._dp))
             t0 = time.perf_counter()
@@ -672,6 +902,21 @@ class FusedMergeEngine:
                 # with the device execution.
                 overlap_work()
                 overlap_work = None  # once per merge, not per retry
+            if warm_caches:
+                # Serializer-cache prefetch, same overlap seam: the
+                # node tables (native op-log renderer) and field lists
+                # (C op factory) every tail consumer will need are
+                # built while the kernel is still in flight, so the
+                # first to_json/materialize after merge() returns pays
+                # a cache hit instead of three 40k-node table builds.
+                from .oplog_view import _get_fields, _get_table
+                for nodes, key in ((base_nodes, base_key),
+                                   (left_nodes, left_key),
+                                   (right_nodes, right_key)):
+                    if key is not None:
+                        _get_table((self._tbl_cache, key), nodes)
+                        _get_fields((self._tbl_cache, key), nodes)
+                warm_caches = False
             if detailed:
                 head_dev.block_until_ready()
                 obs_spans.record("kernel", time.perf_counter() - t0,
@@ -715,11 +960,13 @@ class FusedMergeEngine:
         ops_l = OpStreamView(kL[:n_l], aL[:n_l], bL[:n_l], wL[:n_l],
                              base_nodes, left_nodes, prov,
                              base_tbl_ref=base_ref,
-                             side_tbl_ref=(self._tbl_cache, left_key))
+                             side_tbl_ref=(self._tbl_cache, left_key),
+                             pipeline=self._tail)
         ops_r = OpStreamView(kR[:n_r], aR[:n_r], bR[:n_r], wR[:n_r],
                              base_nodes, right_nodes, prov,
                              base_tbl_ref=base_ref,
-                             side_tbl_ref=(self._tbl_cache, right_key))
+                             side_tbl_ref=(self._tbl_cache, right_key),
+                             pipeline=self._tail)
         if detailed:
             obs_spans.record("materialize", time.perf_counter() - t0,
                              layer="ops")
@@ -746,12 +993,12 @@ class FusedMergeEngine:
             chain_cols = (take(2 * C), take(2 * C), take(2 * C))
 
         refs = ref[:n_out]
-        sides_np = refs >> 30
-        idxs_np = refs & ((1 << 30) - 1)
-        table = self.interner.object_table()
+        sides_np = (refs >> 30).astype(np.int32, copy=False)
+        idxs_np = (refs & ((1 << 30) - 1)).astype(np.int32, copy=False)
 
         conflicts: List[Conflict] = []
-        ctx_writes: List[tuple] = []
+        ctx_rows: List[int] = []
+        ctx_vals: List[object] = []
         keep = None
         if has_cand:
             # Columnar cursor walk: the reference's head-vs-head
@@ -759,8 +1006,12 @@ class FusedMergeEngine:
             # symbolId, newName), all derivable as int columns — the
             # interner makes int equality string equality, and every op
             # of one fused merge shares a single timestamp, so the
-            # (prec, ts) keys collapse to precedence ints. No Op
-            # objects materialize unless a conflict actually fires.
+            # (prec, ts) keys collapse to precedence ints. The walk runs
+            # on each side's RENAME substream only (equivalent for
+            # canonically-sorted 4-kind streams — see
+            # cursor_walk_conflicts_renames_only), so its cost scales
+            # with the rename count, not the op count. No Op objects
+            # materialize unless a conflict actually fires.
             pL, pR = permL[:n_l], permR[:n_r]
             kLr, kRr = kL[:n_l], kR[:n_r]
 
@@ -775,13 +1026,14 @@ class FusedMergeEngine:
 
             symL_raw, nameL_raw = raw_cols(kLr, aL[:n_l], bL[:n_l], left_t)
             symR_raw, nameR_raw = raw_cols(kRr, aR[:n_r], bR[:n_r], right_t)
-            pairs, da, db = cursor_walk_conflicts_columnar(
-                _PREC_BY_KIND[kLr[pL]].tolist(),
-                (kLr[pL] == KIND_RENAME).tolist(),
-                symL_raw[pL].tolist(), nameL_raw[pL].tolist(),
-                _PREC_BY_KIND[kRr[pR]].tolist(),
-                (kRr[pR] == KIND_RENAME).tolist(),
-                symR_raw[pR].tolist(), nameR_raw[pR].tolist())
+            symL_s, nameL_s = symL_raw[pL], nameL_raw[pL]
+            symR_s, nameR_s = symR_raw[pR], nameR_raw[pR]
+            renL = np.nonzero(kLr[pL] == KIND_RENAME)[0]
+            renR = np.nonzero(kRr[pR] == KIND_RENAME)[0]
+            pairs, da, db = cursor_walk_conflicts_renames_only(
+                renL, symL_s[renL], nameL_s[renL],
+                renR, symR_s[renR], nameR_s[renR],
+                prec_rename=int(_PREC_BY_KIND[KIND_RENAME]))
             conflicts = [divergent_rename_conflict(ops_l[int(pL[ia])],
                                                    ops_r[int(pR[ib])])
                          for ia, ib in pairs]
@@ -792,8 +1044,10 @@ class FusedMergeEngine:
                 # composed order (drops are always renames, so the
                 # addr/file chains from the device scan remain exact).
                 # Only the rename-context values touch the chain
-                # columns, and those are recorded as (pre-keep row,
-                # value) writes so the chain decode can stay deferred.
+                # columns, and those are recorded as (final row, value)
+                # writes so the chain decode can stay deferred — and
+                # shard-local (each pipeline shard applies only the
+                # writes falling in its row range).
                 droppedL = np.asarray(sorted(int(pL[i]) for i in da))
                 droppedR = np.asarray(sorted(int(pR[j]) for j in db))
                 drop_mask = (((sides_np == 0)
@@ -812,30 +1066,39 @@ class FusedMergeEngine:
                 kind_row = np.where(sides_np == 0, kLr[il], kRr[ir])
                 newname_row = np.where(sides_np == 0,
                                        nameL_raw[il], nameR_raw[ir])
+                table = self.interner.object_table()
                 ctx: Dict[int, object] = {}
                 for i in np.nonzero(aff_mask)[0].tolist():
                     sym = int(sym_row[i])
                     if kind_row[i] == KIND_RENAME:
                         ctx[sym] = table[newname_row[i]]
-                    ctx_writes.append((i, ctx.get(sym)))
+                    ctx_rows.append(i)
+                    ctx_vals.append(ctx.get(sym))
                 keep = np.nonzero(~drop_mask)[0]
                 sides_np, idxs_np = sides_np[keep], idxs_np[keep]
+                # Affected rows are all kept, so their final positions
+                # are their ranks within `keep`.
+                ctx_rows = np.searchsorted(
+                    keep, np.asarray(ctx_rows, np.int64)).tolist()
 
         n_pre = n_out  # pre-keep row count for the deferred gathers
         # Bind just the interner: closing over `self` would pin the
         # whole engine (device decl/byte-table caches) for the lifetime
         # of any unread split-fetch composed view.
         interner = self.interner
+        keep_idx = keep
+        ctx_row_arr = np.asarray(ctx_rows, np.int64)
 
-        def decode_chains():
-            """Fetch (split mode) and decode the chain-override columns.
-            Runs inside the compose_decode window on the one-buffer
-            path; on the split path it runs at first composed-view
-            access — by which point the chain bytes have been streaming
-            host-ward since dispatch. ``object_table()`` is re-fetched
-            here because gathers must not be separated from the live
-            view (the interner may have grown since ``merge`` returned;
-            indices are append-only stable)."""
+        def fetch_chains():
+            """Fetch (split mode) and slice the chain-override columns,
+            plus one interner-table snapshot — shared by every decode
+            shard through a _OnceCell (shards may race; the cell
+            serializes the producers). On the split path the chain
+            bytes have been streaming host-ward since dispatch;
+            ``object_table()`` is re-fetched here because gathers must
+            not be separated from the live view (the interner may have
+            grown since ``merge`` returned; indices are append-only
+            stable)."""
             t1 = time.perf_counter()
             if chain_cols is not None:
                 c_addr, c_file, c_name = chain_cols
@@ -844,32 +1107,42 @@ class FusedMergeEngine:
                 obs_device.record_transfer("d2h", fc.nbytes)
                 c_addr, c_file, c_name = (fc[:2 * C], fc[2 * C:4 * C],
                                           fc[4 * C:])
-            # One object-array gather per chain column (NULL_ID wraps
-            # to the mirror's trailing None).
             tbl = interner.object_table()
-            addr_o = tbl[c_addr[:n_pre]]
-            file_o = tbl[c_file[:n_pre]]
-            name_o = tbl[c_name[:n_pre]]
-            for i, v in ctx_writes:
-                name_o[i] = v
-            if keep is not None:
-                addr_o, file_o, name_o = addr_o[keep], file_o[keep], name_o[keep]
             if detailed and split:
                 # On the one-buffer path this work already sits inside
                 # the compose_decode window; a separate key would
                 # double-count it.
                 obs_spans.record("chain_decode", time.perf_counter() - t1,
                                  layer="ops")
-            return addr_o.tolist(), file_o.tolist(), name_o.tolist()
+            return (c_addr[:n_pre], c_file[:n_pre], c_name[:n_pre], tbl)
 
-        if split:
-            composed = ComposedOpView.deferred(
-                sides_np.tolist(), idxs_np.tolist(), decode_chains,
-                ops_l, ops_r)
-        else:
-            addr_s, file_s, name_s = decode_chains()
-            composed = ComposedOpView(sides_np.tolist(), idxs_np.tolist(),
-                                      addr_s, file_s, name_s, ops_l, ops_r)
+        chains_cell = _OnceCell(fetch_chains)
+
+        def decode_rows(lo, hi):
+            """One shard's chain-override decode: object-array gathers
+            over the shard's pre-keep rows (NULL_ID wraps to the
+            mirror's trailing None) plus the shard-local rename-context
+            writes."""
+            c_addr, c_file, c_name, tbl = chains_cell.get()
+            rows = slice(lo, hi) if keep_idx is None else keep_idx[lo:hi]
+            addr_o = tbl[c_addr[rows]].tolist()
+            file_o = tbl[c_file[rows]].tolist()
+            name_o = tbl[c_name[rows]].tolist()
+            if len(ctx_row_arr):
+                j0, j1 = np.searchsorted(ctx_row_arr, (lo, hi))
+                for j in range(int(j0), int(j1)):
+                    name_o[int(ctx_row_arr[j]) - lo] = ctx_vals[j]
+            return addr_o, file_o, name_o
+
+        plan = TailPlan(self._tail, int(len(sides_np)), decode_rows)
+        composed = ComposedOpView.pipelined(sides_np, idxs_np, plan,
+                                            ops_l, ops_r)
+        if self._tail.eager_overlap:
+            # Producer/consumer kick-off: every shard's chain decode is
+            # in the pool before merge returns, overlapping the
+            # caller's serialization (and the chain transfer itself on
+            # a real device link).
+            plan.prefetch()
         if detailed:
             obs_spans.record("compose_decode", time.perf_counter() - t0,
                              layer="ops")

@@ -13,16 +13,24 @@ These views keep the fetched int32/digest columns as the source of
 truth and materialize on three paths, lazily:
 
 - ``to_json()`` — the notes/op-log payload, synthesized directly from
-  the columns: one bulk hex conversion for the ids, f-string rows with
-  cached JSON escaping. Byte-identical to
-  ``OpLog([...]).to_json()`` over the materialized ops
-  (fuzz-tested in ``tests/test_oplog_view.py``); the JSON shape is the
-  reference parity surface (reference ``semmerge/ops.py:106-121``).
+  the columns. Since the host-tail pipelining round this is SHARDED:
+  the stream splits into row ranges, each range serializes
+  independently (the native C renderer per shard, or the vectorized
+  Python row synthesizer), and the shards byte-join in deterministic
+  shard order — so worker threads can serialize shards concurrently
+  (the C renderer runs GIL-free through ctypes) and the result is
+  byte-identical to the single-pass serialization. Byte parity with
+  ``OpLog([...]).to_json()`` over the materialized ops is fuzz-tested
+  in ``tests/test_oplog_view.py``; the JSON shape is the reference
+  parity surface (reference ``semmerge/ops.py:106-121``).
 - ``view[i]`` — one op, built on demand and cached: the conflict
   constructors and spot inspections touch a handful of ops, not 90k.
-- ``iter(view)`` — bulk materialization with the per-kind tight loops
-  (same cost as the old eager path), for consumers that genuinely need
-  every op as an object (the applier's handler dispatch, parity tests).
+- ``iter(view)`` — bulk materialization via the C op factory
+  (``native/opfactory.c``), which since v2 borrows every field string
+  from per-snapshot FIELD LISTS (one Python list per node column,
+  cached by the engine) instead of UTF-8-decoding them out of a byte
+  blob per op — materializing a 46k-op stream allocates ~46k id +
+  summary strings instead of ~230k field strings.
 
 The DivergentRename cursor walk gets a columnar twin here too: the
 reference's head-vs-head walk (reference ``semmerge/compose.py:51-112``)
@@ -39,6 +47,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core.encode import shard_ranges
 from ..core.ops import Op, Target, dumps_canonical
 
 #: Device diff kinds (ops/diff.py) — re-declared to avoid a JAX import
@@ -108,6 +117,16 @@ def _node_table(nodes) -> Tuple[bytes, np.ndarray]:
     return blob, offs
 
 
+def _node_fields(nodes) -> Tuple[list, list, list, list]:
+    """Per-node field COLUMNS as four Python string lists (symbolId,
+    addressId, name, file) — the C op factory borrows every field
+    string from these instead of decoding bytes per op, and the
+    vectorized Python serializer gathers from them by slot index
+    (list indexing, no per-row attribute access)."""
+    return ([nd.symbolId for nd in nodes], [nd.addressId for nd in nodes],
+            [nd.name for nd in nodes], [nd.file for nd in nodes])
+
+
 def _get_table(ref, nodes) -> Tuple[bytes, np.ndarray]:
     """Node table via the engine's per-snapshot cache when a stable
     identity exists (``ref = (cache, key)``), else built fresh."""
@@ -122,9 +141,63 @@ def _get_table(ref, nodes) -> Tuple[bytes, np.ndarray]:
     tbl = _node_table(nodes)
     if cache is not None and key is not None:
         cache[key] = (tbl[0], tbl[1], len(nodes))
-        while len(cache) > 8:
+        while len(cache) > 16:
             cache.popitem(last=False)
     return tbl
+
+
+def _get_fields(ref, nodes) -> Tuple[list, list, list, list]:
+    """Field columns via the same per-snapshot cache as
+    :func:`_get_table` (entries keyed ``("fields", key)`` so tables and
+    field lists coexist in one OrderedDict); built fresh when no stable
+    identity exists."""
+    cache = key = None
+    if ref is not None:
+        cache, raw_key = ref
+        if raw_key is not None:
+            key = ("fields", raw_key)
+            hit = cache.get(key)
+            if hit is not None and hit[1] == len(nodes):
+                cache.move_to_end(key)
+                return hit[0]
+    fields = _node_fields(nodes)
+    if cache is not None and key is not None:
+        cache[key] = (fields, len(nodes))
+        while len(cache) > 16:
+            cache.popitem(last=False)
+    return fields
+
+
+#: Row templates for the vectorized Python serializer, one per kind.
+#: ``%s`` slots receive already-escaped string BODIES (ids are hex and
+#: never need escaping); the provenance literal is spliced in by
+#: :func:`_kind_templates` with its ``%`` doubled.
+_TMPL_RENAME = (
+    '{"id":"%s","schemaVersion":1,"type":"renameSymbol","target":'
+    '{"symbolId":"%s","addressId":"%s"},"params":{"oldName":"%s",'
+    '"newName":"%s","file":"%s"},"guards":{"exists":true,'
+    '"addressMatch":"%s"},"effects":{"summary":"rename %s→%s"},'
+    '"provenance":')
+_TMPL_MOVE = (
+    '{"id":"%s","schemaVersion":1,"type":"moveDecl","target":'
+    '{"symbolId":"%s","addressId":"%s"},"params":{"oldAddress":"%s",'
+    '"newAddress":"%s","oldFile":"%s","newFile":"%s"},"guards":'
+    '{"exists":true,"addressMatch":"%s"},"effects":{"summary":'
+    '"move %s→%s"},"provenance":')
+_TMPL_ADD = (
+    '{"id":"%s","schemaVersion":1,"type":"addDecl","target":'
+    '{"symbolId":"%s","addressId":"%s"},"params":{"file":"%s"},'
+    '"guards":{},"effects":{"summary":"add decl"},"provenance":')
+_TMPL_DELETE = (
+    '{"id":"%s","schemaVersion":1,"type":"deleteDecl","target":'
+    '{"symbolId":"%s","addressId":"%s"},"params":{"file":"%s"},'
+    '"guards":{},"effects":{"summary":"delete decl"},"provenance":')
+
+
+def _kind_templates(prov_json: str) -> Tuple[str, str, str, str]:
+    suffix = prov_json.replace("%", "%%") + "}"
+    return (_TMPL_RENAME + suffix, _TMPL_MOVE + suffix,
+            _TMPL_ADD + suffix, _TMPL_DELETE + suffix)
 
 
 class OpStreamView(Sequence):
@@ -132,17 +205,20 @@ class OpStreamView(Sequence):
 
     Rows are ``(kind, a_slot, b_slot, digest_words)`` where the slots
     index the scanned decl node lists. Construction does no per-row
-    work at all."""
+    work at all. ``pipeline`` (optional) is the engine's host-tail
+    worker pool (:class:`semantic_merge_tpu.ops.fused.TailPipeline`);
+    when set, bulk serialization shards across it."""
 
     __slots__ = ("kind", "a_slot", "b_slot", "words",
                  "base_nodes", "side_nodes", "prov",
-                 "base_tbl_ref", "side_tbl_ref",
+                 "base_tbl_ref", "side_tbl_ref", "pipeline",
                  "_ids", "_ops", "_all_done")
 
     def __init__(self, kind: np.ndarray, a_slot: np.ndarray,
                  b_slot: np.ndarray, words: np.ndarray,
                  base_nodes, side_nodes, prov: Dict,
-                 base_tbl_ref=None, side_tbl_ref=None) -> None:
+                 base_tbl_ref=None, side_tbl_ref=None,
+                 pipeline=None) -> None:
         self.kind = kind
         self.a_slot = a_slot
         self.b_slot = b_slot
@@ -151,9 +227,11 @@ class OpStreamView(Sequence):
         self.side_nodes = side_nodes
         self.prov = prov
         # Optional (cache, identity) pairs for the native serializer's
-        # node tables — the fused engine shares them across merges.
+        # node tables / field lists — the fused engine shares them
+        # across merges.
         self.base_tbl_ref = base_tbl_ref
         self.side_tbl_ref = side_tbl_ref
+        self.pipeline = pipeline
         self._ids: Optional[List[str]] = None
         self._ops: Optional[List[Optional[Op]]] = None
         self._all_done = False
@@ -212,16 +290,18 @@ class OpStreamView(Sequence):
         return op
 
     def _c_stream_args(self):
-        """(columns..., tables...) tuple prefix shared by the C factory
-        entry points. Empty streams are the CALLER'S guard (len > 0
-        checks) — this always returns the tuple."""
-        base_tbl = _get_table(self.base_tbl_ref, self.base_nodes)
-        side_tbl = _get_table(self.side_tbl_ref, self.side_nodes)
+        """(columns..., field lists...) tuple prefix shared by the C
+        factory entry points: 4 contiguous int32 arrays + the 8 cached
+        per-node field lists (base then side). Empty streams are the
+        CALLER'S guard (len > 0 checks) — this always returns the
+        tuple."""
+        bf = _get_fields(self.base_tbl_ref, self.base_nodes)
+        sf = _get_fields(self.side_tbl_ref, self.side_nodes)
         return (np.ascontiguousarray(self.kind, np.int32),
                 np.ascontiguousarray(self.a_slot, np.int32),
                 np.ascontiguousarray(self.b_slot, np.int32),
                 np.ascontiguousarray(self.words, np.int32),
-                base_tbl[0], base_tbl[1], side_tbl[0], side_tbl[1])
+                *bf, *sf)
 
     def materialize(self) -> List[Op]:
         """Every op as an object — via the C factory
@@ -308,42 +388,106 @@ class OpStreamView(Sequence):
 
         Prefers the native C renderer (``smn_oplog_json``): node string
         tables + int32 columns in, JSON bytes out (~20× the Python
-        row loop); falls back to the Python serializer when the native
-        library is unavailable."""
+        row loop); falls back to the vectorized Python serializer when
+        the native library is unavailable."""
         return self.to_json_bytes().decode("utf-8")
 
     def to_json_bytes(self) -> bytes:
         """UTF-8 bytes of :meth:`to_json` — the native path hands the C
         buffer through without the 20 MB-scale decode/encode round trip
-        (the notes writer consumes bytes directly)."""
-        if len(self) > 0:
-            raw = self._to_json_native_bytes()
-            if raw is not None:
-                return raw
+        (the notes writer consumes bytes directly).
+
+        With a :attr:`pipeline` attached and enough rows, the stream
+        serializes in row-range SHARDS submitted to the worker pool
+        (the native renderer releases the GIL through ctypes, so shards
+        genuinely overlap on multi-core hosts) and the shard bodies
+        byte-join in deterministic shard order — output identical to
+        the single-pass serialization for every worker count."""
+        n = len(self)
+        if n == 0:
+            return b"[]"
+        pipe = self.pipeline
+        # Sharded serialization only buys time when shards can actually
+        # run concurrently (multi-worker AND multi-core — the pipeline's
+        # eager_overlap condition); otherwise the per-shard call
+        # overhead is pure cost and the single native pass wins.
+        if pipe is not None and pipe.eager_overlap and n > pipe.shard_rows:
+            parts = self._shard_json_bodies(pipe)
+            if parts is not None:
+                return b"[" + b",".join(parts) + b"]"
+        raw = self._to_json_native_bytes()
+        if raw is not None:
+            return raw
         return self._to_json_py().encode("utf-8")
 
-    def _native_args(self):
+    def _shard_json_bodies(self, pipe) -> Optional[List[bytes]]:
+        """Serialize in shards over the pipeline pool; returns the
+        bracket-stripped shard bodies in shard order, or ``None`` when
+        the native renderer is unavailable (caller falls back to one
+        Python pass — the vectorized serializer already batches
+        internally, so sharding it buys nothing without the GIL-free
+        native path)."""
+        from ..frontend.native import available
+        if not available():
+            return None
+        # Prebuild shared state in THIS thread: the table/field caches
+        # and the id list are plain dict/list mutations, not safe to
+        # race from pool workers.
+        self._native_args_prefix()
+        ranges = shard_ranges(len(self), pipe.shard_rows)
+        futs = [pipe.submit(self._native_shard_body, lo, hi)
+                for lo, hi in ranges]
+        parts = [f.result() for f in futs]
+        if any(p is None for p in parts):
+            return None
+        return parts  # type: ignore[return-value]
+
+    def _native_args_prefix(self):
         base_tbl = _get_table(self.base_tbl_ref, self.base_nodes)
         side_tbl = _get_table(self.side_tbl_ref, self.side_nodes)
-        return (len(self),
-                np.ascontiguousarray(self.kind, np.int32),
+        return (np.ascontiguousarray(self.kind, np.int32),
                 np.ascontiguousarray(self.a_slot, np.int32),
                 np.ascontiguousarray(self.b_slot, np.int32),
                 np.ascontiguousarray(self.words, np.int32),
-                base_tbl[0], base_tbl[1], side_tbl[0], side_tbl[1],
+                base_tbl[0], base_tbl[1], side_tbl[0], side_tbl[1])
+
+    def _native_shard_body(self, lo: int, hi: int) -> Optional[bytes]:
+        """One shard's rows as a bracket-stripped JSON body (the native
+        renderer emits ``[rows]``; shard bodies re-join with commas)."""
+        from ..frontend.native import try_oplog_json_bytes
+        kind, a_slot, b_slot, words, bb, bo, sb, so = \
+            self._native_args_prefix()
+        raw = try_oplog_json_bytes(
+            hi - lo, kind[lo:hi], a_slot[lo:hi], b_slot[lo:hi],
+            words[lo:hi], bb, bo, sb, so, dumps_canonical(self.prov))
+        if raw is None:
+            return None
+        return raw[1:-1]
+
+    def _native_args(self):
+        return (len(self), *self._native_args_prefix(),
                 dumps_canonical(self.prov))
 
     def _to_json_native_bytes(self) -> Optional[bytes]:
         from ..frontend.native import try_oplog_json_bytes
         return try_oplog_json_bytes(*self._native_args())
 
-    def _to_json_py(self) -> str:
+    def _json_rows(self, lo: int, hi: int) -> List[str]:
+        """Rows ``lo:hi`` as JSON object strings — the vectorized
+        Python serializer. All column prep is batched: numpy row
+        selection per kind, field gathers from the cached per-node
+        string LISTS (no attribute access), escape-once-per-unique
+        string via a shared body cache, and one C-level ``%`` format
+        per row; rows land in stream order via object-array scatter."""
         ids = self.ids()
-        n = len(self)
-        rows: List[Optional[str]] = [None] * n
-        prov = dumps_canonical(self.prov)
-        base_nodes, side_nodes = self.base_nodes, self.side_nodes
-        kinds = self.kind
+        kinds = self.kind[lo:hi]
+        n = hi - lo
+        rows = np.empty(n, dtype=object)
+        bsym, baddr, bname, bfile = _get_fields(self.base_tbl_ref,
+                                                self.base_nodes)
+        ssym, saddr, sname, sfile = _get_fields(self.side_tbl_ref,
+                                                self.side_nodes)
+        tmpl = _kind_templates(dumps_canonical(self.prov))
         # Escaped-body cache: every string is escape-checked at most
         # once per call (files repeat per decl, addressIds per row) and
         # summaries concatenate cached bodies — zero regex on the
@@ -358,78 +502,64 @@ class OpStreamView(Sequence):
             return r
 
         for k in (KIND_RENAME, KIND_MOVE, KIND_ADD, KIND_DELETE):
-            idxs = np.nonzero(kinds == k)[0]
-            if not len(idxs):
+            where = np.nonzero(kinds == k)[0]
+            if not len(where):
                 continue
-            ai = self.a_slot[idxs].tolist()
-            bi = self.b_slot[idxs].tolist()
-            where = idxs.tolist()
+            ai = self.a_slot[lo:hi][where].tolist()
+            bi = self.b_slot[lo:hi][where].tolist()
+            widx = where.tolist()
+            rid = [ids[lo + i] for i in widx]
             if k == KIND_RENAME:
-                for i, x, y in zip(where, ai, bi):
-                    a, b = base_nodes[x], side_nodes[y]
-                    ea = body(a.addressId)
-                    an, bn = body(a.name), body(b.name)
-                    rows[i] = (
-                        f'{{"id":"{ids[i]}","schemaVersion":1,'
-                        f'"type":"renameSymbol","target":{{"symbolId":'
-                        f'"{body(a.symbolId)}","addressId":"{ea}"}},"params":'
-                        f'{{"oldName":"{an}","newName":"{bn}",'
-                        f'"file":"{body(b.file)}"}},"guards":{{"exists":true,'
-                        f'"addressMatch":"{ea}"}},"effects":{{"summary":'
-                        f'"rename {an}→{bn}"}},'
-                        f'"provenance":{prov}}}')
+                sym = [body(bsym[x]) for x in ai]
+                ea = [body(baddr[x]) for x in ai]
+                an = [body(bname[x]) for x in ai]
+                bn = [body(sname[y]) for y in bi]
+                fl = [body(sfile[y]) for y in bi]
+                rows[where] = list(map(tmpl[0].__mod__, zip(
+                    rid, sym, ea, an, bn, fl, ea, an, bn)))
             elif k == KIND_MOVE:
-                for i, x, y in zip(where, ai, bi):
-                    a, b = base_nodes[x], side_nodes[y]
-                    ea = body(a.addressId)
-                    eb = body(b.addressId)
-                    rows[i] = (
-                        f'{{"id":"{ids[i]}","schemaVersion":1,'
-                        f'"type":"moveDecl","target":{{"symbolId":'
-                        f'"{body(a.symbolId)}","addressId":"{ea}"}},"params":'
-                        f'{{"oldAddress":"{ea}","newAddress":"{eb}","oldFile":'
-                        f'"{body(a.file)}","newFile":"{body(b.file)}"}},'
-                        f'"guards":{{"exists":true,"addressMatch":"{ea}"}},'
-                        f'"effects":{{"summary":"move {ea}→{eb}"}},'
-                        f'"provenance":{prov}}}')
+                sym = [body(bsym[x]) for x in ai]
+                ea = [body(baddr[x]) for x in ai]
+                eb = [body(saddr[y]) for y in bi]
+                af = [body(bfile[x]) for x in ai]
+                bf = [body(sfile[y]) for y in bi]
+                rows[where] = list(map(tmpl[1].__mod__, zip(
+                    rid, sym, ea, ea, eb, af, bf, ea, ea, eb)))
             elif k == KIND_ADD:
-                for i, y in zip(where, bi):
-                    b = side_nodes[y]
-                    rows[i] = (
-                        f'{{"id":"{ids[i]}","schemaVersion":1,'
-                        f'"type":"addDecl","target":{{"symbolId":'
-                        f'"{body(b.symbolId)}","addressId":"{body(b.addressId)}"}},'
-                        f'"params":{{"file":"{body(b.file)}"}},"guards":{{}},'
-                        f'"effects":{{"summary":"add decl"}},'
-                        f'"provenance":{prov}}}')
+                sym = [body(ssym[y]) for y in bi]
+                eb = [body(saddr[y]) for y in bi]
+                fl = [body(sfile[y]) for y in bi]
+                rows[where] = list(map(tmpl[2].__mod__, zip(
+                    rid, sym, eb, fl)))
             else:
-                for i, x in zip(where, ai):
-                    a = base_nodes[x]
-                    rows[i] = (
-                        f'{{"id":"{ids[i]}","schemaVersion":1,'
-                        f'"type":"deleteDecl","target":{{"symbolId":'
-                        f'"{body(a.symbolId)}","addressId":"{body(a.addressId)}"}},'
-                        f'"params":{{"file":"{body(a.file)}"}},"guards":{{}},'
-                        f'"effects":{{"summary":"delete decl"}},'
-                        f'"provenance":{prov}}}')
-        return "[" + ",".join(rows) + "]"  # type: ignore[arg-type]
+                sym = [body(bsym[x]) for x in ai]
+                ea = [body(baddr[x]) for x in ai]
+                fl = [body(bfile[x]) for x in ai]
+                rows[where] = list(map(tmpl[3].__mod__, zip(
+                    rid, sym, ea, fl)))
+        return rows.tolist()
+
+    def _to_json_py(self) -> str:
+        return "[" + ",".join(self._json_rows(0, len(self))) + "]"
 
 
 class ComposedOpView(Sequence):
     """The composed stream as references into the two side views plus
     per-row chain overrides — a lazy ``Sequence[Op]``.
 
-    ``sides``/``idxs`` index raw (unsorted) stream positions;
-    ``addr_s``/``file_s``/``name_s`` carry the decoded chain-override
-    strings (``None`` = no override), exactly the arguments the eager
-    path fed :func:`_materialize_decoded`."""
+    ``sides``/``idxs`` index raw (unsorted) stream positions (plain
+    lists or int32 numpy arrays); ``addr_s``/``file_s``/``name_s``
+    carry the decoded chain-override strings (``None`` = no override),
+    exactly the arguments the eager path fed
+    :func:`_materialize_decoded`."""
 
     __slots__ = ("sides", "idxs", "addr_s", "file_s", "name_s",
-                 "left", "right", "_all", "_chains_thunk")
+                 "left", "right", "_all", "_chains_thunk", "_plan")
 
-    def __init__(self, sides: List[int], idxs: List[int],
-                 addr_s: List[Optional[str]], file_s: List[Optional[str]],
-                 name_s: List[Optional[str]],
+    def __init__(self, sides, idxs,
+                 addr_s: Optional[List[Optional[str]]],
+                 file_s: Optional[List[Optional[str]]],
+                 name_s: Optional[List[Optional[str]]],
                  left: OpStreamView, right: OpStreamView) -> None:
         self.sides = sides
         self.idxs = idxs
@@ -440,9 +570,10 @@ class ComposedOpView(Sequence):
         self.right = right
         self._all: Optional[List[Op]] = None
         self._chains_thunk = None
+        self._plan = None
 
     @classmethod
-    def deferred(cls, sides: List[int], idxs: List[int], chains_thunk,
+    def deferred(cls, sides, idxs, chains_thunk,
                  left: OpStreamView, right: OpStreamView
                  ) -> "ComposedOpView":
         """A view whose chain-override columns are produced by
@@ -455,8 +586,26 @@ class ComposedOpView(Sequence):
         view._chains_thunk = chains_thunk
         return view
 
+    @classmethod
+    def pipelined(cls, sides, idxs, plan,
+                  left: OpStreamView, right: OpStreamView
+                  ) -> "ComposedOpView":
+        """A view whose chain decode AND op materialization run as
+        row-range shards over the host-tail worker pool (``plan`` is a
+        :class:`semantic_merge_tpu.ops.fused.TailPlan`). Shard results
+        concatenate in deterministic shard order, so the materialized
+        sequence is identical to the serial path for every worker
+        count."""
+        view = cls(sides, idxs, None, None, None, left, right)
+        view._plan = plan
+        return view
+
     def _force_chains(self) -> None:
-        if self.addr_s is None:
+        if self.addr_s is not None:
+            return
+        if self._plan is not None:
+            self.addr_s, self.file_s, self.name_s = self._plan.decode_all()
+        else:
             self.addr_s, self.file_s, self.name_s = self._chains_thunk()
             self._chains_thunk = None
 
@@ -475,37 +624,78 @@ class ComposedOpView(Sequence):
             return self._all[i]
         self._force_chains()
         src = self.left if self.sides[i] == 0 else self.right
-        return _materialize_decoded(src[self.idxs[i]], self.addr_s[i],
+        return _materialize_decoded(src[int(self.idxs[i])], self.addr_s[i],
                                     self.file_s[i], self.name_s[i])
 
+    def _shard_ops(self, lo: int, hi: int,
+                   overrides: Tuple[list, list, list]) -> List[Op]:
+        """Materialize composed rows ``lo:hi`` (one pipeline shard).
+        ``overrides`` are the shard's decoded chain columns (local
+        indexing: row ``lo + j`` uses ``overrides[*][j]``)."""
+        addr_s, file_s, name_s = overrides
+        sides = np.ascontiguousarray(np.asarray(self.sides[lo:hi]), np.int32)
+        idxs = np.ascontiguousarray(np.asarray(self.idxs[lo:hi]), np.int32)
+        if hi > lo:
+            from ..frontend.native import load_opfactory
+            fac = load_opfactory()
+            if fac is not None:
+                return fac.composed_ops(
+                    *self.left._c_stream_args(),
+                    *self.right._c_stream_args(),
+                    sides, idxs, addr_s, file_s, name_s,
+                    self.left.prov, self.right.prov, Op, Target)
+        left_ops = self.left
+        right_ops = self.right
+        return [
+            _materialize_decoded(
+                (left_ops if side == 0 else right_ops)[int(i)], na, nf, nn)
+            for side, i, na, nf, nn in zip(sides.tolist(), idxs.tolist(),
+                                           addr_s, file_s, name_s)]
+
     def materialize(self) -> List[Op]:
-        if self._all is None:
-            self._force_chains()
-            if len(self) > 0:
-                from ..frontend.native import load_opfactory
-                fac = load_opfactory()
-                if fac is not None:
-                    # One C pass builds every final composed op straight
-                    # from the two streams' columns + per-row overrides;
-                    # the intermediate stream objects never materialize.
-                    # (Ops are value-identical to the Python path but
-                    # always fresh — no sharing with the stream views.)
-                    self._all = fac.composed_ops(
-                        *self.left._c_stream_args(),
-                        *self.right._c_stream_args(),
-                        np.asarray(self.sides, np.int32),
-                        np.asarray(self.idxs, np.int32),
-                        self.addr_s, self.file_s, self.name_s,
-                        self.left.prov, self.right.prov, Op, Target)
-                    return self._all
-            ops_l = self.left.materialize()
-            ops_r = self.right.materialize()
-            self._all = [
-                _materialize_decoded(
-                    (ops_l if side == 0 else ops_r)[i], na, nf, nn)
-                for side, i, na, nf, nn in zip(self.sides, self.idxs,
-                                               self.addr_s, self.file_s,
-                                               self.name_s)]
+        if self._all is not None:
+            return self._all
+        plan = self._plan
+        if plan is not None:
+            # Shard fan-out over the host-tail pool: each shard decodes
+            # its chain-override rows and builds its ops; results
+            # concatenate in shard order (deterministic merge). With
+            # one worker this degrades to the serial loop over the
+            # same shard boundaries — byte/value-identical output.
+            futs = [plan.submit_materialize(
+                        lo, hi, lambda l, h, ov: self._shard_ops(l, h, ov))
+                    for lo, hi in plan.ranges]
+            out: List[Op] = []
+            for f in futs:
+                out.extend(f.result())
+            self._all = out
+            return out
+        self._force_chains()
+        if len(self) > 0:
+            from ..frontend.native import load_opfactory
+            fac = load_opfactory()
+            if fac is not None:
+                # One C pass builds every final composed op straight
+                # from the two streams' columns + per-row overrides;
+                # the intermediate stream objects never materialize.
+                # (Ops are value-identical to the Python path but
+                # always fresh — no sharing with the stream views.)
+                self._all = fac.composed_ops(
+                    *self.left._c_stream_args(),
+                    *self.right._c_stream_args(),
+                    np.ascontiguousarray(np.asarray(self.sides), np.int32),
+                    np.ascontiguousarray(np.asarray(self.idxs), np.int32),
+                    self.addr_s, self.file_s, self.name_s,
+                    self.left.prov, self.right.prov, Op, Target)
+                return self._all
+        ops_l = self.left.materialize()
+        ops_r = self.right.materialize()
+        self._all = [
+            _materialize_decoded(
+                (ops_l if side == 0 else ops_r)[int(i)], na, nf, nn)
+            for side, i, na, nf, nn in zip(self.sides, self.idxs,
+                                           self.addr_s, self.file_s,
+                                           self.name_s)]
         return self._all
 
     def __iter__(self):
@@ -598,3 +788,35 @@ def cursor_walk_conflicts_columnar(
         else:
             ib += 1
     return pairs, dropped_a, dropped_b
+
+
+def cursor_walk_conflicts_renames_only(
+        ren_pos_a: np.ndarray, sym_a: np.ndarray, name_a: np.ndarray,
+        ren_pos_b: np.ndarray, sym_b: np.ndarray, name_b: np.ndarray,
+        prec_rename: int = 11
+        ) -> Tuple[List[Tuple[int, int]], set, set]:
+    """The cursor walk restricted to each stream's RENAME substream.
+
+    For canonically-sorted streams over the fused path's op vocabulary
+    (move=10 < rename=11 < add=30 < delete=31, one shared timestamp)
+    the full walk can only emit conflicts at rename-vs-rename head
+    pairs, and its bisect bulk-advances never let a non-rename reorder
+    which rename pairs meet — so walking the two rename substreams
+    yields exactly the full walk's pairs at a cost proportional to the
+    RENAME count, not the op count (the rung-5 workload walks ~5k rows
+    instead of ~47k). Equivalence is property-tested against the full
+    walk in ``tests/test_oplog_view.py``.
+
+    ``ren_pos_*`` are the rename rows' positions in the sorted streams;
+    returned pairs/drop sets are mapped back to full-stream positions.
+    """
+    k_a, k_b = len(ren_pos_a), len(ren_pos_b)
+    sub_pairs, sub_da, sub_db = cursor_walk_conflicts_columnar(
+        [prec_rename] * k_a, [True] * k_a,
+        sym_a.tolist(), name_a.tolist(),
+        [prec_rename] * k_b, [True] * k_b,
+        sym_b.tolist(), name_b.tolist())
+    pairs = [(int(ren_pos_a[x]), int(ren_pos_b[y])) for x, y in sub_pairs]
+    da = {int(ren_pos_a[x]) for x in sub_da}
+    db = {int(ren_pos_b[y]) for y in sub_db}
+    return pairs, da, db

@@ -57,6 +57,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.conflict import Conflict
 from ..core.encode import NULL_ID, PAD_ID, DeclTensor, shard_bucket
 from ..core.ops import Op
+from ..utils.jaxenv import shard_map_compat
 from .compose import (_conflict_cursor_walk, _merge_and_scan, _pad_op_tensor,
                       _rename_candidate_query, _rename_candidate_tables,
                       _rename_pairs, _seg_combine, _sort_stream,
@@ -290,7 +291,7 @@ def _sharded_diff_slots(b_sym, b_addr, b_name, s_sym, s_addr, s_name,
 @lru_cache(maxsize=None)
 def _sharded_diff_fn(mesh: Mesh, nb: int, ns: int, k: int):
     spec = P(AXIS)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         partial(_sharded_diff_core, nb=nb, ns=ns, k=k),
         mesh=mesh, in_specs=(spec,) * 8, out_specs=P(),
         check_vma=False))
@@ -317,7 +318,7 @@ def _sharded_diff_pair_fn(mesh: Mesh, nb: int, nl: int, nr: int, k: int):
 
         return jnp.stack([pad(out_l), pad(out_r)])
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         pair, mesh=mesh, in_specs=(spec,) * 12, out_specs=P(),
         check_vma=False))
 
@@ -445,7 +446,7 @@ def _sharded_compose_fn(mesh: Mesh, na: int, nb: int, k: int):
                  ("prec", "ts_rank", "id_rank", "is_rename", "is_move", "sym",
                   "new_name", "chain_name", "new_addr", "chain_file",
                   "op_index")}
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         partial(_sharded_compose_core, na=na, nb=nb, k=k),
         mesh=mesh, in_specs=(col_specs, col_specs, P(), P()),
         out_specs=P(), check_vma=False))

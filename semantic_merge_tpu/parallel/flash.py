@@ -31,6 +31,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+#: jax-version compat: the TPU compiler-params dataclass is
+#: ``CompilerParams`` on newer jax, ``TPUCompilerParams`` before.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30
 
 # Lane width of the VPU; scratch row-stat tiles replicate across it.
@@ -136,7 +141,7 @@ def flash_chunk_attention(q, k, v, kmask, *, block_q: int = 128,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt, maskp)

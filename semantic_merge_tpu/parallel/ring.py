@@ -110,7 +110,8 @@ def ring_attention(q, k, v, kmask, mesh: Mesh, *, axis_name: str = "sp",
     # shows up in the profiler timeline, not here.
     with obs_spans.span("ring_attention", layer="parallel", axis=axis_name,
                         seq=int(q.shape[1]), pallas=str(pallas)):
-        return jax.shard_map(
+        from ..utils.jaxenv import shard_map_compat
+        return shard_map_compat(
             partial(_ring_attention_local, axis_name=axis_name, pallas=pallas),
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),

@@ -67,7 +67,8 @@ def ulysses_attention(q, k, v, kmask, mesh: Mesh, *, axis_name: str = "sp"):
     :func:`semantic_merge_tpu.parallel.ring.ring_attention`."""
     qkv_spec = P("dp", axis_name, "tp", None)
     mask_spec = P("dp", axis_name)
-    return jax.shard_map(
+    from ..utils.jaxenv import shard_map_compat
+    return shard_map_compat(
         partial(_ulysses_local, axis_name=axis_name),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),

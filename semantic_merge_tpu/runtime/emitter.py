@@ -24,6 +24,23 @@ DEFAULT_FORMATTER = ("npx", "prettier", "--write")
 #: path argument (e.g. Next.js route files like ``pages/[id].ts``).
 _GLOB_CHARS = re.compile(r"[*?\[\]{}()!]")
 
+#: Suffixes prettier can parse out of the box (its built-in language
+#: set) — the touched-scope filter: a text-merged ``notes.txt`` or a
+#: binary must never reach prettier as an explicit path argument.
+PRETTIER_EXTENSIONS = frozenset((
+    ".js", ".jsx", ".mjs", ".cjs", ".ts", ".tsx", ".mts", ".cts",
+    ".json", ".json5", ".jsonc", ".css", ".scss", ".less", ".html",
+    ".htm", ".vue", ".md", ".markdown", ".mdx", ".yaml", ".yml",
+    ".graphql", ".gql", ".handlebars", ".hbs"))
+
+
+def _escape_glob(path: str) -> str:
+    """Backslash-escape fast-glob metacharacters so an explicit path
+    argument (``pages/[id].ts``, ``app/(marketing)/page.tsx``) reaches
+    prettier as a literal file, not a pattern. fast-glob honors
+    ``\\``-escaping on every platform prettier runs it."""
+    return _GLOB_CHARS.sub(lambda m: "\\" + m.group(0), path)
+
 
 def emit_files(tree_path: pathlib.Path,
                formatter_cmd: Sequence[str] | None = None,
@@ -33,21 +50,18 @@ def emit_files(tree_path: pathlib.Path,
     reference's behavior); a list formats only those files —
     touched-scope mode (``[engine] formatter_scope = "touched"``), which
     leaves every unvisited file byte-identical. An empty list skips the
-    formatter entirely. A touched path containing glob metacharacters
-    would be misread as a pattern by prettier, so such merges fall back
-    to whole-tree formatting rather than silently skipping the file."""
+    formatter entirely. Touched paths containing glob metacharacters
+    are backslash-escaped (fast-glob's literal-path escape), so
+    Next.js-style routes format in place instead of degrading the whole
+    merge to tree-wide formatting."""
     from ..obs import spans as obs_spans
     tree_path = pathlib.Path(tree_path)
     base_cmd = list(formatter_cmd) if formatter_cmd else list(DEFAULT_FORMATTER)
-    if paths is not None and any(_GLOB_CHARS.search(p) for p in paths):
-        logger.debug("touched path contains glob metacharacters; "
-                     "formatting the whole tree")
-        paths = None
     if paths is not None:
         existing = sorted(p for p in paths if (tree_path / p).is_file())
         if not existing:
             return
-        cmd = base_cmd + existing
+        cmd = base_cmd + [_escape_glob(p) for p in existing]
         scope = len(existing)
     else:
         cmd = base_cmd + ["."]

@@ -85,6 +85,25 @@ def force_cpu(n_devices: int | None = None) -> None:
             _xb._backend_factories.pop(_name, None)
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions.
+
+    The public ``jax.shard_map`` (with its ``check_vma`` kwarg) only
+    exists on newer jax; earlier releases ship the same transform as
+    ``jax.experimental.shard_map.shard_map`` with the kwarg named
+    ``check_rep``. Every shard_map call site in this package goes
+    through here so one jax upgrade/downgrade cannot strand the mesh
+    kernels (this image's jax 0.4.37 has only the experimental form)."""
+    import jax
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm_exp
+    return sm_exp(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+
+
 def accelerator_available(timeout: float = 120.0, retries: int = 1) -> str | None:
     """Probe whether a real accelerator backend initialises, without
     risking this process.

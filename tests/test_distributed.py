@@ -82,8 +82,9 @@ def test_hybrid_mesh_executes_collectives():
     def body(x):
         return jax.lax.psum(x, "dp")
 
-    out = jax.shard_map(body, mesh=mesh.mesh,
-                        in_specs=P("dp", "sp"), out_specs=P(None, "sp"))(x)
+    from semantic_merge_tpu.utils.jaxenv import shard_map_compat
+    out = shard_map_compat(body, mesh=mesh.mesh,
+                           in_specs=P("dp", "sp"), out_specs=P(None, "sp"))(x)
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(x).reshape(4, 2, 2).sum(axis=0))
 

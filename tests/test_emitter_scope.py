@@ -55,17 +55,21 @@ def test_emit_files_empty_touched_skips_formatter(tmp_path):
     assert not log.exists()
 
 
-def test_emit_files_glob_metachar_falls_back_to_tree(tmp_path):
+def test_emit_files_glob_metachars_escaped_in_place(tmp_path):
     # prettier reads explicit args as fast-glob patterns: a touched
-    # pages/[id].ts would match pages/i.ts instead of itself. Tree mode
-    # is the safe fallback.
+    # pages/[id].ts would match pages/i.ts instead of itself. The path
+    # is backslash-escaped (fast-glob's literal-path escape), so the
+    # route file formats in place — no whole-tree fallback, untouched
+    # files keep their bytes.
     tree = tmp_path / "tree"
     (tree / "pages").mkdir(parents=True)
     (tree / "pages" / "[id].ts").write_text("x\n")
+    (tree / "pages" / "(group)").mkdir()
+    (tree / "pages" / "(group)" / "p!.tsx").write_text("y\n")
     cmd, log = _recorder_cmd(tmp_path)
-    emit_files(tree, cmd, paths=["pages/[id].ts"])
+    emit_files(tree, cmd, paths=["pages/[id].ts", "pages/(group)/p!.tsx"])
     (args,) = [json.loads(line) for line in log.read_text().splitlines()]
-    assert args == ["."]
+    assert args == [r"pages/\(group\)/p\!.tsx", r"pages/\[id\].ts"]
 
 
 def test_cli_touched_scope_end_to_end(tmp_path, monkeypatch):

@@ -231,3 +231,46 @@ def test_cli_extract_vs_inline_end_to_end(tmp_path, monkeypatch):
     assert rc == 1
     payload = json.loads((tmp_path / ".semmerge-conflicts.json").read_text())
     assert any(c["category"] == "ExtractVsInline" for c in payload)
+
+
+def test_trivial_blocks_are_not_motion_evidence():
+    """A trivial shared block (the bare `return null;` class) must not
+    mint motion markers: content-only blockHash would otherwise join
+    opposite-side trivial "motions" into a false ExtractVsInline abort
+    of a clean merge (ADVICE round 5). The gate is
+    core.difflift._block_significant: ≥2 statements or >15 chars."""
+    base = _snap(
+        big="export function big(s: string): string { return null; }\n",
+        util=("export function util(s: string, n: number): string"
+              " { return null; }\n"))
+    # A "extracts" big's trivial block; B "inlines" util's — both
+    # coincidences, neither a motion.
+    side_a = _snap(
+        big="export function big(s: string): string { return ex(s); }\n",
+        ex=("export function ex(s: string, x: number): string"
+            " { return null; }\n"),
+        util=("export function util(s: string, n: number): string"
+              " { return null; }\n"))
+    side_b = _snap(
+        big="export function big(s: string): string { return null; }\n",
+        util="")
+    bk = get_backend("host")
+    assert not [o for o in bk.diff(BASE_EXTRACT, side_a, **KW)
+                if o.type == "extractMethod"]
+    res = bk.build_and_diff(base, side_a, side_b, **KW)
+    assert not [o for o in res.op_log_left
+                if o.type in ("extractMethod", "inlineMethod")]
+    assert not [o for o in res.op_log_right
+                if o.type in ("extractMethod", "inlineMethod")]
+    kept_a, kept_b, conflicts = detect_conflicts_strict(
+        res.op_log_left, res.op_log_right)
+    assert not [c for c in conflicts if c.category == "ExtractVsInline"]
+
+
+def test_two_trivial_statements_are_motion_evidence():
+    """The statement-count arm of the gate: two short statements pass
+    even when the char arm alone would not."""
+    from semantic_merge_tpu.core.difflift import _block_significant
+    assert not _block_significant("return null;")
+    assert _block_significant("a();b();")       # 2 statements, 8 chars
+    assert _block_significant("return s.trim();")  # 16 chars > 15

@@ -244,3 +244,31 @@ def test_cli_stmt_edit_applies_to_merge(tmp_path, monkeypatch):
     assert rc == 0
     assert "return 42" in (tmp_path / "a.ts").read_text()
     assert "other2" in (tmp_path / "b.ts").read_text()
+
+
+def test_conflict_order_by_first_involved_a_op_position():
+    """The documented output contract: conflicts sort by the first
+    involved A-op's stream position, even though ExtractVsInline is
+    DETECTED first (the motion pass runs before the per-symbol loops).
+    Here the divergent rename involves A's op 0 and the motion pair
+    A's op 1 — the rename must come out first."""
+    ext = _op("extractMethod", "host",
+              {"file": "f.ts", "newName": "helper", "newAddress": "na",
+               "newSymbol": "hsym", "fromFile": "f.ts",
+               "blockHash": "bh"}, "a2")
+    a = [_op("renameSymbol", "s2", {"oldName": "f", "newName": "g",
+                                    "file": "f.ts"}, "a1"),
+         ext]
+    inl = _op("inlineMethod", "hostB",
+              {"file": "g.ts", "methodName": "helper", "oldAddress": "oa",
+               "oldSymbol": "hsym", "blockHash": "bh"}, "b2")
+    b = [inl,
+         _op("renameSymbol", "s2", {"oldName": "f", "newName": "h",
+                                    "file": "f.ts"}, "b1")]
+    _, _, conflicts = detect_conflicts_strict(a, b)
+    assert [c.category for c in conflicts] == ["DivergentRename",
+                                               "ExtractVsInline"]
+    # Swapping A's stream order swaps the output order too.
+    _, _, conflicts = detect_conflicts_strict(list(reversed(a)), b)
+    assert [c.category for c in conflicts] == ["ExtractVsInline",
+                                               "DivergentRename"]

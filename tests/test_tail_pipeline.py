@@ -1,0 +1,165 @@
+"""Host-tail pipeline parity — the BASELINE invariant of the
+pipelined-materialization round.
+
+The fused merge's post-kernel tail (chain decode → op materialization →
+op-log serialization) runs as row-range shards over a worker pool
+(``SEMMERGE_HOST_WORKERS`` / ``[engine] host_workers``), with a
+deterministic shard-order merge of per-shard results. These tests pin
+the contract: the emitted op-log bytes and the materialized composed
+stream are IDENTICAL for every worker count and shard size — including
+the concurrent schedule (eager prefetch + sharded serialization), which
+single-core hosts skip by default and these tests force on.
+"""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from semantic_merge_tpu.backends.base import get_backend, run_merge
+from semantic_merge_tpu.core.encode import shard_ranges
+from semantic_merge_tpu.core.ops import OpLog
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+
+TS = "2026-01-02T03:04:05Z"
+
+
+def snap(files):
+    return Snapshot(files=[{"path": p, "content": c} for p, c in files])
+
+
+def _workload(n_files=40, conflicts=False):
+    """A multi-kind workload big enough to span several tiny shards."""
+    base, left, right = [], [], []
+    for i in range(n_files):
+        path = f"src/m{i:03d}.ts"
+        content = (f"export function fn{i}(x: number): number "
+                   f"{{ return {i}; }}\n")
+        base.append((path, content))
+        if i % 2 == 0:
+            left.append((path, content.replace(f"fn{i}(", f"renamed{i}(")))
+        elif i % 7 == 0:
+            left.append((path, content + f"export function extra{i}"
+                                         f"(s: string): string "
+                                         f"{{ return s; }}\n"))
+        else:
+            left.append((path, content))
+        if conflicts and i % 8 == 0:
+            right.append((path, content.replace(f"fn{i}(", f"other{i}(")))
+        elif i % 2 == 1:
+            right.append((f"lib/m{i:03d}.ts", content))
+        else:
+            right.append((path, content))
+    return snap(base), snap(left), snap(right)
+
+
+def _merge_outputs(monkeypatch, workers: int, shard_rows: int,
+                   base, left, right, force_multicore: bool = True,
+                   seed="s", base_rev="r", timestamp=TS):
+    """One fused merge under the given pipeline geometry; returns the
+    two op-log byte payloads, the composed op dicts, and conflicts."""
+    monkeypatch.setenv("SEMMERGE_HOST_WORKERS", str(workers))
+    monkeypatch.setenv("SEMMERGE_TAIL_SHARD_ROWS", str(shard_rows))
+    if force_multicore:
+        # The concurrent schedule (eager shard prefetch + sharded
+        # serialization) is gated on multi-core hosts; force it so the
+        # parity claim covers the schedule actually used in production.
+        monkeypatch.setattr(os, "cpu_count", lambda: 8)
+    from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+    tpu = TpuTSBackend(mesh=False)
+    res, composed, conflicts = run_merge(tpu, base, left, right, seed=seed,
+                                         base_rev=base_rev,
+                                         timestamp=timestamp)
+    return (OpLog(res.op_log_left).to_json_bytes(),
+            OpLog(res.op_log_right).to_json_bytes(),
+            [o.to_dict() for o in composed],
+            [c.to_dict() for c in conflicts])
+
+
+@pytest.mark.parametrize("conflicts", [False, True],
+                         ids=["clean", "divergent"])
+def test_pipelined_oplog_byte_parity_across_worker_counts(
+        monkeypatch, conflicts):
+    if conflicts:
+        # The bench divergent preset is pinned (test_fused) to surface
+        # DivergentRename at the compose cursors — hand-rolled
+        # interleavings get masked by the reference's cursor-walk quirk.
+        import bench
+        base, left, right = bench.synth_repo(97, 3, divergent=True)
+        kw = dict(seed="bench", base_rev="bench",
+                  timestamp="2026-01-01T00:00:00Z")
+    else:
+        base, left, right = _workload(conflicts=False)
+        kw = {}
+    # Serial reference: one worker, one shard covering the stream, and
+    # no forced multicore — the exact pre-pipeline serial code path.
+    ref = _merge_outputs(monkeypatch, 1, 1 << 20, base, left, right,
+                         force_multicore=False, **kw)
+    if conflicts:
+        assert ref[3], "divergent workload must produce conflicts"
+    for workers in (1, 4):
+        for shard_rows in (7, 64):
+            got = _merge_outputs(monkeypatch, workers, shard_rows,
+                                 base, left, right, **kw)
+            assert got[0] == ref[0], (workers, shard_rows)
+            assert got[1] == ref[1], (workers, shard_rows)
+            assert got[2] == ref[2], (workers, shard_rows)
+            assert got[3] == ref[3], (workers, shard_rows)
+
+
+def test_pipelined_empty_merge(monkeypatch):
+    # Identical snapshots: zero ops, zero shards (shard_ranges(0) is
+    # empty) — the pipeline must produce the empty payloads, not choke.
+    base, _, _ = _workload(8)
+    for workers in (1, 4):
+        left_json, right_json, comp, confs = _merge_outputs(
+            monkeypatch, workers, 4, base, base, base)
+        assert left_json == b"[]" and right_json == b"[]"
+        assert comp == [] and confs == []
+
+
+def test_pipelined_matches_host_oracle(monkeypatch):
+    # The sharded pipeline must stay byte-identical to the HOST
+    # backend's Op-object serialization (the Node-worker parity
+    # surface), not merely self-consistent — conflict drops included.
+    import bench
+    base, left, right = bench.synth_repo(97, 3, divergent=True)
+    got = _merge_outputs(monkeypatch, 4, 37, base, left, right,
+                         seed="bench", base_rev="bench",
+                         timestamp="2026-01-01T00:00:00Z")
+    res_h, comp_h, conf_h = run_merge(get_backend("host"), base, left,
+                                      right, seed="bench",
+                                      base_rev="bench",
+                                      timestamp="2026-01-01T00:00:00Z")
+    assert got[0] == OpLog(res_h.op_log_left).to_json_bytes()
+    assert got[1] == OpLog(res_h.op_log_right).to_json_bytes()
+    assert got[2] == [o.to_dict() for o in comp_h]
+    assert got[3] == [c.to_dict() for c in conf_h]
+
+
+def test_shard_ranges_contract():
+    assert shard_ranges(0, 8) == []
+    assert shard_ranges(1, 8) == [(0, 1)]
+    assert shard_ranges(8, 8) == [(0, 8)]
+    assert shard_ranges(9, 8) == [(0, 8), (8, 9)]
+    assert shard_ranges(20, 7) == [(0, 7), (7, 14), (14, 20)]
+    # Degenerate shard size clamps to 1 row per shard.
+    assert shard_ranges(3, 0) == [(0, 1), (1, 2), (2, 3)]
+    # Ranges tile [0, n) exactly — every consumer sees the same plan.
+    for n, rows in ((1, 1), (13, 4), (100, 8192)):
+        rs = shard_ranges(n, rows)
+        assert rs[0][0] == 0 and rs[-1][1] == n
+        assert all(a[1] == b[0] for a, b in zip(rs, rs[1:]))
+
+
+def test_resolve_host_workers_resolution(monkeypatch):
+    from semantic_merge_tpu.ops.fused import resolve_host_workers
+    monkeypatch.delenv("SEMMERGE_HOST_WORKERS", raising=False)
+    assert resolve_host_workers(3) == 3
+    assert resolve_host_workers() == min(8, os.cpu_count() or 1)
+    monkeypatch.setenv("SEMMERGE_HOST_WORKERS", "5")
+    assert resolve_host_workers(3) == 5  # env beats config
+    monkeypatch.setenv("SEMMERGE_HOST_WORKERS", "not-a-number")
+    assert resolve_host_workers(3) == 3  # invalid env ignored
+    monkeypatch.setenv("SEMMERGE_HOST_WORKERS", "0")
+    assert resolve_host_workers(3) >= 1  # floor at 1

@@ -2,16 +2,18 @@
 """Validate semmerge observability artifacts against the documented
 schema (runbook.md, "Observability").
 
-Checks a ``.semmerge-trace.json`` trace artifact and (optionally) a
-``.semmerge-events.jsonl`` span/event stream. Run standalone::
+Checks a ``.semmerge-trace.json`` trace artifact, (optionally) a
+``.semmerge-events.jsonl`` span/event stream, and (optionally) a BENCH
+JSON record emitted by ``bench.py``. Run standalone::
 
     python scripts/check_trace_schema.py .semmerge-trace.json \
-        [.semmerge-events.jsonl]
+        [.semmerge-events.jsonl] [--bench BENCH_JSON]
 
-Exit 0 when both conform, 1 with one line per violation otherwise. The
-tier-1 suite imports :func:`validate_trace` / :func:`validate_events`
-directly (``tests/test_trace_schema.py``), so trace-format drift fails
-CI before it reaches a consumer.
+Exit 0 when everything conforms, 1 with one line per violation
+otherwise. The tier-1 suite imports :func:`validate_trace` /
+:func:`validate_events` / :func:`validate_bench` directly
+(``tests/test_trace_schema.py``), so trace-format drift fails CI before
+it reaches a consumer.
 
 Dependency-free on purpose: the schema IS this file plus the runbook
 table, not a jsonschema document that could drift separately.
@@ -34,6 +36,24 @@ SPAN_REQUIRED = ("name", "t_start", "seconds", "depth", "span_id",
 #: Required keys of the ``device`` telemetry block.
 DEVICE_REQUIRED = ("jax_imported", "platform", "device_count",
                    "transfer_bytes", "transfer_count")
+
+#: Span names of the apply layer (runtime/applier.py). ``apply_ops``
+#: wraps every apply; ``apply_columnar`` is the columnar dispatch walk;
+#: ``apply_plan`` is the bench's tree-less consumption of the same
+#: columns. A CLI ``--trace`` of a fused merge must contain the first
+#: two — renaming them is schema drift (tests pin this).
+APPLY_PHASE_SPANS = ("apply_ops", "apply_columnar", "apply_plan")
+
+#: Required keys of a BENCH JSON record (the driver contract).
+BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
+
+#: Additive BENCH fields that must be numbers when present (the
+#: host-tail, strict-preset, incremental, and roundtrip extensions).
+BENCH_NUMERIC_OPTIONAL = (
+    "host_tail_ms", "device_roundtrip_ms", "incremental_ms",
+    "full_scan_device_ms", "full_scan_host_ms", "vs_full_scan_device",
+    "strict_ms", "nonstrict_ms", "strict_conflicts", "strict_motion_ops",
+)
 
 
 def _is_num(v: Any) -> bool:
@@ -137,6 +157,66 @@ def validate_trace(data: Any) -> List[str]:
     return errors
 
 
+def validate_phase_coverage(data: Any, required) -> List[str]:
+    """Check a trace artifact's span/phase names include ``required`` —
+    the drift guard for load-bearing phase names (e.g. the apply-layer
+    spans BENCH and the runbook reference by name)."""
+    if not isinstance(data, dict):
+        return ["trace: top level must be a JSON object"]
+    names = {row.get("name") for row in data.get("spans", [])
+             if isinstance(row, dict)}
+    names.update(p.get("name") for p in data.get("phases", [])
+                 if isinstance(p, dict))
+    return [f"trace: expected span/phase {r!r} not present"
+            for r in required if r not in names]
+
+
+def validate_bench(data: Any) -> List[str]:
+    """Validate one BENCH JSON record (``bench.py``'s single output
+    line). Required driver fields plus the additive extensions:
+    ``phases_ms``/``host_phases_ms`` maps of non-negative numbers,
+    boolean ``parity``, the ``overlap`` block, and the numeric
+    host-tail/strict/incremental fields."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["bench: record must be a JSON object"]
+    for key in BENCH_REQUIRED:
+        if key not in data:
+            errors.append(f"bench: missing key {key!r}")
+    for key in ("metric", "unit"):
+        if key in data and not isinstance(data[key], str):
+            errors.append(f"bench: {key} must be a string")
+    for key in ("value", "vs_baseline"):
+        if key in data and not _is_num(data[key]):
+            errors.append(f"bench: {key} must be a number")
+    for key in ("phases_ms", "host_phases_ms"):
+        block = data.get(key)
+        if block is None:
+            continue
+        if not isinstance(block, dict):
+            errors.append(f"bench: {key} must be an object")
+            continue
+        for name, v in block.items():
+            if not _is_num(v) or v < 0:
+                errors.append(f"bench: {key}.{name} must be a number >= 0")
+    if "parity" in data and not isinstance(data["parity"], bool):
+        errors.append("bench: parity must be a boolean")
+    if "error" in data and not isinstance(data["error"], str):
+        errors.append("bench: error must be a string")
+    overlap = data.get("overlap")
+    if overlap is not None:
+        if not isinstance(overlap, dict):
+            errors.append("bench: overlap must be an object")
+        else:
+            for key in ("host_workers", "worker_ms", "hidden_ms"):
+                if not _is_num(overlap.get(key)):
+                    errors.append(f"bench: overlap.{key} must be a number")
+    for key in BENCH_NUMERIC_OPTIONAL:
+        if key in data and not _is_num(data[key]):
+            errors.append(f"bench: {key} must be a number")
+    return errors
+
+
 def validate_events(lines: List[str]) -> List[str]:
     errors: List[str] = []
     for i, line in enumerate(lines):
@@ -168,10 +248,19 @@ def validate_events(lines: List[str]) -> List[str]:
 
 
 def main(argv: List[str]) -> int:
+    bench_path = None
+    if "--bench" in argv:
+        i = argv.index("--bench")
+        try:
+            bench_path = argv[i + 1]
+        except IndexError:
+            print("--bench requires a path", file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
     if not argv or len(argv) > 2:
         print(__doc__.strip().splitlines()[0])
-        print("usage: check_trace_schema.py TRACE_JSON [EVENTS_JSONL]",
-              file=sys.stderr)
+        print("usage: check_trace_schema.py TRACE_JSON [EVENTS_JSONL] "
+              "[--bench BENCH_JSON]", file=sys.stderr)
         return 2
     errors: List[str] = []
     try:
@@ -185,6 +274,12 @@ def main(argv: List[str]) -> int:
                 errors.extend(validate_events(fh.read().splitlines()))
         except OSError as exc:
             errors.append(f"events: unreadable ({exc})")
+    if bench_path is not None:
+        try:
+            with open(bench_path, encoding="utf-8") as fh:
+                errors.extend(validate_bench(json.load(fh)))
+        except (OSError, json.JSONDecodeError) as exc:
+            errors.append(f"bench: unreadable ({exc})")
     for err in errors:
         print(err, file=sys.stderr)
     if not errors:

@@ -299,6 +299,11 @@ class TpuTSBackend:
                     sources=sources) + stmt
 
     def compose(self, delta_a: List[Op], delta_b: List[Op]):
+        """Device-composed stream; since the columnar-applier round the
+        non-empty result is a lazy ``ComposedOpView`` over the sorted
+        object streams (decode hands the view through instead of a
+        materialized list) — consumers that never need full ``Op`` rows
+        skip the override clones."""
         if self._mesh is not None:
             from ..ops.sharded import compose_oplogs_device_sharded
             return compose_oplogs_device_sharded(delta_a, delta_b, self._mesh)
@@ -321,7 +326,14 @@ class TpuTSBackend:
         whose rows actually contain a foldable delete+add pair — fall
         back to the two-program path with identical observable output.
         Phase timings flow through :mod:`semantic_merge_tpu.obs`.
-        Returns ``(BuildAndDiffResult, composed_ops, conflicts)``."""
+        Returns ``(BuildAndDiffResult, composed_ops, conflicts)``.
+
+        ``composed_ops`` is handed through COLUMNAR: the fused path's
+        ``ComposedOpView`` (op-stream columns + tail-plan shards) feeds
+        the columnar applier (``runtime/applier.py``) and the columnar
+        touched-path scope directly — the default CLI merge
+        materializes zero composed ``Op`` objects end-to-end
+        (``SEMMERGE_OBJECT_APPLY=1`` forces the object oracle)."""
         ts = timestamp or EPOCH_ISO
         self._maybe_reset_interner()
         if not structured_apply and not statement_ops:

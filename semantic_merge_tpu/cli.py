@@ -218,14 +218,22 @@ def cmd_semdiff(args: argparse.Namespace) -> int:
                 or config.engine.statement_ops)
     try:
         with tracer.phase("snapshot"):
-            from .runtime.git import (archive_bytes, diff_scope,
-                                      snapshot_from_bytes)
+            from .runtime.git import (archive_bytes, collision_safe_scope,
+                                      diff_scope, snapshot_from_bytes)
             scope = (diff_scope(args.rev1, args.rev2)
                      if config.engine.incremental else None)
-            base_snap = snapshot_from_bytes(archive_bytes(args.rev1),
-                                            paths=scope)
-            right_snap = snapshot_from_bytes(archive_bytes(args.rev2),
-                                             paths=scope)
+            rev1_tar = archive_bytes(args.rev1)
+            rev2_tar = archive_bytes(args.rev2)
+            base_snap = snapshot_from_bytes(rev1_tar, paths=scope)
+            right_snap = snapshot_from_bytes(rev2_tar, paths=scope)
+            if scope is not None and collision_safe_scope(
+                    scope, rev1_tar, resolve_rev(args.rev1),
+                    (base_snap, right_snap)) is None:
+                logger.info("incremental scope disabled: a scoped "
+                            "symbolId has an out-of-scope twin")
+                scope = None
+                base_snap = snapshot_from_bytes(rev1_tar)
+                right_snap = snapshot_from_bytes(rev2_tar)
         with tracer.phase("diff"):
             ops = backend.diff(base_snap, right_snap,
                                base_rev=resolve_rev(args.rev1),
@@ -253,8 +261,8 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
     merged_tree: pathlib.Path | None = None
     try:
         with tracer.phase("snapshot"):
-            from .runtime.git import (archive_bytes, merge_scope,
-                                      snapshot_from_bytes)
+            from .runtime.git import (archive_bytes, collision_safe_scope,
+                                      merge_scope, snapshot_from_bytes)
             base_tar = archive_bytes(args.base)
             left_tar = archive_bytes(args.a)
             right_tar = archive_bytes(args.b)
@@ -266,6 +274,19 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
             base_snap = snapshot_from_bytes(base_tar, paths=scope)
             left_snap = snapshot_from_bytes(left_tar, paths=scope)
             right_snap = snapshot_from_bytes(right_tar, paths=scope)
+            if scope is not None and collision_safe_scope(
+                    scope, base_tar, resolve_rev(args.base),
+                    (base_snap, left_snap, right_snap)) is None:
+                # A scoped symbolId has an out-of-scope twin: under
+                # Map-last-wins the restriction could change which
+                # occurrence survives — fall back to the full scan
+                # (see runtime/git.py merge_scope).
+                logger.info("incremental scope disabled: a scoped "
+                            "symbolId has an out-of-scope twin")
+                scope = None
+                base_snap = snapshot_from_bytes(base_tar)
+                left_snap = snapshot_from_bytes(left_tar)
+                right_snap = snapshot_from_bytes(right_tar)
             if scope is not None:
                 tracer.count("scope_files", len(scope))
         base_rev = resolve_rev(args.base)
@@ -306,8 +327,12 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                     signature_matcher=sig_matcher, statement_ops=stmt_ops)
             with tracer.phase("compose"):
                 from .core.strict_conflicts import detect_conflicts_strict
-                ops_left, ops_right, conflicts = detect_conflicts_strict(
-                    result.op_log_left, result.op_log_right)
+                from .obs import spans as obs_spans
+                with obs_spans.span("strict_detect", layer="core",
+                                    n_a=len(result.op_log_left),
+                                    n_b=len(result.op_log_right)):
+                    ops_left, ops_right, conflicts = detect_conflicts_strict(
+                        result.op_log_left, result.op_log_right)
                 compose_fn = getattr(backend, "compose", None) or compose_oplogs
                 composed, walk_conflicts = compose_fn(ops_left, ops_right)
                 conflicts.extend(walk_conflicts)
@@ -373,14 +398,11 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                 # while letting notes.txt through when no backend set
                 # existed. A text-merged notes.txt or binary must not
                 # reach prettier as an explicit arg. Untouched files
-                # keep their bytes.
-                from .runtime.applier import _normalize_relpath
+                # keep their bytes. Columnar composed views answer
+                # straight from their columns (no Op materialization).
+                from .runtime.applier import _normalize_relpath, touched_paths
                 from .runtime.emitter import PRETTIER_EXTENSIONS
-                touched = {str(_normalize_relpath(v))
-                           for op in composed
-                           for k in ("file", "oldFile", "newFile",
-                                     "oldPath", "newPath")
-                           if isinstance((v := op.params.get(k)), str) and v}
+                touched = touched_paths(composed)
                 touched.update(
                     str(_normalize_relpath(p)) for p in text_written
                     if pathlib.PurePosixPath(p).suffix.lower()

@@ -44,7 +44,7 @@ from ..core.encode import (NULL_ID, PAD_ID, Interner, OpTensor,
                            build_rank_tables, encode_oplog, pad_to,
                            shard_bucket)
 from ..core.ops import Op, Target
-from .oplog_view import _materialize_decoded
+from .oplog_view import ComposedOpView
 
 _PAD_PREC = np.int32(2**30)  # sorts after every real precedence
 
@@ -342,8 +342,20 @@ def compose_oplogs_device(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op
 def decode_compose_output(out: np.ndarray, delta_a: List[Op], delta_b: List[Op],
                           interner: Interner, na: int, nb: int
                           ) -> Tuple[List[Op], List[Conflict]]:
-    """Decode the kernel's stacked int32 result matrix back to op/conflict
-    lists (shared by the single-device and mesh compose paths)."""
+    """Decode the kernel's stacked int32 result matrix into the composed
+    stream + conflict list (shared by the single-device and mesh compose
+    paths).
+
+    The composed stream comes back as a lazy
+    :class:`~semantic_merge_tpu.ops.oplog_view.ComposedOpView` over the
+    two sorted *object* streams — the view is handed through instead of
+    a materialized list, so consumers that never need full ``Op`` rows
+    (``len``, the applier's object loop deferred to apply time) skip the
+    override clones, and every composed result reaches the apply layer
+    as one shape. Materializing the view is bit-identical to the eager
+    decode this replaces: no-override rows pass the stream op through
+    unchanged (``_materialize_decoded``'s identity case), override rows
+    pay the per-op clone."""
     (out_side, out_row, chain_addr, chain_file, chain_name,
      n_out_row, conf_a, conf_b, n_conf_row, a_op_index, b_op_index) = out
     n_out, n_conf = int(n_out_row[0]), int(n_conf_row[0])
@@ -351,37 +363,36 @@ def decode_compose_output(out: np.ndarray, delta_a: List[Op], delta_b: List[Op],
     sorted_a = [delta_a[i] for i in a_op_index[:na].tolist() if i != NULL_ID]
     sorted_b = [delta_b[i] for i in b_op_index[:nb].tolist() if i != NULL_ID]
 
-    # Columnar decode: one object-array gather resolves every interned
-    # chain id to its string (NULL_ID = -1 wraps to the trailing None),
-    # and `.tolist()` turns the int32 rows into plain ints once — the
-    # per-op numpy-scalar indexing this replaces was the hot loop at the
-    # 1k-file rung (VERDICT round 1, Weak #3).
-    sides = out_side[:n_out].tolist()
-    rows = out_row[:n_out].tolist()
-
-    # Vectorized no-override fast path: a row with all three chain
-    # columns NULL passes its stream op through unchanged
-    # (_materialize_decoded's identity case), so the common
-    # chains-don't-fire merge never calls it at all — the composed list
-    # assembles as plain gathers and only override rows pay the
-    # per-op clone.
-    ca, cf, cn = chain_addr[:n_out], chain_file[:n_out], chain_name[:n_out]
-    composed: List[Op] = [
-        (sorted_a if side == 0 else sorted_b)[row]
-        for side, row in zip(sides, rows)]
-    override_rows = np.nonzero(
-        (ca != NULL_ID) | (cf != NULL_ID) | (cn != NULL_ID))[0]
-    if len(override_rows):
-        strings = interner.object_table()
-        addr_s = strings[ca[override_rows]].tolist()
-        file_s = strings[cf[override_rows]].tolist()
-        name_s = strings[cn[override_rows]].tolist()
-        for k, i in enumerate(override_rows.tolist()):
-            composed[i] = _materialize_decoded(
-                composed[i], addr_s[k], file_s[k], name_s[k])
-
     conflicts: List[Conflict] = []
     for k in range(n_conf):
         conflicts.append(divergent_rename_conflict(
             sorted_a[int(conf_a[k])], sorted_b[int(conf_b[k])]))
+    if n_out == 0:
+        return [], conflicts
+
+    # Columnar decode: one object-array gather resolves every interned
+    # chain id to its string (NULL_ID = -1 wraps to the trailing None),
+    # and `.tolist()` turns the int32 rows into plain ints once — the
+    # per-op numpy-scalar indexing this replaces was the hot loop at the
+    # 1k-file rung (VERDICT round 1, Weak #3). Only override rows get
+    # string columns; everything else stays None (= no override).
+    sides = out_side[:n_out].tolist()
+    rows = out_row[:n_out].tolist()
+    ca, cf, cn = chain_addr[:n_out], chain_file[:n_out], chain_name[:n_out]
+    addr_s: List = [None] * n_out
+    file_s: List = [None] * n_out
+    name_s: List = [None] * n_out
+    override_rows = np.nonzero(
+        (ca != NULL_ID) | (cf != NULL_ID) | (cn != NULL_ID))[0]
+    if len(override_rows):
+        strings = interner.object_table()
+        a_vals = strings[ca[override_rows]].tolist()
+        f_vals = strings[cf[override_rows]].tolist()
+        n_vals = strings[cn[override_rows]].tolist()
+        for k, i in enumerate(override_rows.tolist()):
+            addr_s[i] = a_vals[k]
+            file_s[i] = f_vals[k]
+            name_s[i] = n_vals[k]
+    composed = ComposedOpView(sides, rows, addr_s, file_s, name_s,
+                              sorted_a, sorted_b)
     return composed, conflicts

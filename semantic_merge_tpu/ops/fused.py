@@ -240,7 +240,13 @@ class TailPlan:
                          layer="ops", stage="decode", rows=hi - lo)
         return out
 
-    def _shard_overrides(self, lo: int, hi: int):
+    def shard_overrides(self, lo: int, hi: int):
+        """One shard's decoded chain-override columns ``(addr, file,
+        name)`` — cached, claimed from an in-flight pool future, or
+        computed inline. Public: the columnar applier walks the plan's
+        shard ranges through :meth:`ComposedOpView.override_rows`, so
+        apply work on early shards overlaps later shards' decodes (and,
+        split-fetch, the chain transfer itself)."""
         key = (lo, hi)
         with self._lock:
             ent = self._decoded.get(key)
@@ -266,7 +272,7 @@ class TailPlan:
         blocked workers only add cost, and the shard plan (hence the
         output) is identical either way."""
         def run():
-            overrides = self._shard_overrides(lo, hi)
+            overrides = self.shard_overrides(lo, hi)
             t0 = time.perf_counter()
             ops = build_fn(lo, hi, overrides)
             obs_spans.record("materialize_overlap",
@@ -284,7 +290,7 @@ class TailPlan:
         file: list = []
         name: list = []
         for lo, hi in self.ranges:
-            a, f, nm = self._shard_overrides(lo, hi)
+            a, f, nm = self.shard_overrides(lo, hi)
             addr.extend(a)
             file.extend(f)
             name.extend(nm)

@@ -240,6 +240,18 @@ class OpStreamView(Sequence):
     def __len__(self) -> int:
         return int(self.kind.shape[0])
 
+    # -- columnar accessors ------------------------------------------------
+    def base_fields(self) -> Tuple[list, list, list, list]:
+        """The base snapshot's per-node field columns ``(symbolId,
+        addressId, name, file)`` as plain string lists, via the engine's
+        per-snapshot cache — the columnar applier reads op params
+        through these instead of materializing ``Op`` objects."""
+        return _get_fields(self.base_tbl_ref, self.base_nodes)
+
+    def side_fields(self) -> Tuple[list, list, list, list]:
+        """The side snapshot's field columns; see :meth:`base_fields`."""
+        return _get_fields(self.side_tbl_ref, self.side_nodes)
+
     def ids(self) -> List[str]:
         if self._ids is None:
             self._ids = format_ids(self.words)
@@ -551,7 +563,13 @@ class ComposedOpView(Sequence):
     lists or int32 numpy arrays); ``addr_s``/``file_s``/``name_s``
     carry the decoded chain-override strings (``None`` = no override),
     exactly the arguments the eager path fed
-    :func:`_materialize_decoded`."""
+    :func:`_materialize_decoded`.
+
+    ``left``/``right`` are usually :class:`OpStreamView` columns (the
+    fused path), but any indexable ``Sequence[Op]`` works — the device
+    composer hands its sorted *object* streams through the same class,
+    so every composed result reaches the applier as one shape. Column
+    consumers gate on :attr:`supports_columns`."""
 
     __slots__ = ("sides", "idxs", "addr_s", "file_s", "name_s",
                  "left", "right", "_all", "_chains_thunk", "_plan")
@@ -612,6 +630,56 @@ class ComposedOpView(Sequence):
     def __len__(self) -> int:
         return len(self.sides)
 
+    @property
+    def supports_columns(self) -> bool:
+        """Whether both sources are columnar :class:`OpStreamView`
+        streams — the gate for column consumers (the columnar applier,
+        the C composed-op factory). Object-backed views (the device
+        composer's sorted op lists) answer False and materialize rows
+        instead."""
+        return (isinstance(self.left, OpStreamView)
+                and isinstance(self.right, OpStreamView))
+
+    def apply_shard_ranges(self) -> List[Tuple[int, int]]:
+        """Contiguous ascending ``(lo, hi)`` row ranges a shard-wise
+        consumer should walk — the PR-2 tail plan's shard boundaries
+        when this view is pipelined (so per-shard chain decodes already
+        submitted to the worker pool are consumed as they land, and on
+        a split-fetch merge the first shards apply while later chain
+        bytes are still streaming device→host), else one full range."""
+        if self._plan is not None:
+            return list(self._plan.ranges)
+        n = len(self)
+        return [(0, n)] if n else []
+
+    def override_rows(self, lo: int, hi: int
+                      ) -> Tuple[list, list, list]:
+        """The decoded chain-override columns ``(addr, file, name)``
+        for rows ``lo:hi`` (local indexing, ``None`` = no override).
+        ``(lo, hi)`` must be one of :meth:`apply_shard_ranges` when the
+        view is pipelined — those are the granularity the tail plan
+        memoizes (and may already have decoded in a worker)."""
+        if self.addr_s is not None:
+            return (self.addr_s[lo:hi], self.file_s[lo:hi],
+                    self.name_s[lo:hi])
+        if self._plan is not None:
+            return self._plan.shard_overrides(lo, hi)
+        self._force_chains()
+        return self.addr_s[lo:hi], self.file_s[lo:hi], self.name_s[lo:hi]
+
+    def row_slices(self, lo: int, hi: int) -> Tuple[object, object]:
+        """Zero-copy ``(sides, idxs)`` row slices for ``lo:hi`` —
+        numpy views when the backing columns are arrays (the fused
+        path), list slices otherwise."""
+        return self.sides[lo:hi], self.idxs[lo:hi]
+
+    def materialize_row(self, i: int) -> Op:
+        """Escape hatch: ONE row as a full :class:`Op` — for the rare
+        consumers that genuinely need structured params (conflict
+        constructors, spot inspection, unknown-kind fallbacks) while
+        the bulk path stays on the columns."""
+        return self[i]
+
     def __getitem__(self, i: int) -> Op:
         if isinstance(i, slice):
             return [self[j] for j in range(*i.indices(len(self)))]
@@ -671,7 +739,7 @@ class ComposedOpView(Sequence):
             self._all = out
             return out
         self._force_chains()
-        if len(self) > 0:
+        if len(self) > 0 and self.supports_columns:
             from ..frontend.native import load_opfactory
             fac = load_opfactory()
             if fac is not None:
@@ -688,8 +756,10 @@ class ComposedOpView(Sequence):
                     self.addr_s, self.file_s, self.name_s,
                     self.left.prov, self.right.prov, Op, Target)
                 return self._all
-        ops_l = self.left.materialize()
-        ops_r = self.right.materialize()
+        ops_l = (self.left.materialize()
+                 if isinstance(self.left, OpStreamView) else self.left)
+        ops_r = (self.right.materialize()
+                 if isinstance(self.right, OpStreamView) else self.right)
         self._all = [
             _materialize_decoded(
                 (ops_l if side == 0 else ops_r)[int(i)], na, nf, nn)

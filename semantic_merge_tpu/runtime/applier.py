@@ -1,6 +1,6 @@
 """Materialize composed ops onto a tree (reference ``semmerge/applier.py``).
 
-Applies a composed op list to a copy of the base tree. Implemented
+Applies a composed op stream to a copy of the base tree. Implemented
 handlers (the reference's set): ``moveDecl`` moves the *whole file*
 old→new; ``renameSymbol`` rewrites word-boundary occurrences across the
 file; ``modifyImport`` is a literal replace; ``moveFile`` moves by
@@ -8,14 +8,38 @@ old/new path. Everything else is logged and skipped (reference
 ``semmerge/applier.py:30-31``). Additionally ``reorderImports`` is
 applied via the RGA CRDT ordering (wired in here; dead code in the
 reference, ``semmerge/crdt.py``).
+
+Two dispatch paths, one contract:
+
+- **Columnar** (default for the fused device path): a
+  :class:`~semantic_merge_tpu.ops.oplog_view.ComposedOpView` backed by
+  op-stream columns is consumed directly — dispatch on the int kind
+  column, params read through the cached per-snapshot field tables,
+  chain-file overrides applied exactly as ``_materialize_decoded``
+  would. No ``Op`` objects materialize; the walk is shard-wise over the
+  PR-2 tail plan, so early shards apply while later shards' chain
+  decodes (and, split-fetch, the chain transfer itself) are still in
+  flight. The fused path's op vocabulary is exactly the four diff kinds,
+  none of which carry structured params, so the full-Op escape hatch
+  (``view.materialize_row``) exists but is never needed on this path.
+- **Object** (host composer output, ``semrebase`` replay, strict mode,
+  and the parity oracle behind ``SEMMERGE_OBJECT_APPLY=1``): the
+  original per-op handler loop, byte-identical trees by construction —
+  both paths call the same file-edit primitives.
+
+Parity (trees AND notes payloads) is property-tested in
+``tests/test_applier_columnar.py``.
 """
 from __future__ import annotations
 
+import os
 import pathlib
 import re
 import shutil
 import tempfile
-from typing import Iterable
+from typing import Iterable, List, Optional, Set
+
+import numpy as np
 
 from ..core.ops import Op
 from ..obs import metrics as obs_metrics
@@ -27,20 +51,320 @@ def apply_ops(base_tree: pathlib.Path, ops: Iterable[Op],
               *, device_crdt: bool = False) -> pathlib.Path:
     """Apply composed ops to a copy of ``base_tree``.
 
-    With ``device_crdt`` (the tpu backend's path), every
-    ``reorderImports`` op's RGA ordering in the merge resolves in ONE
-    batched device materialization
+    Column-backed composed views take the columnar dispatch loop (no Op
+    materialization); everything else takes the object loop.
+    ``SEMMERGE_OBJECT_APPLY=1`` forces the object loop for any input —
+    the parity oracle. With ``device_crdt`` (the tpu backend's path),
+    every ``reorderImports`` op's RGA ordering in the merge resolves in
+    ONE batched device materialization
     (:func:`semantic_merge_tpu.ops.crdt.materialize_batch`) instead of
     per-list host insert scans; output is identical (parity-tested).
     """
-    ops = list(ops)
-    obs_metrics.REGISTRY.counter(
+    view = _columnar_view(ops)
+    counter = obs_metrics.REGISTRY.counter(
         "semmerge_ops_applied_total",
-        "Composed ops handed to the tree applier").inc(len(ops))
+        "Composed ops handed to the tree applier")
+    if view is not None and not _object_apply_forced():
+        counter.inc(len(view))
+        with obs_spans.span("apply_ops", layer="runtime", ops=len(view),
+                            device_crdt=device_crdt, columnar=True):
+            return _apply_columnar(pathlib.Path(base_tree), view)
+    ops = list(ops)
+    counter.inc(len(ops))
     with obs_spans.span("apply_ops", layer="runtime", ops=len(ops),
                         device_crdt=device_crdt):
         return _apply_ops(pathlib.Path(base_tree), ops, device_crdt)
 
+
+def _object_apply_forced() -> bool:
+    """``SEMMERGE_OBJECT_APPLY=1`` keeps the object-dispatch applier as
+    a parity oracle: composed views materialize full ``Op`` objects and
+    flow through the per-op handlers exactly as before the columnar
+    path existed."""
+    return os.environ.get("SEMMERGE_OBJECT_APPLY", "").strip() == "1"
+
+
+def _columnar_view(ops):
+    """``ops`` as a column-backed ComposedOpView, or ``None``."""
+    from ..ops.oplog_view import ComposedOpView
+    if isinstance(ops, ComposedOpView) and ops.supports_columns:
+        return ops
+    return None
+
+
+# --------------------------------------------------------------------------
+# Columnar dispatch
+# --------------------------------------------------------------------------
+
+#: OP_PRECEDENCE of the four columnar diff kinds, indexed by KIND_*
+#: code (rename, move, add, delete) — the order the composed stream is
+#: emitted in, and the order-check table for the bulk action assembly.
+_PREC_OF_KIND = np.asarray([11, 10, 30, 31], dtype=np.int32)
+
+
+def iter_columnar_actions(view):
+    """Per-shard apply actions straight off a composed view's columns.
+
+    Yields one list of ACTION GROUPS per tail-plan shard (contiguous
+    ascending ranges). A group is ``("move", old_files, new_files)`` or
+    ``("rename", files, old_names, new_names)`` — parallel column
+    lists, already override-applied and validity-filtered, so consumers
+    zip them row-wise without any per-row Python dispatch here. Rows
+    with no tree effect — ``addDecl`` without structured text,
+    ``deleteDecl`` tombstones (the object path's "no applier hook"
+    skips), rows with missing required params — are simply absent.
+
+    Chain-file overrides land exactly where ``_materialize_decoded``
+    would put them. For RENAME rows that is the ``file`` param (the
+    last preceding move's destination). For MOVE rows the override is a
+    proven no-op and is skipped: the chain scan is inclusive and a live
+    move always contributes its own (non-null) destination, so a move
+    row's decoded chain-file IS its own ``newFile`` — parity with the
+    object path is property-tested either way. The addr/name overrides
+    only touch fields the tree applier never reads.
+
+    Assembly is bulk per kind (C-speed map gathers over the cached
+    field tables), exploiting the composed stream's canonical-order
+    invariant: rows sort by op precedence, so within any contiguous
+    slice every moveDecl precedes every renameSymbol and emitting
+    moves-then-renames IS row order. The invariant is verified per
+    shard (one vectorized monotonicity check); a violating stream
+    falls back to exact row-order assembly (a ``("rows", actions)``
+    group of per-row tuples).
+    """
+    from ..ops.oplog_view import KIND_MOVE, KIND_RENAME
+    left, right = view.left, view.right
+    b_name, b_file = left.base_fields()[2:4]
+    l_name, l_file = left.side_fields()[2:4]
+    r_name, r_file = right.side_fields()[2:4]
+    kL, kR = left.kind, right.kind
+
+    def merged(col_l, col_r, isL_k, rows):
+        """Per-row gather from a per-stream int column pair, clamped so
+        the other side's (never-selected) lane can't index out of an
+        empty or shorter stream."""
+        li = col_l[np.minimum(rows, max(col_l.shape[0] - 1, 0))] \
+            if col_l.shape[0] else rows
+        ri = col_r[np.minimum(rows, max(col_r.shape[0] - 1, 0))] \
+            if col_r.shape[0] else rows
+        return np.where(isL_k, li, ri)
+
+    def gather_side(fields_l, fields_r, isL_k, slot):
+        """Side-dependent string gather: two C-speed ``map`` passes over
+        the per-side field lists, interleaved back to row order through
+        an object-array scatter (returned as the object array — row
+        iteration over it matches a list)."""
+        out = np.empty(len(slot), dtype=object)
+        wl_k = np.nonzero(isL_k)[0]
+        wr_k = np.nonzero(~isL_k)[0]
+        if len(wl_k):
+            out[wl_k] = list(map(fields_l.__getitem__,
+                                 slot[wl_k].tolist()))
+        if len(wr_k):
+            out[wr_k] = list(map(fields_r.__getitem__,
+                                 slot[wr_k].tolist()))
+        return out
+
+    def with_override(vals: list, file_o, rows) -> list:
+        ov = list(map(file_o.__getitem__, rows.tolist()))
+        if any(o is not None for o in ov):
+            return [v if o is None else o for o, v in zip(ov, vals)]
+        return vals
+
+    for lo, hi in view.apply_shard_ranges():
+        sides, idxs = view.row_slices(lo, hi)
+        _, file_o, _ = view.override_rows(lo, hi)
+        sides = np.asarray(sides, dtype=np.int32)
+        idxs = np.asarray(idxs, dtype=np.int32)
+        n = hi - lo
+        isL = sides == 0
+        kind_row = merged(kL, kR, isL, idxs)
+        prec = _PREC_OF_KIND[kind_row]
+        if n > 1 and not bool((prec[1:] >= prec[:-1]).all()):
+            yield [("rows",
+                    _row_order_actions(view, kind_row, isL, idxs, file_o))]
+            continue
+        groups: list = []
+        mv = np.nonzero(kind_row == KIND_MOVE)[0]
+        if len(mv):
+            isL_k = isL[mv]
+            a_row = merged(left.a_slot, right.a_slot, isL_k, idxs[mv])
+            b_row = merged(left.b_slot, right.b_slot, isL_k, idxs[mv])
+            # Move params are decl FILE fields, which the scanner never
+            # leaves empty (every DeclNode carries its snapshot path) —
+            # the object handler's falsy-param skip cannot fire, so the
+            # validity scan is elided on this hot column.
+            olds = list(map(b_file.__getitem__, a_row.tolist()))
+            news = gather_side(l_file, r_file, isL_k, b_row)
+            groups.append(("move", olds, news))
+        ren = np.nonzero(kind_row == KIND_RENAME)[0]
+        if len(ren):
+            isL_k = isL[ren]
+            a_row = merged(left.a_slot, right.a_slot, isL_k, idxs[ren])
+            b_row = merged(left.b_slot, right.b_slot, isL_k, idxs[ren])
+            olds = list(map(b_name.__getitem__, a_row.tolist()))
+            news = gather_side(l_name, r_name, isL_k, b_row)
+            files = with_override(
+                gather_side(l_file, r_file, isL_k, b_row), file_o, ren)
+            if all(olds) and all(news) and all(files):
+                groups.append(("rename", files, olds, news))
+            else:
+                kept = [(f, o, nw)
+                        for f, o, nw in zip(files, olds, news)
+                        if f and o and nw]
+                groups.append(("rename", [f for f, _, _ in kept],
+                               [o for _, o, _ in kept],
+                               [nw for _, _, nw in kept]))
+        yield groups
+
+
+def _row_order_actions(view, kind_row, isL, idxs, file_o) -> list:
+    """Exact row-order assembly — the fallback for a composed stream
+    that is not precedence-sorted (no producer emits one today; this
+    keeps the bulk path honest rather than silently reordering)."""
+    from ..ops.oplog_view import KIND_MOVE, KIND_RENAME
+    left, right = view.left, view.right
+    b_name, b_file = left.base_fields()[2:4]
+    cols = ((left.a_slot, left.b_slot) + left.side_fields()[2:4],
+            (right.a_slot, right.b_slot) + right.side_fields()[2:4])
+    acts: list = []
+    for w, (k, s, i) in enumerate(zip(kind_row.tolist(), isL.tolist(),
+                                      idxs.tolist())):
+        a_c, b_c, s_name, s_file = cols[0 if s else 1]
+        if k == KIND_RENAME:
+            f = file_o[w]
+            if f is None:
+                f = s_file[int(b_c[i])]
+            old, new = b_name[int(a_c[i])], s_name[int(b_c[i])]
+            if f and old and new:
+                acts.append(("rename", f, old, new))
+        elif k == KIND_MOVE:
+            nf = file_o[w]
+            if nf is None:
+                nf = s_file[int(b_c[i])]
+            of = b_file[int(a_c[i])]
+            if of and nf:
+                acts.append(("move", of, nf))
+    return acts
+
+
+def _apply_columnar(base_tree: pathlib.Path, view) -> pathlib.Path:
+    out = pathlib.Path(tempfile.mkdtemp(prefix="semmerge_merged_"))
+    shutil.copytree(base_tree, out, dirs_exist_ok=True)
+    renames = moves = 0
+    with obs_spans.span("apply_columnar", layer="runtime", rows=len(view)):
+        for groups in iter_columnar_actions(view):
+            for g in groups:
+                if g[0] == "rename":
+                    renames += len(g[1])
+                    for f, old, new in zip(g[1], g[2], g[3]):
+                        _rename_symbol_in_file(out, f, old, new)
+                elif g[0] == "move":
+                    moves += len(g[1])
+                    for old, new in zip(g[1], g[2]):
+                        _move_decl_path(out, old, new)
+                else:  # ("rows", [...]) — the exact row-order fallback
+                    for act in g[1]:
+                        if act[0] == "rename":
+                            _rename_symbol_in_file(out, *act[1:])
+                            renames += 1
+                        else:
+                            _move_decl_path(out, *act[1:])
+                            moves += 1
+    skipped = len(view) - renames - moves
+    rows = obs_metrics.REGISTRY.counter(
+        "semmerge_columnar_apply_rows_total",
+        "Composed rows consumed by the columnar applier, by action")
+    rows.inc(renames, action="rename")
+    rows.inc(moves, action="move")
+    rows.inc(skipped, action="skip")
+    return out
+
+
+def consume_stream(ops) -> int:
+    """Consume a composed stream the way ``cmd_semmerge``'s apply layer
+    does, minus the tree I/O — the bench's honest device-path endpoint.
+
+    Columnar views walk the full shard-wise action plan (forcing the
+    chain decode and reading every param through the field tables);
+    object streams — and any stream under ``SEMMERGE_OBJECT_APPLY=1`` —
+    fully materialize, as the object applier's ``list(ops)`` does.
+    Returns the number of actionable rows (renames + moves).
+    """
+    view = _columnar_view(ops)
+    if view is not None and not _object_apply_forced():
+        with obs_spans.span("apply_plan", layer="runtime", rows=len(view)):
+            return sum(len(g[1]) for groups in iter_columnar_actions(view)
+                       for g in groups)
+    materialized = list(ops)
+    return sum(op.type in ("renameSymbol", "moveDecl")
+               for op in materialized)
+
+
+def touched_paths(ops) -> Set[str]:
+    """Normalized tree-relative paths of every file the composed stream
+    can write — the ``[engine] formatter_scope = "touched"`` scope (the
+    path-bearing params: ``file``/``oldFile``/``newFile``/``oldPath``/
+    ``newPath``). Columnar views compute the set from their columns
+    without materializing Ops; the object comprehension is the oracle
+    (sets are equal by construction — parity-tested)."""
+    view = _columnar_view(ops)
+    if view is not None and not _object_apply_forced():
+        return _touched_paths_columnar(view)
+    return {str(_normalize_relpath(v))
+            for op in ops
+            for k in ("file", "oldFile", "newFile", "oldPath", "newPath")
+            if isinstance((v := op.params.get(k)), str) and v}
+
+
+def _touched_paths_columnar(view) -> Set[str]:
+    from ..ops.oplog_view import (KIND_ADD, KIND_DELETE, KIND_MOVE,
+                                  KIND_RENAME)
+    left, right = view.left, view.right
+    b_file = left.base_fields()[3]
+    sources = (
+        (left.kind, left.a_slot, left.b_slot, left.side_fields()[3]),
+        (right.kind, right.a_slot, right.b_slot, right.side_fields()[3]),
+    )
+    raw: Set[str] = set()
+    for lo, hi in view.apply_shard_ranges():
+        sides, idxs = view.row_slices(lo, hi)
+        _, file_o, _ = view.override_rows(lo, hi)
+        sides = np.asarray(sides, dtype=np.int32)
+        idxs = np.asarray(idxs, dtype=np.int32)
+        for s, (kind_c, a_c, b_c, s_file) in enumerate(sources):
+            on_side = np.nonzero(sides == s)[0]
+            if not len(on_side):
+                continue
+            kind = kind_c[idxs[on_side]]
+            # Rename `file` / move `newFile`: the side file, with the
+            # chain-file override where _materialize_decoded puts it.
+            ren_mv = on_side[(kind == KIND_RENAME) | (kind == KIND_MOVE)]
+            for w, y in zip(ren_mv.tolist(), b_c[idxs[ren_mv]].tolist()):
+                f = file_o[w]
+                if f is None:
+                    f = s_file[y]
+                if f:
+                    raw.add(f)
+            # Add `file`: the raw side file (add/delete params keep it
+            # even when the symbol's chain fired).
+            adds = on_side[kind == KIND_ADD]
+            for y in b_c[idxs[adds]].tolist():
+                f = s_file[y]
+                if f:
+                    raw.add(f)
+            # Move `oldFile` / delete `file`: the base file.
+            base_rows = on_side[(kind == KIND_MOVE) | (kind == KIND_DELETE)]
+            for x in a_c[idxs[base_rows]].tolist():
+                f = b_file[x]
+                if f:
+                    raw.add(f)
+    return {str(_normalize_relpath(p)) for p in raw}
+
+
+# --------------------------------------------------------------------------
+# Object dispatch (the oracle)
+# --------------------------------------------------------------------------
 
 def _apply_ops(base_tree: pathlib.Path, ops: list,
                device_crdt: bool) -> pathlib.Path:
@@ -128,6 +452,11 @@ def _apply_move_decl(root: pathlib.Path, op: Op) -> None:
     new_file = op.params.get("newFile") or op.params.get("file")
     if not old_file or not new_file:
         return
+    _move_decl_path(root, old_file, new_file)
+
+
+def _move_decl_path(root: pathlib.Path, old_file, new_file) -> None:
+    """The moveDecl edit primitive, shared by both dispatch paths."""
     src = root / _normalize_relpath(old_file)
     dst = root / _normalize_relpath(new_file)
     if src == dst:
@@ -159,12 +488,18 @@ def _apply_rename_symbol(root: pathlib.Path, op: Op) -> None:
     new_name = op.params.get("newName")
     if not file_path or not old_name or not new_name:
         return
+    _rename_symbol_in_file(root, file_path, str(old_name), str(new_name))
+
+
+def _rename_symbol_in_file(root: pathlib.Path, file_path,
+                           old_name: str, new_name: str) -> None:
+    """The renameSymbol edit primitive, shared by both dispatch paths."""
     path = root / _normalize_relpath(file_path)
     if not path.exists():
         logger.debug("renameSymbol target missing: %s", path)
         return
     code = path.read_text(encoding="utf-8")
-    code = re.sub(rf"\b{re.escape(str(old_name))}\b", str(new_name), code)
+    code = re.sub(rf"\b{re.escape(old_name)}\b", new_name, code)
     path.write_text(code, encoding="utf-8")
 
 

@@ -15,7 +15,8 @@ import pathlib
 import subprocess
 import tarfile
 import tempfile
-from typing import Iterable, List
+from collections import OrderedDict
+from typing import Dict, Iterable, List
 
 from ..frontend.snapshot import SOURCE_EXTENSIONS, Snapshot
 
@@ -127,15 +128,94 @@ def merge_scope(base: str, a: str, b: str,
     only the scoped files. Returns ``None`` (caller falls back to the
     full-tree scan) when git cannot answer.
 
-    Known semantic caveat, shared with the reference's design: under
-    symbolId *collisions* (two decls with identical structural
-    signatures, JS-``Map`` last-wins — reference
-    ``workers/ts/src/sast.ts:65-67``) the surviving occurrence can
-    differ when the colliding twin lives outside the scope. Set
-    ``[engine] incremental = false`` for collision-exact full scans."""
+    Semantic caveat, shared with the reference's design: under symbolId
+    *collisions* (two decls with identical structural signatures,
+    JS-``Map`` last-wins — reference ``workers/ts/src/sast.ts:65-67``)
+    the surviving occurrence can differ when the colliding twin lives
+    outside the scope. The CLI closes this hole automatically: after
+    snapshotting it runs :func:`collision_safe_scope`, which keeps a
+    full-tree symbolId multiset per base commit and falls back to the
+    full scan whenever a scoped symbolId has an out-of-scope twin.
+    ``[engine] incremental = false`` still forces full scans outright."""
     try:
         changed = set(changed_files_between(base, a, cwd=cwd))
         changed |= set(changed_files_between(base, b, cwd=cwd))
         return changed
     except subprocess.CalledProcessError:
         return None
+
+
+# --------------------------------------------------------------------------
+# Incremental-scope collision guard
+# --------------------------------------------------------------------------
+# The per-commit symbol index: resolved rev → {path: [symbolId, ...]}
+# over the TS-indexed files of the FULL tree. Bounded; entries are pure
+# functions of the commit's content.
+_SYMID_INDEX_CACHE: "OrderedDict[str, Dict[str, List[str]]]" = OrderedDict()
+
+
+def snapshot_symbol_index(snap: Snapshot) -> Dict[str, List[str]]:
+    """Per-file symbolId lists of a snapshot's TS-indexed files (keyed
+    by the raw snapshot path — the same strings a git scope carries).
+    Scans go through the process-wide decl cache, so files the merge
+    scans anyway are shared work, not duplicate work."""
+    from ..frontend.scanner import scan_snapshot_keyed
+    from ..frontend.snapshot import TS_EXTENSIONS, filter_files
+    files = filter_files(snap, TS_EXTENSIONS)
+    return {f["path"]: [n.symbolId for n in nodes]
+            for f, (_, nodes) in zip(files, scan_snapshot_keyed(files))}
+
+
+def full_tree_symbol_index(tar_bytes: bytes,
+                           rev: str | None = None) -> Dict[str, List[str]]:
+    """The symbol index of a revision's full tree, memoized per
+    resolved commit — repeated merges against one base (watch mode,
+    merge-driver repo runs, the bench) pay the full-tree scan once per
+    process, and the decl cache absorbs most of even the cold scan."""
+    if rev is not None:
+        hit = _SYMID_INDEX_CACHE.get(rev)
+        if hit is not None:
+            _SYMID_INDEX_CACHE.move_to_end(rev)
+            return hit
+    index = snapshot_symbol_index(snapshot_from_bytes(tar_bytes))
+    if rev is not None:
+        _SYMID_INDEX_CACHE[rev] = index
+        while len(_SYMID_INDEX_CACHE) > 8:
+            _SYMID_INDEX_CACHE.popitem(last=False)
+    return index
+
+
+def scope_symbol_collisions(scope: "set[str]",
+                            base_index: Dict[str, List[str]],
+                            scoped_snaps: Iterable[Snapshot]) -> bool:
+    """True when any symbolId indexed by a scoped file also occurs in
+    an out-of-scope file of the base tree — the Map-last-wins hazard of
+    :func:`merge_scope`: restriction could change which colliding
+    occurrence survives the per-symbol join. Out-of-scope files are
+    identical in every snapshot of the merge (that is what "out of
+    scope" means), so the base tree's index is exact for them; scoped
+    ids union over all restricted snapshots, so decls a side *added*
+    count too."""
+    scoped_ids: set = set()
+    out_ids: set = set()
+    for path, ids in base_index.items():
+        (scoped_ids if path in scope else out_ids).update(ids)
+    for snap in scoped_snaps:
+        for ids in snapshot_symbol_index(snap).values():
+            scoped_ids.update(ids)
+    return bool(scoped_ids & out_ids)
+
+
+def collision_safe_scope(scope: "set[str] | None", base_tar: bytes,
+                         base_rev: str | None,
+                         scoped_snaps: Iterable[Snapshot]
+                         ) -> "set[str] | None":
+    """``scope`` when the incremental restriction is collision-exact,
+    else ``None`` — the caller falls back to full-tree snapshots.
+    An empty scope (no changed files) trivially passes."""
+    if not scope:
+        return scope
+    index = full_tree_symbol_index(base_tar, base_rev)
+    if scope_symbol_collisions(scope, index, scoped_snaps):
+        return None
+    return scope

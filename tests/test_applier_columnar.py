@@ -1,0 +1,194 @@
+"""Columnar-vs-object applier parity (the tentpole contract of the
+columnar apply path): the same composed stream applied through the
+columnar dispatch loop and through the object-handler oracle
+(``SEMMERGE_OBJECT_APPLY=1``) must produce byte-identical working
+trees, and the op-log/notes payloads serialized from the columnar
+views must be byte-identical to the object serialization — including
+conflict-patched streams, CRDT reorder ops, and empty streams."""
+import os
+import pathlib
+import random
+import tempfile
+
+import pytest
+
+import bench
+from semantic_merge_tpu.backends.base import get_backend, run_merge
+from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+from semantic_merge_tpu.core.ops import Op, OpLog, Target, dumps_canonical
+from semantic_merge_tpu.runtime.applier import (apply_ops, consume_stream,
+                                                touched_paths,
+                                                _normalize_relpath)
+
+KW = dict(base_rev="r", seed="s", timestamp="2026-01-01T00:00:00Z")
+
+
+def fused_backend():
+    return TpuTSBackend(mesh=False)
+
+
+def mk_tree(snap) -> pathlib.Path:
+    root = pathlib.Path(tempfile.mkdtemp(prefix="semmerge_base_"))
+    for f in snap.files:
+        p = root / f["path"]
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(f["content"], encoding="utf-8")
+    return root
+
+
+def tree_bytes(root) -> dict:
+    root = pathlib.Path(root)
+    return {str(p.relative_to(root)): p.read_bytes()
+            for p in sorted(root.rglob("*")) if p.is_file()}
+
+
+def object_touched(ops) -> set:
+    """The object-comprehension oracle for the touched-path set."""
+    return {str(_normalize_relpath(v))
+            for op in ops
+            for k in ("file", "oldFile", "newFile", "oldPath", "newPath")
+            if isinstance((v := op.params.get(k)), str) and v}
+
+
+def apply_both_ways(base_snap, composed, monkeypatch):
+    """(columnar tree bytes, object-oracle tree bytes) for one stream."""
+    tree = mk_tree(base_snap)
+    monkeypatch.delenv("SEMMERGE_OBJECT_APPLY", raising=False)
+    out_col = apply_ops(tree, composed)
+    monkeypatch.setenv("SEMMERGE_OBJECT_APPLY", "1")
+    out_obj = apply_ops(tree, composed)
+    monkeypatch.delenv("SEMMERGE_OBJECT_APPLY", raising=False)
+    return tree_bytes(out_col), tree_bytes(out_obj)
+
+
+def test_apply_parity_fuzz(monkeypatch):
+    """Property test: random synthetic workloads (clean and
+    DivergentRename — the latter exercises conflict-patched views whose
+    dropped rows and rename-context writes must not change the tree),
+    applied through both dispatch paths, plus the host oracle's
+    composed list, all byte-identical. Tiny tail shards force multiple
+    apply shards so shard-boundary stitching is covered; notes payloads
+    and touched-path sets are checked against their object oracles on
+    every trial."""
+    monkeypatch.setenv("SEMMERGE_TAIL_SHARD_ROWS", "16")
+    host = get_backend("host")
+    rng = random.Random(7)
+    for trial in range(4):
+        n = rng.randrange(15, 45)
+        divergent = bool(trial % 2)
+        base, left, right = bench.synth_repo(n, 3, divergent=divergent)
+        tpu = fused_backend()
+        res_t, comp_t, conf_t = run_merge(tpu, base, left, right, **KW)
+        res_h, comp_h, conf_h = run_merge(host, base, left, right, **KW)
+        assert comp_t.supports_columns, trial
+        if divergent:
+            assert conf_t, "divergent trial produced no conflicts"
+
+        a, b = apply_both_ways(base, comp_t, monkeypatch)
+        assert a == b, f"columnar vs object tree diverged (trial {trial})"
+        tree = mk_tree(base)
+        assert tree_bytes(apply_ops(tree, comp_h)) == a, \
+            f"columnar tree diverged from host-composed tree (trial {trial})"
+
+        # Notes payloads: the columnar op-stream serialization must be
+        # byte-identical to the object OpLog serialization.
+        for view, ops in ((res_t.op_log_left, res_h.op_log_left),
+                          (res_t.op_log_right, res_h.op_log_right)):
+            assert OpLog(view).to_json_bytes() == dumps_canonical(
+                [o.to_dict() for o in ops]).encode("utf-8"), trial
+
+        # Touched-path scope: columnar columns vs object comprehension.
+        assert touched_paths(comp_t) == object_touched(list(comp_t)), trial
+        # The bench's consumption endpoint counts exactly the
+        # actionable rows the object stream carries.
+        assert consume_stream(comp_t) == sum(
+            op.type in ("renameSymbol", "moveDecl") for op in comp_h), trial
+
+
+def test_apply_parity_empty_stream(monkeypatch):
+    """An empty composed stream (three identical snapshots) must apply
+    to an unchanged copy of the base tree on both paths."""
+    base, _, _ = bench.synth_repo(6, 2)
+    tpu = fused_backend()
+    _, composed, conflicts = run_merge(tpu, base, base, base, **KW)
+    assert len(composed) == 0 and not conflicts
+    a, b = apply_both_ways(base, composed, monkeypatch)
+    assert a == b == tree_bytes(mk_tree(base))
+    assert touched_paths(composed) == set()
+    assert consume_stream(composed) == 0
+
+
+def test_apply_parity_one_sided_stream(monkeypatch):
+    """One side identical to base (that op-stream column is empty):
+    the merged gathers must not index into the empty stream, and both
+    dispatch paths stay byte-identical."""
+    base, left, right = bench.synth_repo(12, 2)
+    tpu = fused_backend()
+    for snaps in ((base, base, right), (base, left, base)):
+        _, composed, _ = run_merge(tpu, *snaps, **KW)
+        assert len(composed) > 0
+        assert min(len(composed.left), len(composed.right)) == 0
+        a, b = apply_both_ways(base, composed, monkeypatch)
+        assert a == b
+        assert touched_paths(composed) == object_touched(list(composed))
+
+
+def test_apply_crdt_reorder_unaffected(monkeypatch):
+    """reorderImports (the CRDT-ordered handler) only ever arrives in
+    object streams — the columnar vocabulary is the four diff kinds —
+    and must behave identically whether or not the object oracle is
+    forced: the env flag gates dispatch, not semantics."""
+    order = [
+        {"value": 'import b from "b";', "anchor": "", "t": 1,
+         "author": "x", "opid": "1"},
+        {"value": 'import a from "a";', "anchor": "", "t": 2,
+         "author": "y", "opid": "2"},
+    ]
+    op = Op.new("reorderImports", Target(symbolId="s"),
+                params={"file": "a.ts", "order": order})
+    rename = Op.new("renameSymbol", Target(symbolId="s2"),
+                    params={"file": "a.ts", "oldName": "foo",
+                            "newName": "bar"})
+    root = pathlib.Path(tempfile.mkdtemp())
+    (root / "a.ts").write_text(
+        'import a from "a";\nimport b from "b";\nconst foo = 1;\n')
+    monkeypatch.delenv("SEMMERGE_OBJECT_APPLY", raising=False)
+    out1 = tree_bytes(apply_ops(root, [op, rename]))
+    monkeypatch.setenv("SEMMERGE_OBJECT_APPLY", "1")
+    out2 = tree_bytes(apply_ops(root, [op, rename]))
+    assert out1 == out2
+    assert out1["a.ts"].startswith(b'import b from "b";\nimport a from "a";')
+    assert b"const bar = 1;" in out1["a.ts"]
+
+
+def test_device_compose_view_applies_like_eager_list():
+    """The device composer now hands a lazy (object-backed) view
+    through instead of a materialized list; applying it must equal
+    applying the host composer's eager list."""
+    host = get_backend("host")
+    tpu = fused_backend()
+    base, left, right = bench.synth_repo(12, 2)
+    res = tpu.build_and_diff(base, left, right, **KW)
+    comp_view, _ = tpu.compose(list(res.op_log_left),
+                               list(res.op_log_right))
+    comp_list, _ = host.compose(list(res.op_log_left),
+                                list(res.op_log_right))
+    assert [o.to_dict() for o in comp_view] == \
+        [o.to_dict() for o in comp_list]
+    tree = mk_tree(base)
+    assert tree_bytes(apply_ops(tree, comp_view)) == \
+        tree_bytes(apply_ops(tree, comp_list))
+
+
+@pytest.mark.parametrize("split", ["0", "1"])
+def test_apply_parity_split_fetch_modes(monkeypatch, split):
+    """Both fetch schedules (one-buffer packed and split/deferred
+    chains) must feed the columnar applier identically — the split
+    path's chain decode happens shard-wise inside the apply walk."""
+    monkeypatch.setenv("SEMMERGE_SPLIT_FETCH", split)
+    monkeypatch.setenv("SEMMERGE_TAIL_SHARD_ROWS", "8")
+    base, left, right = bench.synth_repo(20, 2)
+    tpu = fused_backend()
+    _, composed, _ = run_merge(tpu, base, left, right, **KW)
+    a, b = apply_both_ways(base, composed, monkeypatch)
+    assert a == b

@@ -332,40 +332,85 @@ def test_snapshot_encode_cache_no_stale_hits():
     assert renames and renames[0].params["newName"] == "h"
 
 
+def _inject_scope_collision(base, left, right):
+    """Plant a colliding-signature pair across the scope boundary: a
+    decl in an already-CHANGED file (renamed by side A) and a twin with
+    the same name-free structural signature in an unchanged file that
+    sorts LAST. Under Map-last-wins the full scan's survivor is the
+    out-of-scope twin, so a scope-restricted merge without the guard
+    changes which occurrence survives the symbol join."""
+    dup = ("export function %s(a: string, b: string, c: string): "
+           "string { return a; }\n")
+    changed = base.files[0]["path"]
+    for snap, name in ((base, "dupScoped"), (left, "dupRenamed"),
+                       (right, "dupScoped")):
+        f = next(f for f in snap.files if f["path"] == changed)
+        f["content"] += dup % name
+        snap.files.append({"path": "src/zzz_twin.ts",
+                           "content": dup % "dupTwin"})
+
+
 def test_incremental_scope_fuzz_parity():
-    """The incremental invariant across varying repo sizes and both the
-    clean and DivergentRename workloads: restricting all three
-    snapshots to the changed-path union must produce identical op
-    logs, composed ops, and conflicts to the full-tree merge. (The
-    synthetic generator's edit mix is deterministic — rename/add/move/
-    delete per its fixed modular pattern; trials vary the repo size,
-    which shifts which files carry which edits, and the conflict flag.
-    Unique signatures throughout, per the scope contract — see
-    runtime/git.py merge_scope for the collision caveat.)"""
+    """The incremental invariant across varying repo sizes and the
+    clean, DivergentRename, and COLLIDING-signature workloads:
+    restricting all three snapshots to the changed-path union — with
+    the collision guard's full-scan fallback, exactly as the CLI
+    routes it — must produce identical op logs, composed ops, and
+    conflicts to the full-tree merge. (The synthetic generator's edit
+    mix is deterministic — rename/add/move/delete per its fixed
+    modular pattern; trials vary the repo size, which shifts which
+    files carry which edits, plus the conflict and collision flags.
+    Collision trials drop the unique-signature restriction: a scoped
+    symbolId gets an out-of-scope twin, the guard must fire, and the
+    un-guarded restricted merge is asserted to actually diverge — the
+    hole the guard closes.)"""
     import bench
+
+    from semantic_merge_tpu.runtime.git import (scope_symbol_collisions,
+                                                snapshot_symbol_index)
 
     host = get_backend("host")
     tpu = fused_backend()
     rng = random.Random(41)
-    for trial in range(6):
+    for trial in range(8):
         n = rng.randrange(20, 60)
-        base, left, right = bench.synth_repo(n, 3,
-                                             divergent=bool(trial % 2))
+        collide = trial >= 6
+        if collide:
+            base, left, right = bench.synth_repo_sparse(n, 3, 3)
+            _inject_scope_collision(base, left, right)
+        else:
+            base, left, right = bench.synth_repo(n, 3,
+                                                 divergent=bool(trial % 2))
         scope = bench.changed_paths(base, left, right)
+        base_r, left_r, right_r = (base.restrict(scope),
+                                   left.restrict(scope),
+                                   right.restrict(scope))
         kw = dict(base_rev="r", seed="s", timestamp="2026-01-01T00:00:00Z")
         res_f, comp_f, conf_f = run_merge(host, base, left, right, **kw)
-        res_i, comp_i, conf_i = run_merge(
-            host, base.restrict(scope),
-            left.restrict(scope), right.restrict(scope), **kw)
+        # The CLI's guard: a scoped symbolId with an out-of-scope twin
+        # forces the full-tree fallback.
+        hazard = scope_symbol_collisions(scope, snapshot_symbol_index(base),
+                                         (base_r, left_r, right_r))
+        assert hazard == collide, trial
+        if hazard:
+            # The fallback is necessary: the un-guarded restricted
+            # merge picks the wrong surviving occurrence.
+            res_bad, comp_bad, _ = run_merge(host, base_r, left_r,
+                                             right_r, **kw)
+            assert (_dicts(res_bad.op_log_left)
+                    != _dicts(res_f.op_log_left)
+                    or _dicts(comp_bad) != _dicts(comp_f)), trial
+            base_r, left_r, right_r = base, left, right
+        res_i, comp_i, conf_i = run_merge(host, base_r, left_r, right_r,
+                                          **kw)
         assert _dicts(res_i.op_log_left) == _dicts(res_f.op_log_left), trial
         assert _dicts(res_i.op_log_right) == _dicts(res_f.op_log_right), trial
         assert _dicts(comp_i) == _dicts(comp_f), trial
         assert [c.to_dict() for c in conf_i] == \
             [c.to_dict() for c in conf_f], trial
-        # And the device path on the restricted scope agrees too.
-        res_t, comp_t, conf_t = run_merge(
-            tpu, base.restrict(scope),
-            left.restrict(scope), right.restrict(scope), **kw)
+        # And the device path on the (guarded) restricted scope agrees.
+        res_t, comp_t, conf_t = run_merge(tpu, base_r, left_r, right_r,
+                                          **kw)
         assert _dicts(comp_t) == _dicts(comp_f)
         assert [c.to_dict() for c in conf_t] == [c.to_dict() for c in conf_f]
 

@@ -84,6 +84,90 @@ def test_drifted_trace_is_rejected(schema, artifacts):
     assert any("sum to count" in e for e in schema.validate_trace(broken))
 
 
+def test_apply_phase_spans_in_real_trace(schema, tmp_path):
+    """A real columnar-apply merge under ``--trace`` must record the
+    apply-layer span names BENCH and the runbook reference
+    (``apply_ops`` + ``apply_columnar``) — renaming them is schema
+    drift. The artifact must also still validate structurally."""
+    import pathlib
+    import tempfile
+
+    import bench
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    from semantic_merge_tpu.backends.base import run_merge
+    from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+    from semantic_merge_tpu.runtime.applier import apply_ops
+
+    base, left, right = bench.synth_repo(6, 2)
+    tracer = trace_mod.Tracer(enabled=True)
+    with tracer.phase("merge", backend="tpu"):
+        _, composed, _ = run_merge(TpuTSBackend(mesh=False), base, left,
+                                   right, base_rev="r", seed="s",
+                                   timestamp="2026-01-01T00:00:00Z")
+    with tracer.phase("materialize"):
+        tree = pathlib.Path(tempfile.mkdtemp())
+        for f in base.files:
+            p = tree / f["path"]
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(f["content"])
+        apply_ops(tree, composed)
+    trace = tmp_path / ".semmerge-trace.json"
+    tracer.write(trace)
+    data = json.loads(trace.read_text())
+    assert schema.validate_trace(data) == []
+    assert schema.validate_phase_coverage(
+        data, ("apply_ops", "apply_columnar")) == []
+    # Drift detection: a renamed span surfaces as a coverage error.
+    assert schema.validate_phase_coverage(data, ("apply_ops_v2",))
+
+
+def test_bench_record_validates(schema):
+    """A representative BENCH record — with the additive host-tail,
+    apply-phase, and strict-preset fields — validates; broken shapes
+    are rejected field by field."""
+    record = {
+        "metric": "files merged/sec/chip (synthetic)", "value": 123.4,
+        "unit": "files/sec", "vs_baseline": 5.1, "parity": True,
+        "phases_ms": {"scan_encode": 20.0, "kernel": 190.0,
+                      "serialize": 50.0, "compose_materialize": 12.0,
+                      "apply_plan": 11.0},
+        "host_phases_ms": {"build_and_diff": 600.0},
+        "host_tail_ms": 90.0, "device_roundtrip_ms": 0.1,
+        "overlap": {"host_workers": 8, "worker_ms": 40.0,
+                    "hidden_ms": 30.0},
+        "strict_ms": 900.0, "nonstrict_ms": 500.0,
+        "strict_conflicts": 0, "strict_motion_ops": 2,
+    }
+    assert schema.validate_bench(record) == []
+    for name in schema.APPLY_PHASE_SPANS:
+        assert schema.validate_bench(
+            {**record, "phases_ms": {name: -1.0}})
+    assert schema.validate_bench({**record, "parity": "yes"})
+    assert schema.validate_bench({**record, "overlap": {"worker_ms": 1.0}})
+    assert schema.validate_bench({**record, "strict_ms": "fast"})
+    missing = dict(record)
+    missing.pop("vs_baseline")
+    assert any("vs_baseline" in e for e in schema.validate_bench(missing))
+
+
+def test_bench_cli_flag(schema, artifacts, tmp_path):
+    bench_json = tmp_path / "bench.json"
+    bench_json.write_text(json.dumps({
+        "metric": "m", "value": 1.0, "unit": "files/sec",
+        "vs_baseline": 1.0}))
+    trace, events = artifacts
+    ok = subprocess.run([sys.executable, str(_SCRIPT), str(trace),
+                         str(events), "--bench", str(bench_json)],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    bench_json.write_text(json.dumps({"metric": "m"}))
+    fail = subprocess.run([sys.executable, str(_SCRIPT), str(trace),
+                           "--bench", str(bench_json)],
+                          capture_output=True, text=True, timeout=60)
+    assert fail.returncode == 1
+    assert "bench:" in fail.stderr
+
+
 def test_drifted_events_are_rejected(schema, artifacts):
     _, events = artifacts
     lines = events.read_text().splitlines()

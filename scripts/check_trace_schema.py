@@ -44,6 +44,20 @@ DEVICE_REQUIRED = ("jax_imported", "platform", "device_count",
 #: two — renaming them is schema drift (tests pin this).
 APPLY_PHASE_SPANS = ("apply_ops", "apply_columnar", "apply_plan")
 
+#: Meta keys every ``degradation`` span must carry (the ladder record:
+#: which rung failed, which rung the merge moved to, and the fault).
+DEGRADATION_META = ("from", "to", "fault", "stage")
+
+#: Label keys of the fault-containment metric series (cli.py ladder /
+#: backends/subproc.py supervision). Series of these names carrying
+#: other label sets are schema drift.
+FAULT_METRIC_LABELS = {
+    "merge_degradations_total": ("fault", "from", "to"),
+    "merge_faults_total": ("fault", "stage"),
+    "subprocess_retries_total": ("method",),
+    "subprocess_deadline_kills_total": ("method",),
+}
+
 #: Required keys of a BENCH JSON record (the driver contract).
 BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 
@@ -157,6 +171,39 @@ def validate_trace(data: Any) -> List[str]:
     return errors
 
 
+def validate_degradations(data: Any) -> List[str]:
+    """Validate the fault-containment records of a trace artifact:
+    every ``degradation`` span carries the full rung-transition meta
+    (``from``/``to``/``fault``/``stage``), and the fault-layer metric
+    series carry their documented label sets."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["trace: top level must be a JSON object"]
+    for i, row in enumerate(data.get("spans", [])):
+        if not isinstance(row, dict) or row.get("name") != "degradation":
+            continue
+        meta = row.get("meta")
+        if not isinstance(meta, dict):
+            errors.append(f"trace.spans[{i}]: degradation span needs meta")
+            continue
+        for key in DEGRADATION_META:
+            if not isinstance(meta.get(key), str) or not meta.get(key):
+                errors.append(f"trace.spans[{i}]: degradation meta "
+                              f"missing/empty {key!r}")
+    metrics = data.get("metrics", data)
+    counters = metrics.get("counters", {}) if isinstance(metrics, dict) else {}
+    for name, labels in FAULT_METRIC_LABELS.items():
+        m = counters.get(name)
+        if not isinstance(m, dict):
+            continue
+        for j, s in enumerate(m.get("series", [])):
+            got = tuple(sorted((s.get("labels") or {}).keys()))
+            if got != tuple(sorted(labels)):
+                errors.append(f"metrics.counters.{name}[{j}]: labels {got} "
+                              f"!= documented {tuple(sorted(labels))}")
+    return errors
+
+
 def validate_phase_coverage(data: Any, required) -> List[str]:
     """Check a trace artifact's span/phase names include ``required`` —
     the drift guard for load-bearing phase names (e.g. the apply-layer
@@ -265,7 +312,9 @@ def main(argv: List[str]) -> int:
     errors: List[str] = []
     try:
         with open(argv[0], encoding="utf-8") as fh:
-            errors.extend(validate_trace(json.load(fh)))
+            trace = json.load(fh)
+        errors.extend(validate_trace(trace))
+        errors.extend(validate_degradations(trace))
     except (OSError, json.JSONDecodeError) as exc:
         errors.append(f"trace: unreadable ({exc})")
     if len(argv) == 2:

@@ -105,12 +105,19 @@ def main() -> None:
                  base_commit, head, merge_head, "--inplace", "--git"],
                 cwd=repo_root,
             ).returncode
-            if code != 0:
-                sys.exit(code)
         except BaseException:
-            # A failed run must not latch; the next invocation retries.
+            # A crashed run must not latch; the next invocation retries.
             lock.unlink(missing_ok=True)
             raise
+        if code != 0:
+            # Engine failure: clear the latch so the NEXT driver
+            # invocation retries the full merge instead of copying back
+            # a stale resolution, and leave %A exactly as git
+            # materialized it — git's own conflict markers win. (The
+            # CLI's crash-safe --inplace commit guarantees the work
+            # tree itself is untouched on every failure exit.)
+            lock.unlink(missing_ok=True)
+            sys.exit(code)
 
     resolved = repo_root / pathname
     if resolved.exists():

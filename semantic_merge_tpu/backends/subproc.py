@@ -4,42 +4,79 @@ Speaks the newline JSON-RPC protocol of
 :mod:`semantic_merge_tpu.runtime.worker` to a child process (reference
 ``semmerge/lang/ts/bridge.py:21-47`` spawns its Node worker the same
 way). Crash isolation is the point: a dying worker raises a clean
-:class:`WorkerError` here, which the CLI's backend-fallback path turns
+:class:`WorkerError` here, which the CLI's degradation ladder turns
 into a host-engine retry instead of a corrupted merge.
+
+Supervision (the fault-containment layer):
+
+- every request carries a **deadline** (``SEMMERGE_WORKER_TIMEOUT``
+  seconds, default 120; constructor override for tests). The response
+  read happens on a reader thread; on expiry the worker's whole
+  process group is SIGKILLed — a wedged worker can never hang the
+  merge, and killing the group unblocks the reader;
+- **bounded respawn-and-resend**: idempotent methods (every protocol
+  method is a pure function of its params) retry once by default
+  (``SEMMERGE_WORKER_RETRIES``) against a freshly spawned worker, with
+  exponential backoff. Retries land in the
+  ``subprocess_retries_total{method}`` counter;
+- the worker runs in its own session (``start_new_session``) so the
+  group kill cannot take the CLI down with it.
 
 The worker command is configurable (``[engine] worker_cmd`` in
 ``.semmerge.toml``), so ANY external implementation of the protocol can
-serve a language — including a future Node worker wrapping the real
-TypeScript compiler, which would turn the golden-corpus fixtures into a
-live oracle. Default: this package's own worker over the host engine.
+serve a language. Default: this package's own worker over the host
+engine.
 """
 from __future__ import annotations
 
 import json
 import subprocess
 import sys
+import threading
+import time
 from typing import Dict, List, Optional
 
 from ..core.conflict import Conflict
 from ..core.ops import Op
+from ..errors import WorkerFault
 from ..frontend.snapshot import TS_EXTENSIONS, Snapshot
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from ..utils import faults
+from ..utils.loggingx import logger
+from ..utils.procs import env_seconds, kill_process_group
 from .base import BuildAndDiffResult, register_backend
 
 
-class WorkerError(RuntimeError):
-    """The worker died or answered with a protocol error."""
+class WorkerError(WorkerFault):
+    """The worker died, wedged past its deadline, or answered with a
+    protocol error. Subclasses :class:`~semantic_merge_tpu.errors.
+    WorkerFault`, so the CLI's degradation ladder catches it natively."""
+
+
+#: Protocol methods that are pure functions of their params — safe to
+#: resend against a respawned worker.
+IDEMPOTENT_METHODS = frozenset({"buildAndDiff", "diff", "compose", "ping"})
 
 
 class SubprocessBackend:
     name = "subprocess"
     extensions = frozenset(TS_EXTENSIONS)
 
-    def __init__(self, worker_cmd: Optional[List[str]] = None) -> None:
+    def __init__(self, worker_cmd: Optional[List[str]] = None, *,
+                 deadline: Optional[float] = None,
+                 max_retries: Optional[int] = None,
+                 retry_backoff: float = 0.05) -> None:
         self._cmd = worker_cmd or [
             sys.executable, "-m", "semantic_merge_tpu.runtime.worker",
             "--backend", "host"]
         self._proc: Optional[subprocess.Popen] = None
         self._next_id = 0
+        self._deadline = (deadline if deadline is not None
+                          else env_seconds("SEMMERGE_WORKER_TIMEOUT", 120.0))
+        self._max_retries = (max_retries if max_retries is not None
+                             else int(env_seconds("SEMMERGE_WORKER_RETRIES", 1)))
+        self._retry_backoff = retry_backoff
 
     def configure(self, config) -> None:
         cmd = getattr(config.engine, "worker_cmd", None)
@@ -59,40 +96,111 @@ class SubprocessBackend:
             pkg_root = str(pathlib.Path(__file__).resolve().parents[2])
             parts = [pkg_root, env.get("PYTHONPATH", "")]
             env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
+            # Own session: deadline expiry kills the worker's whole
+            # process group without touching the CLI's.
             self._proc = subprocess.Popen(
                 self._cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                text=True, bufsize=1, env=env)
+                text=True, bufsize=1, env=env, start_new_session=True)
         return self._proc
 
     def _call(self, method: str, params: Dict) -> Dict:
+        faults.check("worker")
+        attempts = 1
+        if method in IDEMPOTENT_METHODS and self._max_retries > 0:
+            attempts += self._max_retries
+        for attempt in range(attempts):
+            try:
+                return self._call_once(method, params)
+            except WorkerError as exc:
+                if attempt + 1 >= attempts:
+                    raise
+                obs_metrics.REGISTRY.counter(
+                    "subprocess_retries_total",
+                    "Worker requests resent after respawn, by method",
+                ).inc(1, method=method)
+                obs_spans.event("worker_retry", method=method,
+                                attempt=attempt + 1, error=str(exc))
+                logger.warning("worker %s failed (%s); respawning and "
+                               "resending (attempt %d/%d)", method, exc,
+                               attempt + 2, attempts)
+                time.sleep(self._retry_backoff * (2 ** attempt))
+        raise AssertionError("unreachable")
+
+    def _call_once(self, method: str, params: Dict) -> Dict:
         proc = self._ensure_proc()
         self._next_id += 1
         request = {"id": self._next_id, "method": method, "params": params}
         try:
             proc.stdin.write(json.dumps(request) + "\n")
             proc.stdin.flush()
-            line = proc.stdout.readline()
         except (BrokenPipeError, OSError) as exc:
             self._shutdown()
-            raise WorkerError(f"worker pipe broke during {method}: {exc}") from exc
+            raise WorkerError(f"worker pipe broke during {method}: {exc}",
+                              cause=type(exc).__name__) from exc
+        line = self._read_response_line(proc, method)
         if not line:
             code = proc.poll()
             self._shutdown()
             raise WorkerError(
-                f"worker exited (rc={code}) without answering {method}")
+                f"worker exited (rc={code}) without answering {method}",
+                cause="worker-exit")
         try:
             response = json.loads(line)
         except json.JSONDecodeError as exc:
             self._shutdown()
-            raise WorkerError(f"worker spoke non-JSON: {line[:200]!r}") from exc
+            raise WorkerError(f"worker spoke non-JSON: {line[:200]!r}",
+                              cause="protocol") from exc
         if response.get("id") != request["id"]:
             self._shutdown()
             raise WorkerError(
-                f"worker answered id {response.get('id')} to {request['id']}")
+                f"worker answered id {response.get('id')} to {request['id']}",
+                cause="protocol")
         if "error" in response:
             # The worker survived — only this request failed.
-            raise WorkerError(str(response["error"].get("message", "unknown")))
+            raise WorkerError(str(response["error"].get("message", "unknown")),
+                              cause="request-error")
         return response.get("result", {})
+
+    def _read_response_line(self, proc: subprocess.Popen, method: str) -> str:
+        """One response line, bounded by the per-request deadline.
+
+        ``readline`` blocks forever on a wedged worker, so it runs on a
+        daemon reader thread; on expiry the worker's process group is
+        killed (which also unblocks the reader via EOF) and a deadline
+        WorkerError raised."""
+        if not self._deadline or self._deadline <= 0:
+            return proc.stdout.readline()
+        box: list = []
+        done = threading.Event()
+
+        def read() -> None:
+            try:
+                box.append(proc.stdout.readline())
+            except Exception as exc:  # pipe torn down under the reader
+                box.append(exc)
+            finally:
+                done.set()
+
+        reader = threading.Thread(target=read, daemon=True,
+                                  name="semmerge-worker-read")
+        reader.start()
+        if not done.wait(self._deadline):
+            kill_process_group(proc)
+            done.wait(5.0)
+            self._shutdown()
+            obs_metrics.REGISTRY.counter(
+                "subprocess_deadline_kills_total",
+                "Workers killed for exceeding the request deadline",
+            ).inc(1, method=method)
+            raise WorkerError(
+                f"worker exceeded its {self._deadline:g}s deadline on "
+                f"{method}; process group killed", cause="deadline")
+        result = box[0] if box else ""
+        if isinstance(result, Exception):
+            self._shutdown()
+            raise WorkerError(f"worker pipe broke during {method}: {result}",
+                              cause=type(result).__name__) from result
+        return result
 
     def _shutdown(self) -> None:
         proc, self._proc = self._proc, None
@@ -102,7 +210,7 @@ class SubprocessBackend:
                     proc.stdin.close()
                     proc.wait(timeout=5)
             except Exception:
-                proc.kill()
+                kill_process_group(proc)
 
     # --- Backend protocol --------------------------------------------------
 
@@ -162,11 +270,19 @@ class SubprocessBackend:
         return composed, conflicts
 
     def close(self) -> None:
+        if self._proc is not None and self._proc.poll() is not None:
+            self._proc = None  # already dead: nothing to hand shutdown to
         if self._proc is not None:
+            # Shutdown is best-effort and must not inherit a long
+            # request deadline: give a wedged worker 5 s, then kill.
+            deadline, self._deadline = self._deadline, min(
+                self._deadline or 5.0, 5.0)
             try:
-                self._call("shutdown", {})
+                self._call_once("shutdown", {})
             except WorkerError:
                 pass
+            finally:
+                self._deadline = deadline
             self._shutdown()
 
 

@@ -7,17 +7,34 @@ with the same observable contract:
   revisions (pretty lines or JSON).
 - ``semmerge BASE A B [--inplace] [--git]`` — full 3-way semantic merge.
   Exit codes: 0 merged; 1 conflicts (written to
-  ``.semmerge-conflicts.json``); 2 type errors (diagnostics on stderr).
+  ``.semmerge-conflicts.json``); 2 type errors (diagnostics on stderr);
+  3 git plumbing failure; 10-15 a contained fault under
+  ``SEMMERGE_STRICT=1`` / ``--no-degrade`` (see ``errors.py`` and the
+  runbook's "Failure modes" table).
 
 Additions over the reference: ``--backend`` / ``--trace`` / ``--seed``
 flags, config actually loaded (backend + seed + formatter resolved from
 ``.semmerge.toml``), deterministic provenance (commit timestamps), and
 ``semrebase`` replay of a stored op log onto a new base.
+
+Fault containment — the **degradation ladder**: any
+:class:`~semantic_merge_tpu.errors.MergeFault` escaping a merge rung
+degrades the run to the next rung instead of crashing the driver:
+
+    fused/TPU (or subprocess) backend  →  host backend  →
+    whole-tree textual 3-way merge (``runtime/textmerge.py``)
+
+Each transition is recorded as a ``degradation`` span and a
+``merge_degradations_total{from,to,fault}`` counter. ``SEMMERGE_STRICT=1``
+or ``--no-degrade`` fails fast with the fault's documented exit code.
+The textual rung is the LastMerge/DeepMerge floor: never worse than
+git's own 3-way text merge.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import shutil
 import subprocess
@@ -28,6 +45,7 @@ from .backends.base import get_backend
 from .config import load_config
 from .core.compose import compose_oplogs
 from .core.ops import OpLog
+from .errors import MergeFault, fault_boundary
 from .runtime.applier import apply_ops
 from .runtime.emitter import emit_files
 from .runtime.git import commit_timestamp_iso, resolve_rev, snapshot_rev
@@ -63,11 +81,19 @@ def build_parser() -> argparse.ArgumentParser:
                              "edits (also [engine].statement_ops)")
 
     p_merge = sub.add_parser("semmerge", help="Semantic merge base A B into working tree")
-    p_merge.add_argument("base")
-    p_merge.add_argument("a")
-    p_merge.add_argument("b")
+    p_merge.add_argument("base", nargs="?", default=None)
+    p_merge.add_argument("a", nargs="?", default=None)
+    p_merge.add_argument("b", nargs="?", default=None)
     p_merge.add_argument("--inplace", action="store_true",
-                         help="Write the merge result into the current working tree")
+                         help="Write the merge result into the current working tree "
+                              "(crash-safe: staged, journaled, atomically committed)")
+    p_merge.add_argument("--no-degrade", action="store_true",
+                         help="Fail fast with the fault's documented exit code "
+                              "instead of walking the degradation ladder "
+                              "(same as SEMMERGE_STRICT=1)")
+    p_merge.add_argument("--resume", action="store_true",
+                         help="Complete (or roll back) an interrupted --inplace "
+                              "commit in the current directory, then exit")
     p_merge.add_argument("--git", action="store_true",
                          help="Flag set when invoked via git merge driver")
     p_merge.add_argument("--backend", default=None, help="Language backend (host|tpu)")
@@ -158,6 +184,11 @@ def main(argv: Sequence[str] | None = None) -> int:
         cmd = exc.cmd if isinstance(exc.cmd, str) else " ".join(map(str, exc.cmd))
         print(f"error: subprocess failed ({cmd}): exit {exc.returncode}", file=sys.stderr)
         return 3
+    except MergeFault as fault:
+        # A contained fault escaping outside the semmerge ladder
+        # (semdiff, semrebase) still exits with its documented code
+        # instead of a raw traceback.
+        return _fail_fast(fault)
     return 2
 
 
@@ -254,13 +285,111 @@ def cmd_semdiff(args: argparse.Namespace) -> int:
     return 0
 
 
+def _strict_mode(args: argparse.Namespace) -> bool:
+    """Fail-fast mode: ``--no-degrade`` or ``SEMMERGE_STRICT=1``."""
+    return (getattr(args, "no_degrade", False)
+            or os.environ.get("SEMMERGE_STRICT", "").strip() == "1")
+
+
+def _fail_fast(fault: MergeFault) -> int:
+    from .obs import metrics as obs_metrics
+    obs_metrics.REGISTRY.counter(
+        "merge_faults_total",
+        "Merge runs failed on a contained fault, by fault and stage",
+    ).inc(1, fault=type(fault).__name__, stage=fault.stage)
+    print(f"semmerge: {fault.describe()} (exit {fault.exit_code})",
+          file=sys.stderr)
+    return fault.exit_code
+
+
+def _record_degradation(frm: str, to: str, fault: MergeFault,
+                        tracer: Tracer) -> None:
+    """One ladder rung transition: log + metric + span + trace counter."""
+    from .obs import metrics as obs_metrics
+    from .obs import spans as obs_spans
+    name = type(fault).__name__
+    logger.warning("merge degrading %s -> %s after %s",
+                   frm, to, fault.describe())
+    obs_metrics.REGISTRY.counter(
+        "merge_degradations_total",
+        "Degradation-ladder rung transitions, by fault",
+    ).inc(1, **{"from": frm, "to": to, "fault": name})
+    obs_spans.record("degradation", 0.0, layer="cli",
+                     **{"from": frm, "to": to, "fault": name,
+                        "stage": fault.stage})
+    tracer.count("degradations", tracer.counters.get("degradations", 0) + 1)
+
+
 def cmd_semmerge(args: argparse.Namespace) -> int:
+    if getattr(args, "resume", False):
+        from .runtime.inplace import recover
+        action, n_writes = recover()
+        detail = f" ({n_writes} writes)" if action == "rolled-forward" else ""
+        print(f"inplace recovery: {action}{detail}")
+        return 0
+    if not (args.base and args.a and args.b):
+        print("error: semmerge requires BASE A B revisions (or --resume)",
+              file=sys.stderr)
+        return 2
     logger.info("Starting semantic merge base=%s A=%s B=%s", args.base, args.a, args.b)
+    if args.inplace:
+        # A journal/stage left by an interrupted --inplace commit is
+        # resolved before this merge touches anything.
+        from .runtime.inplace import recover
+        recover()
     tracer = Tracer(enabled=args.trace, profile_dir=args.profile)
+    try:
+        return _merge_ladder(args, tracer, strict=_strict_mode(args))
+    finally:
+        tracer.write()
+
+
+def _merge_ladder(args: argparse.Namespace, tracer: Tracer,
+                  *, strict: bool) -> int:
+    """Walk the degradation ladder: resolved backend → host backend →
+    whole-tree textual 3-way merge. Conflicts (exit 1) and type errors
+    (exit 2) are merge *results* and never degrade; only
+    :class:`MergeFault` moves the run down a rung."""
     backend, config = _resolve_backend(args.backend)
+    rung_name = getattr(backend, "name", "?")
+    host_like = rung_name in ("host", "ts_host")
+    try:
+        try:
+            return _semantic_attempt(args, config, backend, tracer)
+        finally:
+            backend.close()
+    except MergeFault as fault:
+        if strict:
+            return _fail_fast(fault)
+        _record_degradation(rung_name, "text" if host_like else "host",
+                            fault, tracer)
+    if not host_like:
+        try:
+            with fault_boundary("merge"):
+                host_backend, host_config = _resolve_backend("host")
+            try:
+                return _semantic_attempt(args, host_config, host_backend,
+                                         tracer)
+            finally:
+                host_backend.close()
+        except MergeFault as fault:
+            _record_degradation("host", "text", fault, tracer)
+    try:
+        return _textual_rung(args, tracer)
+    except MergeFault as fault:
+        # The floor itself failed: nothing left to degrade to.
+        return _fail_fast(fault)
+
+
+def _semantic_attempt(args: argparse.Namespace, config, backend,
+                      tracer: Tracer) -> int:
+    """One semantic-merge rung. Returns the merge's exit code (0/1/2);
+    raises :class:`MergeFault` when a pipeline stage fails — each CLI
+    phase runs inside a :class:`fault_boundary` that classifies
+    unexpected exceptions into the stage's typed fault."""
     merged_tree: pathlib.Path | None = None
     try:
-        with tracer.phase("snapshot"):
+        with tracer.phase("snapshot"), fault_boundary("snapshot"):
             from .runtime.git import (archive_bytes, collision_safe_scope,
                                       merge_scope, snapshot_from_bytes)
             base_tar = archive_bytes(args.base)
@@ -310,7 +439,8 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
             # entry point — on the TPU backend that is one device
             # round trip for diff + op identity + composition.
             from .backends.base import run_merge
-            with tracer.phase("merge", backend=backend.name):
+            with tracer.phase("merge", backend=backend.name), \
+                    fault_boundary("merge"):
                 result, composed, conflicts = run_merge(
                     backend, base_snap, left_snap, right_snap,
                     base_rev=base_rev, seed=seed, timestamp=timestamp,
@@ -319,13 +449,14 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
         else:
             # Strict conflict detection inspects the raw op logs between
             # diff and compose, so it needs the two-step path.
-            with tracer.phase("build_and_diff", backend=backend.name):
+            with tracer.phase("build_and_diff", backend=backend.name), \
+                    fault_boundary("merge"):
                 result = backend.build_and_diff(
                     base_snap, left_snap, right_snap,
                     base_rev=base_rev, seed=seed, timestamp=timestamp,
                     change_signature=change_sig, structured_apply=structured,
                     signature_matcher=sig_matcher, statement_ops=stmt_ops)
-            with tracer.phase("compose"):
+            with tracer.phase("compose"), fault_boundary("merge"):
                 from .core.strict_conflicts import detect_conflicts_strict
                 from .obs import spans as obs_spans
                 with obs_spans.span("strict_detect", layer="core",
@@ -348,23 +479,19 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
 
         if conflicts:
             _write_conflict_reports(conflicts)
-            tracer.write()
             return 1
         # A clean merge must not leave a stale artifact from a previous
         # conflicted run next to a success exit code.
         pathlib.Path(CONFLICTS_ARTIFACT).unlink(missing_ok=True)
 
-        with tracer.phase("materialize"):
-            from .runtime.git import extract_tree_to_temp
-            base_tree = extract_tree_to_temp(base_tar)
-            try:
+        with tracer.phase("materialize"), fault_boundary("apply"):
+            from .runtime.git import temp_tree
+            with temp_tree(base_tar) as base_tree:
                 # tpu backend: the merge's reorderImports RGA lists
                 # materialize as one batched device program.
                 merged_tree = apply_ops(
                     base_tree, composed,
                     device_crdt=getattr(backend, "device_crdt", False))
-            finally:
-                _cleanup([base_tree])
             deleted_paths: list = []
             text_written: list = []
             if config.engine.text_fallback:
@@ -379,9 +506,8 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                 tracer.count("text_conflicts", len(text_conflicts))
                 if text_conflicts:
                     _write_conflict_reports(text_conflicts)
-                    tracer.write()
                     return 1
-        with tracer.phase("format"):
+        with tracer.phase("format"), fault_boundary("format"):
             formatter = None
             ts_cfg = config.languages.get("typescript")
             if ts_cfg and ts_cfg.formatter_cmd:
@@ -408,7 +534,7 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
                     if pathlib.PurePosixPath(p).suffix.lower()
                     in PRETTIER_EXTENSIONS)
             emit_files(merged_tree, formatter, paths=touched)
-        with tracer.phase("typecheck"):
+        with tracer.phase("typecheck"), fault_boundary("verify"):
             if config.ci.require_typecheck:
                 ok, diagnostics = typecheck_ts(merged_tree)
             else:
@@ -416,25 +542,52 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
         if not ok:
             for line in diagnostics:
                 print(line, file=sys.stderr)
-            tracer.write()
             return 2
 
         if args.inplace:
-            _copy_tree_into_cwd(merged_tree)
-            for rel in deleted_paths:  # text-merge deletions propagate too
-                pathlib.Path(rel).unlink(missing_ok=True)
+            # Crash-safe publish: stage → journal → atomic renames.
+            # Text-merge deletions propagate through the same journal.
+            with fault_boundary("commit"):
+                from .runtime.inplace import commit_tree_inplace
+                commit_tree_inplace(merged_tree, deletes=deleted_paths)
 
         with tracer.phase("notes"):
             notes_put(resolve_rev(args.a), OpLog(result.op_log_left))
             notes_put(resolve_rev(args.b), OpLog(result.op_log_right))
         logger.info("Merge complete")
-        tracer.write()
         return 0
     finally:
-        backend.close()
-        tracer.close()
         if merged_tree is not None:
             _cleanup([merged_tree])
+
+
+def _textual_rung(args: argparse.Namespace, tracer: Tracer) -> int:
+    """The ladder's floor: a whole-tree textual 3-way merge — every
+    file resolves through :func:`runtime.textmerge.apply_text_fallback`
+    with an EMPTY indexed set, i.e. git-equivalent 3-way semantics for
+    the entire tree. No semantic engine, no formatter, no typecheck:
+    the guarantee is "never worse than ``git merge``", byte-for-byte."""
+    from .runtime.git import archive_bytes, temp_tree
+    from .runtime.textmerge import apply_text_fallback
+    with tracer.phase("text_merge"), fault_boundary("apply"):
+        base_tar = archive_bytes(args.base)
+        left_tar = archive_bytes(args.a)
+        right_tar = archive_bytes(args.b)
+        with temp_tree(base_tar) as merged_tree:
+            conflicts, deleted_paths, _written = apply_text_fallback(
+                merged_tree, base_tar, left_tar, right_tar,
+                indexed_extensions=frozenset())
+            tracer.count("text_conflicts", len(conflicts))
+            if conflicts:
+                _write_conflict_reports(conflicts)
+                return 1
+            pathlib.Path(CONFLICTS_ARTIFACT).unlink(missing_ok=True)
+            if args.inplace:
+                with fault_boundary("commit"):
+                    from .runtime.inplace import commit_tree_inplace
+                    commit_tree_inplace(merged_tree, deletes=deleted_paths)
+    logger.info("Merge complete (textual fallback)")
+    return 0
 
 
 def cmd_semrebase(args: argparse.Namespace) -> int:
@@ -451,7 +604,9 @@ def cmd_semrebase(args: argparse.Namespace) -> int:
         merged = apply_ops(base_tree, list(oplog))
         emit_files(merged)
         if args.inplace:
-            _copy_tree_into_cwd(merged)
+            # Same crash-safe two-phase commit as semmerge --inplace.
+            from .runtime.inplace import commit_tree_inplace
+            commit_tree_inplace(merged)
             _cleanup([merged])
         else:
             print(str(merged))
@@ -592,16 +747,6 @@ def cmd_train_matcher(args: argparse.Namespace) -> int:
                                             allow_untrained=True)
         print(json.dumps({"matcher_eval": evaluate_matcher(matcher)}))
     return 0
-
-
-def _copy_tree_into_cwd(tmp_path: pathlib.Path) -> None:
-    tmp_path = pathlib.Path(tmp_path)
-    cwd = pathlib.Path.cwd()
-    for path in tmp_path.rglob("*"):
-        if path.is_file():
-            target = cwd / path.relative_to(tmp_path)
-            target.parent.mkdir(parents=True, exist_ok=True)
-            shutil.copy2(path, target)
 
 
 def _write_conflict_reports(conflicts: Sequence[object]) -> None:

@@ -1,0 +1,170 @@
+"""Structured fault taxonomy — the pipeline-wide error contract.
+
+Any failure the merge pipeline can contain is represented by a
+:class:`MergeFault` subclass carrying the *stage* it arose in and the
+underlying *cause*. The CLI's degradation ladder (``cli.py``) catches
+``MergeFault`` at each rung boundary and either degrades to the next
+rung (fused-TPU → host → whole-tree textual merge) or — under
+``SEMMERGE_STRICT=1`` / ``--no-degrade`` — exits with the fault's
+documented exit code. LastMerge (arXiv:2507.19687) and DeepMerge
+(arXiv:2105.07569) both treat "never worse than the textual baseline"
+as the floor a structured merger must guarantee; this taxonomy is how
+every layer of this pipeline reports into that guarantee instead of
+escaping as a raw traceback.
+
+Documented exit codes (also in ``runbook.md`` "Failure modes"):
+
+====  =============================================================
+code  meaning
+====  =============================================================
+0     merged cleanly
+1     conflicts (written to ``.semmerge-conflicts.json``)
+2     type errors (diagnostics on stderr)
+3     git/subprocess plumbing failure (bad revision, missing git)
+10    ``ParseFault`` — frontend scan/parse failure
+11    ``KernelFault`` — device kernel / engine failure
+12    ``WorkerFault`` — out-of-process worker died/wedged/spoke garbage
+13    ``ApplyFault`` — tree materialization or in-place commit failure
+14    ``FormatFault`` — formatter failure escalated by fault injection
+15    ``DeadlineFault`` — a per-request deadline expired
+====  =============================================================
+
+Codes 10-15 are only ever *exit* codes in strict mode or when the
+textual rung itself fails; in the default posture they name the fault
+that triggered a ladder rung (the ``fault`` label of the
+``merge_degradations_total`` metric and ``degradation`` span).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class MergeFault(Exception):
+    """Base class for contained pipeline failures.
+
+    ``stage`` names the pipeline stage the fault arose in (``scan``,
+    ``merge``, ``apply``, …); ``cause`` is a short machine-readable
+    reason (``"deadline"``, ``"injected"``, an exception class name).
+    """
+
+    exit_code = 70
+    default_stage = "merge"
+
+    def __init__(self, message: str = "", *, stage: Optional[str] = None,
+                 cause: Optional[str] = None) -> None:
+        super().__init__(message)
+        self.stage = stage or self.default_stage
+        self.cause = cause
+
+    def describe(self) -> str:
+        parts = [f"{type(self).__name__} at {self.stage}"]
+        msg = str(self)
+        if msg:
+            parts.append(msg)
+        if self.cause:
+            parts.append(f"cause={self.cause}")
+        return ": ".join(parts)
+
+
+class ParseFault(MergeFault):
+    """Frontend scan/parse failure (``frontend/``)."""
+
+    exit_code = 10
+    default_stage = "scan"
+
+
+class KernelFault(MergeFault):
+    """Device kernel dispatch / merge-engine failure (``ops/fused.py``,
+    backend merge paths)."""
+
+    exit_code = 11
+    default_stage = "kernel"
+
+
+class WorkerFault(MergeFault):
+    """Out-of-process worker died, wedged past its deadline, or spoke
+    a broken protocol (``backends/subproc.py``)."""
+
+    exit_code = 12
+    default_stage = "worker"
+
+
+class ApplyFault(MergeFault):
+    """Tree materialization / in-place commit failure (``runtime/
+    applier.py``, ``runtime/inplace.py``)."""
+
+    exit_code = 13
+    default_stage = "apply"
+
+
+class FormatFault(MergeFault):
+    """Formatter/emitter failure escalated past the best-effort
+    posture (``runtime/emitter.py``)."""
+
+    exit_code = 14
+    default_stage = "format"
+
+
+class DeadlineFault(MergeFault):
+    """A per-request deadline expired (worker call, typecheck,
+    formatter)."""
+
+    exit_code = 15
+    default_stage = "deadline"
+
+
+#: Fault class each pipeline stage wraps *unexpected* exceptions into.
+STAGE_FAULTS = {
+    "snapshot": ParseFault,
+    "scan": ParseFault,
+    "merge": KernelFault,
+    "kernel": KernelFault,
+    "chain": KernelFault,
+    "worker": WorkerFault,
+    "worker-serve": WorkerFault,
+    "materialize": ApplyFault,
+    "apply": ApplyFault,
+    "commit": ApplyFault,
+    "format": FormatFault,
+    "emit": FormatFault,
+    "verify": DeadlineFault,
+}
+
+#: The documented fault exit codes, by class name (runbook table).
+EXIT_CODES = {cls.__name__: cls.exit_code for cls in
+              (ParseFault, KernelFault, WorkerFault, ApplyFault,
+               FormatFault, DeadlineFault)}
+
+
+def fault_for_stage(stage: str) -> type:
+    """The fault class a stage's unexpected exceptions classify into."""
+    return STAGE_FAULTS.get(stage, MergeFault)
+
+
+class fault_boundary:
+    """Context manager classifying a stage's unexpected exceptions.
+
+    A :class:`MergeFault` (raised by a deeper, better-informed layer)
+    passes through unchanged. ``subprocess.CalledProcessError`` passes
+    through too — git plumbing failures (bad revision, missing git) are
+    usage errors the ladder cannot fix, and keep their documented
+    exit 3 via the CLI's top-level handler. Everything else derived
+    from ``Exception`` is wrapped into the stage's fault class with the
+    original exception chained as ``__cause__``.
+    """
+
+    def __init__(self, stage: str) -> None:
+        self.stage = stage
+
+    def __enter__(self) -> "fault_boundary":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is None or not isinstance(exc, Exception):
+            return False
+        import subprocess
+        if isinstance(exc, (MergeFault, subprocess.CalledProcessError)):
+            return False
+        fault = fault_for_stage(self.stage)(
+            str(exc), stage=self.stage, cause=type(exc).__name__)
+        raise fault from exc

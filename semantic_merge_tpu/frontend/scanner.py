@@ -136,18 +136,29 @@ def scan_snapshot_keyed(files: Sequence[dict]
     — exactly the decl-cache key, so downstream per-file caches (e.g. the
     device backend's encoded-column cache) can reuse it. ``None`` keys
     mean "no stable identity" (cache disabled)."""
+    from ..errors import ParseFault
+    from ..utils import faults
     from .declcache import global_cache
+    faults.check("scan")
     cache = global_cache()
     hits0 = cache.hits if cache is not None else 0
     with obs_spans.span("scan", layer="frontend", files=len(files)):
-        if cache is not None:
-            keyed = _scan_snapshot_cached(files, cache)
-        else:
-            from . import native  # local import: native binds against this module
-            nodes = native.try_scan_snapshot(files)
-            if nodes is None:
-                nodes = scan_snapshot_py(files)
-            keyed = _group_unkeyed(files, nodes)
+        try:
+            if cache is not None:
+                keyed = _scan_snapshot_cached(files, cache)
+            else:
+                from . import native  # local import: native binds against this module
+                nodes = native.try_scan_snapshot(files)
+                if nodes is None:
+                    nodes = scan_snapshot_py(files)
+                keyed = _group_unkeyed(files, nodes)
+        except ParseFault:
+            raise
+        except Exception as exc:
+            # A parse/scan failure (native frontend abort, tokenizer
+            # bug) is a contained frontend fault, not a raw traceback.
+            raise ParseFault(f"snapshot scan failed: {exc}", stage="scan",
+                             cause=type(exc).__name__) from exc
     reg = obs_metrics.REGISTRY
     reg.counter("semmerge_files_scanned_total",
                 "Snapshot files handed to the decl scanner").inc(len(files))

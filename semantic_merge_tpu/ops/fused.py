@@ -234,6 +234,8 @@ class TailPlan:
                         self._timed_decode, *r)
 
     def _timed_decode(self, lo: int, hi: int):
+        from ..utils import faults
+        faults.check("chain")
         t0 = time.perf_counter()
         out = self._decode_fn(lo, hi)
         obs_spans.record("materialize_overlap", time.perf_counter() - t0,
@@ -865,6 +867,8 @@ class FusedMergeEngine:
         serialize the dispatch/fetch overlap this path exists for.
         """
         from ..core.ids import op_id_prefix_digest
+        from ..utils import faults
+        faults.check("kernel")
         detailed = obs_spans.active()
         t0 = time.perf_counter()
         hash_tab = self.strings.sync()

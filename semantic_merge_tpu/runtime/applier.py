@@ -60,6 +60,8 @@ def apply_ops(base_tree: pathlib.Path, ops: Iterable[Op],
     (:func:`semantic_merge_tpu.ops.crdt.materialize_batch`) instead of
     per-list host insert scans; output is identical (parity-tested).
     """
+    from ..utils import faults
+    faults.check("apply")
     view = _columnar_view(ops)
     counter = obs_metrics.REGISTRY.counter(
         "semmerge_ops_applied_total",

@@ -53,8 +53,17 @@ def emit_files(tree_path: pathlib.Path,
     formatter entirely. Touched paths containing glob metacharacters
     are backslash-escaped (fast-glob's literal-path escape), so
     Next.js-style routes format in place instead of degrading the whole
-    merge to tree-wide formatting."""
+    merge to tree-wide formatting.
+
+    The formatter runs under a process-group deadline
+    (``SEMMERGE_FORMAT_TIMEOUT`` seconds, default 300): a wedged
+    prettier is killed — whole process group, npx children included —
+    and logged; per [FBK-003] even a deadline never fails the merge."""
+    from ..errors import DeadlineFault
     from ..obs import spans as obs_spans
+    from ..utils import faults
+    from ..utils.procs import env_seconds, run_with_deadline
+    faults.check("emit")
     tree_path = pathlib.Path(tree_path)
     base_cmd = list(formatter_cmd) if formatter_cmd else list(DEFAULT_FORMATTER)
     if paths is not None:
@@ -66,14 +75,19 @@ def emit_files(tree_path: pathlib.Path,
     else:
         cmd = base_cmd + ["."]
         scope = -1  # whole tree
+    deadline = env_seconds("SEMMERGE_FORMAT_TIMEOUT", 300.0)
     with obs_spans.span("emit_files", layer="runtime", files=scope):
         try:
-            subprocess.run(cmd, cwd=tree_path, check=True,
-                           stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            run_with_deadline(cmd, timeout=deadline, stage="format",
+                              cwd=tree_path, check=True,
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
         except FileNotFoundError:
             logger.debug("Formatter %s not available; skipping", cmd[0])
         except subprocess.CalledProcessError as exc:
             logger.warning("Formatter exited with code %s", exc.returncode)
+        except DeadlineFault as exc:
+            logger.warning("Formatter killed: %s", exc.describe())
         except OSError as exc:
             # E2BIG on huge touched lists and friends — formatting never
             # fails a merge ([FBK-003] posture).

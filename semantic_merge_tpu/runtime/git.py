@@ -10,13 +10,15 @@ reference ``semmerge/git_api.py:23-33`` + ``semmerge/lang/ts/bridge.py:66-78``).
 """
 from __future__ import annotations
 
+import contextlib
 import io
 import pathlib
+import shutil
 import subprocess
 import tarfile
 import tempfile
 from collections import OrderedDict
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, Iterator, List
 
 from ..frontend.snapshot import SOURCE_EXTENSIONS, Snapshot
 
@@ -58,6 +60,18 @@ def extract_tree_to_temp(tar_bytes: bytes) -> pathlib.Path:
     with tarfile.open(fileobj=io.BytesIO(tar_bytes)) as tar:
         tar.extractall(tmpdir, filter="data")
     return tmpdir
+
+
+@contextlib.contextmanager
+def temp_tree(tar_bytes: bytes) -> Iterator[pathlib.Path]:
+    """:func:`extract_tree_to_temp` as a context manager: the temp tree
+    is removed on EVERY exit path — exceptions, early returns, ladder
+    degradations — not just the one ``finally`` a caller remembered."""
+    tmpdir = extract_tree_to_temp(tar_bytes)
+    try:
+        yield tmpdir
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def checkout_tree_to_temp(rev: str, cwd: pathlib.Path | None = None) -> pathlib.Path:

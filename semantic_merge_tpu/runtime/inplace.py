@@ -1,0 +1,183 @@
+"""Crash-safe ``--inplace`` apply: stage → journal → atomic commit.
+
+The old in-place path copied the merged tree file-by-file straight into
+the working tree, so a crash mid-copy (OOM-killed CLI, ctrl-C, power
+loss) left a *torn* tree — half old, half new — which the git merge
+driver would then happily publish as the merge result. This module
+makes the commit two-phase:
+
+1. **Stage**: every file of the merged tree is copied into a sibling
+   ``.semmerge-stage/`` directory inside the target root (same
+   filesystem, so the later renames are atomic). A crash here leaves
+   only a stray stage directory; the work tree is bitwise untouched.
+2. **Journal**: the intended writes and deletes are recorded in
+   ``.semmerge-journal.json`` — written to a temp name, fsynced, then
+   atomically renamed into place. The journal's existence IS the
+   commit marker: from this instant the merge is redo-able.
+3. **Commit**: each staged file is ``os.replace``d onto its target
+   (atomic per file) and each journaled delete unlinked; the journal
+   and stage directory are then removed.
+
+A process killed at ANY point leaves one of two recoverable states:
+
+- stage dir without journal → the commit never started; **rollback**
+  (remove the stage dir, work tree untouched);
+- journal present → the commit may be partial; **roll forward**
+  (replay the remaining renames/deletes — ``os.replace`` of an
+  already-moved file is skipped because its staged source is gone).
+
+:func:`recover` implements both and is invoked automatically at the
+start of every ``--inplace`` merge and explicitly by
+``semmerge --resume``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import shutil
+from typing import Iterable, List, Tuple
+
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from ..utils import faults
+from ..utils.loggingx import logger
+
+JOURNAL = ".semmerge-journal.json"
+STAGE_DIR = ".semmerge-stage"
+JOURNAL_SCHEMA = 1
+
+
+def _safe_rel(rel: str) -> pathlib.PurePosixPath:
+    """Validate a journaled relative path: inside the root, no tricks.
+    (The journal is our own artifact, but recovery must not follow a
+    corrupted or tampered one outside the work tree.)"""
+    p = pathlib.PurePosixPath(rel)
+    if p.is_absolute() or ".." in p.parts or not p.parts:
+        raise ValueError(f"journal entry escapes the work tree: {rel!r}")
+    return p
+
+
+def commit_tree_inplace(tree: pathlib.Path, deletes: Iterable[str] = (),
+                        root: pathlib.Path | None = None) -> None:
+    """Publish ``tree`` into ``root`` (default cwd) crash-safely."""
+    tree = pathlib.Path(tree)
+    root = pathlib.Path(root) if root is not None else pathlib.Path.cwd()
+    stage = root / STAGE_DIR
+    if stage.exists():
+        shutil.rmtree(stage)
+    writes: List[str] = []
+    with obs_spans.span("inplace_stage", layer="runtime"):
+        for path in sorted(tree.rglob("*")):
+            if not path.is_file():
+                continue
+            rel = path.relative_to(tree).as_posix()
+            dst = stage / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy2(path, dst)
+            writes.append(rel)
+    journal = {
+        "schema": JOURNAL_SCHEMA,
+        "state": "committing",
+        "writes": writes,
+        "deletes": sorted({pathlib.PurePosixPath(d).as_posix()
+                           for d in deletes}),
+    }
+    _write_journal(root, journal)
+    faults.check("commit")
+    with obs_spans.span("inplace_commit", layer="runtime",
+                        writes=len(writes), deletes=len(journal["deletes"])):
+        _roll_forward(root, journal)
+    obs_metrics.REGISTRY.counter(
+        "semmerge_inplace_commits_total",
+        "Crash-safe in-place commits completed").inc(1)
+
+
+def _write_journal(root: pathlib.Path, journal: dict) -> None:
+    jpath = root / JOURNAL
+    tmp = root / (JOURNAL + ".tmp")
+    payload = json.dumps(journal, indent=0)
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(payload)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, jpath)
+
+
+def _roll_forward(root: pathlib.Path, journal: dict) -> None:
+    """Replay a journal to completion: idempotent, so it serves both
+    the live commit and crash recovery."""
+    stage = root / STAGE_DIR
+    for rel in journal.get("writes", []):
+        rel_p = _safe_rel(rel)
+        src = stage / rel_p
+        if not src.is_file():
+            continue  # already committed before the interruption
+        dst = root / rel_p
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        os.replace(src, dst)
+    for rel in journal.get("deletes", []):
+        (root / _safe_rel(rel)).unlink(missing_ok=True)
+    (root / JOURNAL).unlink(missing_ok=True)
+    shutil.rmtree(stage, ignore_errors=True)
+
+
+def pending_state(root: pathlib.Path | None = None) -> str:
+    """``"none"`` | ``"committing"`` | ``"staged-only"`` — what an
+    earlier interrupted in-place commit left behind."""
+    root = pathlib.Path(root) if root is not None else pathlib.Path.cwd()
+    if (root / JOURNAL).exists():
+        return "committing"
+    if (root / STAGE_DIR).exists():
+        return "staged-only"
+    return "none"
+
+
+def recover(root: pathlib.Path | None = None) -> Tuple[str, int]:
+    """Resolve any interrupted in-place commit under ``root``.
+
+    Returns ``(action, n_writes)`` where action is ``"none"`` (nothing
+    pending), ``"rolled-forward"`` (journal replayed to completion), or
+    ``"rolled-back"`` (pre-journal stage discarded; work tree was never
+    touched). A torn/unreadable journal rolls back: the journal write
+    is atomic, so an unreadable one cannot have committed anything.
+    """
+    root = pathlib.Path(root) if root is not None else pathlib.Path.cwd()
+    jpath = root / JOURNAL
+    stage = root / STAGE_DIR
+    if jpath.exists():
+        try:
+            journal = json.loads(jpath.read_text(encoding="utf-8"))
+            if not isinstance(journal, dict):
+                raise ValueError("journal is not an object")
+        except (ValueError, OSError) as exc:
+            logger.warning("discarding unreadable in-place journal: %s", exc)
+            jpath.unlink(missing_ok=True)
+            shutil.rmtree(stage, ignore_errors=True)
+            return "rolled-back", 0
+        n = len(journal.get("writes", []))
+        logger.warning("resuming interrupted in-place commit (%d writes)", n)
+        try:
+            _roll_forward(root, journal)
+        except ValueError as exc:
+            # A journal entry escaping the work tree: refuse to act on
+            # it (the journal stays for forensics) — a contained fault
+            # with the documented ApplyFault exit, never a traversal.
+            from ..errors import ApplyFault
+            raise ApplyFault(str(exc), stage="commit",
+                             cause="journal-tampered") from exc
+        obs_metrics.REGISTRY.counter(
+            "semmerge_inplace_recoveries_total",
+            "Interrupted in-place commits resolved",
+        ).inc(1, action="rolled-forward")
+        return "rolled-forward", n
+    if stage.exists():
+        logger.warning("discarding pre-commit stage from an interrupted "
+                       "merge (work tree was never touched)")
+        shutil.rmtree(stage, ignore_errors=True)
+        obs_metrics.REGISTRY.counter(
+            "semmerge_inplace_recoveries_total",
+            "Interrupted in-place commits resolved",
+        ).inc(1, action="rolled-back")
+        return "rolled-back", 0
+    return "none", 0

@@ -91,6 +91,8 @@ def serve(backend_name: str = "host",
     exception becomes an error *response* — the worker survives."""
     from ..backends.base import get_backend
 
+    from ..utils import faults
+
     stdin = stdin if stdin is not None else sys.stdin
     stdout = stdout if stdout is not None else sys.stdout
     backend = get_backend(backend_name)
@@ -98,6 +100,14 @@ def serve(backend_name: str = "host",
         for line in stdin:
             line = line.strip()
             if not line:
+                continue
+            # Supervision-test seam: SEMMERGE_FAULT=worker-serve:KIND
+            # makes THIS process wedge (hang), die (exit/kill), or
+            # answer garbage — the client's deadline/respawn logic is
+            # exercised against a real misbehaving worker.
+            if faults.check("worker-serve") == "garbage":
+                stdout.write("this is not json\n")
+                stdout.flush()
                 continue
             req_id = None
             try:

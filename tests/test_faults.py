@@ -1,0 +1,434 @@
+"""Fault-containment matrix (ISSUE 4 tentpole).
+
+For every injectable stage×kind pair (``SEMMERGE_FAULT``), the merge
+must either:
+
+- land on the documented degradation-ladder rung — ultimately the
+  whole-tree textual 3-way merge, whose result must be **byte-exact**
+  against an independently computed textual merge of the same three
+  trees — or,
+- under ``SEMMERGE_STRICT=1`` / ``--no-degrade``, exit with the fault's
+  documented exit code with the work tree **bitwise untouched**.
+
+Plus: crash-safe ``--inplace`` commit (journal/rollback/roll-forward,
+including a real SIGKILL mid-commit resolved by ``semmerge --resume``),
+and schema validation of the ``degradation`` spans / fault metric
+series via ``scripts/check_trace_schema.py``.
+"""
+import hashlib
+import importlib.util
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from semantic_merge_tpu.cli import main
+from semantic_merge_tpu.errors import (ApplyFault, EXIT_CODES, FormatFault,
+                                       ParseFault, WorkerFault)
+from semantic_merge_tpu.obs import metrics as obs_metrics
+from semantic_merge_tpu.runtime import inplace
+from semantic_merge_tpu.utils import faults
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+#: Artifacts the engine itself writes next to the work tree — excluded
+#: from tree-state comparisons.
+ARTIFACTS = {".semmerge-conflicts.json", ".semmerge-trace.json",
+             ".semmerge-events.jsonl", ".semmerge-journal.json"}
+
+
+def git(args, cwd):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def commit_all(root, msg):
+    git(["add", "-A"], root)
+    env = {"GIT_AUTHOR_DATE": "2024-01-01T00:00:00Z",
+           "GIT_COMMITTER_DATE": "2024-01-01T00:00:00Z"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        git(["commit", "-q", "-m", msg], root)
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+
+
+@pytest.fixture
+def repo(tmp_path, monkeypatch):
+    """A repo whose SEMANTIC merge result equals its TEXTUAL merge
+    result (A's edits and B's adds touch disjoint files), so every
+    ladder rung must converge on the same bytes."""
+    root = tmp_path / "repo"
+    root.mkdir()
+    git(["init", "-q", "-b", "main"], root)
+    git(["config", "user.email", "t@example.com"], root)
+    git(["config", "user.name", "t"], root)
+    monkeypatch.chdir(root)
+    (root / "src").mkdir()
+    (root / "src/util.ts").write_text(
+        "export function foo(n: number): number {\n  return n;\n}\n")
+    (root / "notes.txt").write_text("hello\n")
+    commit_all(root, "base")
+    git(["branch", "basebr"], root)
+    git(["checkout", "-qb", "brA"], root)
+    (root / "src/util.ts").write_text(
+        "export function bar(n: number): number {\n  return n;\n}\n")
+    commit_all(root, "rename foo->bar")
+    git(["checkout", "-q", "main"], root)
+    git(["checkout", "-qb", "brB"], root)
+    (root / "extra.ts").write_text(
+        "export function extra(s: string): string { return s; }\n")
+    (root / "notes.txt").write_text("hello\nworld\n")
+    commit_all(root, "add extra + edit notes")
+    git(["checkout", "-q", "main"], root)
+    faults.reset()
+    yield root
+    faults.reset()
+
+
+def tree_state(root: pathlib.Path) -> dict:
+    """``{relpath: sha256}`` of every tracked-tree file (skips .git and
+    engine artifacts)."""
+    out = {}
+    for p in sorted(root.rglob("*")):
+        if not p.is_file():
+            continue
+        rel = p.relative_to(root).as_posix()
+        if rel.startswith(".git/") or rel.split("/")[0] in ARTIFACTS \
+                or rel.startswith(inplace.STAGE_DIR + "/"):
+            continue
+        out[rel] = hashlib.sha256(p.read_bytes()).hexdigest()
+    return out
+
+
+def expected_textual_tree(root: pathlib.Path) -> dict:
+    """Independent oracle: the whole-tree 3-way textual merge of
+    basebr/brA/brB, computed straight from the tars."""
+    from semantic_merge_tpu.runtime.git import archive_bytes, temp_tree
+    from semantic_merge_tpu.runtime.textmerge import apply_text_fallback
+    base = archive_bytes("basebr", cwd=root)
+    left = archive_bytes("brA", cwd=root)
+    right = archive_bytes("brB", cwd=root)
+    with temp_tree(base) as tree:
+        conflicts, deleted, _ = apply_text_fallback(
+            tree, base, left, right, indexed_extensions=frozenset())
+        assert not conflicts and not deleted
+        return {p.relative_to(tree).as_posix():
+                hashlib.sha256(p.read_bytes()).hexdigest()
+                for p in sorted(tree.rglob("*")) if p.is_file()}
+
+
+def counter_total(name: str) -> float:
+    data = obs_metrics.REGISTRY.to_dict()
+    metric = data.get("counters", {}).get(name, {})
+    return sum(s["value"] for s in metric.get("series", []))
+
+
+def run_merge_cli(*extra, backend="host"):
+    return main(["semmerge", "basebr", "brA", "brB",
+                 "--inplace", "--backend", backend, *extra])
+
+
+# ---------------------------------------------------------------------------
+# Degradation-ladder matrix (default posture)
+# ---------------------------------------------------------------------------
+
+LADDER_MATRIX = [
+    # (stage, kind, backend) — every case must exit 0 with the
+    # byte-exact textual-equivalent tree and record >=1 degradation.
+    ("scan", "fault", "host"),
+    ("scan", "raise", "host"),
+    ("apply", "fault", "host"),
+    ("apply", "raise", "host"),
+    ("emit", "fault", "host"),
+    ("worker", "fault", "subprocess"),
+]
+
+
+@pytest.mark.parametrize("stage,kind,backend", LADDER_MATRIX)
+def test_fault_degrades_to_byte_exact_textual_merge(repo, monkeypatch,
+                                                    stage, kind, backend):
+    expected = expected_textual_tree(repo)
+    monkeypatch.setenv("SEMMERGE_FAULT", f"{stage}:{kind}")
+    degr0 = counter_total("merge_degradations_total")
+    rc = run_merge_cli(backend=backend)
+    assert rc == 0, f"{stage}:{kind} must land on a working rung"
+    assert tree_state(repo) == expected, \
+        f"{stage}:{kind} result must be byte-exact vs the textual merge"
+    assert counter_total("merge_degradations_total") > degr0, \
+        "the ladder transition must be recorded"
+    assert not (repo / inplace.JOURNAL).exists()
+    assert not (repo / inplace.STAGE_DIR).exists()
+
+
+@pytest.mark.parametrize("stage,kind", [("kernel", "fault"),
+                                        ("chain", "fault")])
+def test_device_stage_faults_degrade(repo, monkeypatch, stage, kind):
+    pytest.importorskip("jax")
+    try:
+        from semantic_merge_tpu.backends.base import get_backend
+        get_backend("tpu").close()
+    except Exception:
+        pytest.skip("tpu backend unavailable in this environment")
+    expected = expected_textual_tree(repo)
+    monkeypatch.setenv("SEMMERGE_FAULT", f"{stage}:{kind}")
+    degr0 = counter_total("merge_degradations_total")
+    rc = run_merge_cli(backend="tpu")
+    assert rc == 0
+    assert tree_state(repo) == expected
+    assert counter_total("merge_degradations_total") > degr0
+
+
+# ---------------------------------------------------------------------------
+# Strict mode: documented exit code, work tree bitwise untouched
+# ---------------------------------------------------------------------------
+
+STRICT_MATRIX = [
+    ("scan", "fault", "host", ParseFault.exit_code),
+    ("apply", "fault", "host", ApplyFault.exit_code),
+    ("apply", "raise", "host", ApplyFault.exit_code),  # boundary classifies
+    ("emit", "fault", "host", FormatFault.exit_code),
+    ("worker", "fault", "subprocess", WorkerFault.exit_code),
+]
+
+
+@pytest.mark.parametrize("stage,kind,backend,code", STRICT_MATRIX)
+def test_strict_mode_exits_with_documented_code(repo, monkeypatch,
+                                                stage, kind, backend, code):
+    before = tree_state(repo)
+    monkeypatch.setenv("SEMMERGE_FAULT", f"{stage}:{kind}")
+    monkeypatch.setenv("SEMMERGE_STRICT", "1")
+    rc = run_merge_cli(backend=backend)
+    assert rc == code, f"{stage}:{kind} must exit {code} in strict mode"
+    assert tree_state(repo) == before, \
+        "a strict failure exit must leave the work tree bitwise untouched"
+
+
+def test_no_degrade_flag_equals_strict_env(repo, monkeypatch):
+    before = tree_state(repo)
+    monkeypatch.setenv("SEMMERGE_FAULT", "apply:fault")
+    rc = run_merge_cli("--no-degrade")
+    assert rc == ApplyFault.exit_code
+    assert tree_state(repo) == before
+
+
+def test_exit_codes_documented_and_distinct():
+    assert EXIT_CODES == {"ParseFault": 10, "KernelFault": 11,
+                          "WorkerFault": 12, "ApplyFault": 13,
+                          "FormatFault": 14, "DeadlineFault": 15}
+    assert len(set(EXIT_CODES.values())) == len(EXIT_CODES)
+    # Reserved result codes stay distinct from fault codes.
+    assert not {0, 1, 2, 3} & set(EXIT_CODES.values())
+
+
+def test_nth_hit_selector(monkeypatch):
+    faults.reset()
+    monkeypatch.setenv("SEMMERGE_FAULT", "apply:raise:2")
+    assert faults.check("apply") is None  # first hit passes
+    with pytest.raises(RuntimeError):
+        faults.check("apply")  # second hit fires
+    assert faults.check("apply") is None  # third passes again
+
+
+# ---------------------------------------------------------------------------
+# No fault injected: clean merge, no degradations recorded
+# ---------------------------------------------------------------------------
+
+def test_clean_merge_records_no_degradation(repo, monkeypatch):
+    monkeypatch.delenv("SEMMERGE_FAULT", raising=False)
+    degr0 = counter_total("merge_degradations_total")
+    rc = run_merge_cli()
+    assert rc == 0
+    assert counter_total("merge_degradations_total") == degr0
+    assert "bar" in (repo / "src/util.ts").read_text()
+    assert (repo / "extra.ts").exists()
+
+
+# ---------------------------------------------------------------------------
+# Trace artifact: degradation spans + fault metric series validate
+# ---------------------------------------------------------------------------
+
+def _schema_module():
+    script = REPO_ROOT / "scripts" / "check_trace_schema.py"
+    spec = importlib.util.spec_from_file_location("cts_faults", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_degraded_trace_validates_against_schema(repo, monkeypatch):
+    monkeypatch.setenv("SEMMERGE_FAULT", "apply:fault")
+    rc = run_merge_cli("--trace")
+    assert rc == 0
+    trace = json.loads((repo / ".semmerge-trace.json").read_text())
+    degr = [s for s in trace["spans"] if s["name"] == "degradation"]
+    assert degr, "a degraded --trace run must record degradation spans"
+    assert degr[0]["meta"]["to"] == "text"
+    assert degr[0]["meta"]["fault"] == "ApplyFault"
+    schema = _schema_module()
+    assert schema.validate_trace(trace) == []
+    assert schema.validate_degradations(trace) == []
+
+
+def test_schema_rejects_malformed_degradation_records():
+    schema = _schema_module()
+    bad_span = {"schema": 1, "phases": [], "counters": {},
+                "total_seconds": 0.0, "device": None,
+                "spans": [{"name": "degradation", "t_start": 0.0,
+                           "seconds": 0.0, "depth": 0, "span_id": 1,
+                           "parent_id": -1, "thread": "t", "status": "ok",
+                           "error": None, "meta": {"from": "tpu"}}]}
+    assert any("degradation" in e for e in
+               schema.validate_degradations(bad_span))
+    bad_labels = {"metrics": {"counters": {"merge_degradations_total": {
+        "series": [{"labels": {"oops": "x"}, "value": 1}]}}}}
+    assert any("merge_degradations_total" in e for e in
+               schema.validate_degradations(bad_labels))
+
+
+# ---------------------------------------------------------------------------
+# verify.typecheck_ts: toolchain-vs-type-failure distinction + deadline
+# ---------------------------------------------------------------------------
+
+def _fake_npx(tmp_path, monkeypatch, body: str):
+    """Install a fake ``npx`` at the front of PATH."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir(exist_ok=True)
+    npx = bindir / "npx"
+    npx.write_text("#!/bin/sh\n" + body)
+    npx.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    return tmp_path
+
+
+def test_typecheck_npx_without_tsc_passes_vacuously(tmp_path, monkeypatch):
+    """npx present but tsc uninstalled: npx prints its own error and
+    exits nonzero — the documented vacuous pass, NOT exit-2."""
+    from semantic_merge_tpu.runtime.verify import typecheck_ts
+    _fake_npx(tmp_path, monkeypatch,
+              'echo "npm error could not determine executable to run"\n'
+              "exit 1\n")
+    ok, diags = typecheck_ts(tmp_path)
+    assert ok is True and diags == []
+
+
+def test_typecheck_real_type_error_still_fails(tmp_path, monkeypatch):
+    from semantic_merge_tpu.runtime.verify import typecheck_ts
+    _fake_npx(tmp_path, monkeypatch,
+              "echo \"a.ts(1,1): error TS2304: Cannot find name 'x'.\"\n"
+              "exit 2\n")
+    ok, diags = typecheck_ts(tmp_path)
+    assert ok is False
+    assert any("error TS2304" in line for line in diags)
+
+
+def test_typecheck_clean_pass(tmp_path, monkeypatch):
+    from semantic_merge_tpu.runtime.verify import typecheck_ts
+    _fake_npx(tmp_path, monkeypatch, "exit 0\n")
+    assert typecheck_ts(tmp_path) == (True, [])
+
+
+def test_typecheck_deadline_raises_deadline_fault(tmp_path, monkeypatch):
+    from semantic_merge_tpu.errors import DeadlineFault
+    from semantic_merge_tpu.runtime.verify import typecheck_ts
+    _fake_npx(tmp_path, monkeypatch, "sleep 30\n")
+    with pytest.raises(DeadlineFault) as exc_info:
+        typecheck_ts(tmp_path, deadline=0.5)
+    assert exc_info.value.stage == "verify"
+    assert exc_info.value.exit_code == 15
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe --inplace commit
+# ---------------------------------------------------------------------------
+
+def test_sigkill_during_commit_resumes_consistently(repo):
+    """A real SIGKILL between the journal write and the renames: the
+    work tree is recoverable, and ``semmerge --resume`` rolls the
+    commit forward to the exact merge result."""
+    expected = expected_textual_tree(repo)  # == semantic result by design
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEMMERGE_FAULT"] = "commit:kill"
+    proc = subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+         "basebr", "brA", "brB", "--inplace", "--backend", "host"],
+        cwd=repo, env=env, capture_output=True)
+    assert proc.returncode == -signal.SIGKILL
+    assert (repo / inplace.JOURNAL).exists(), \
+        "the intent journal must survive the kill"
+    rc = main(["semmerge", "--resume"])
+    assert rc == 0
+    assert tree_state(repo) == expected
+    assert not (repo / inplace.JOURNAL).exists()
+    assert not (repo / inplace.STAGE_DIR).exists()
+
+
+def test_partial_commit_rolls_forward(tmp_path):
+    """A commit interrupted halfway through its renames (journal
+    present, some staged files already moved) completes idempotently."""
+    root = tmp_path / "wt"
+    stage = root / inplace.STAGE_DIR
+    (stage / "dir").mkdir(parents=True)
+    (stage / "dir/b.txt").write_text("new-b")
+    (root / "a.txt").write_text("new-a")  # 'a' already committed
+    (root / "gone.txt").write_text("stale")
+    journal = {"schema": 1, "state": "committing",
+               "writes": ["a.txt", "dir/b.txt"], "deletes": ["gone.txt"]}
+    (root / inplace.JOURNAL).write_text(json.dumps(journal))
+    action, n = inplace.recover(root)
+    assert action == "rolled-forward" and n == 2
+    assert (root / "a.txt").read_text() == "new-a"
+    assert (root / "dir/b.txt").read_text() == "new-b"
+    assert not (root / "gone.txt").exists()
+    assert not (root / inplace.JOURNAL).exists()
+    assert not stage.exists()
+
+
+def test_pre_journal_stage_rolls_back(tmp_path):
+    root = tmp_path / "wt"
+    stage = root / inplace.STAGE_DIR
+    stage.mkdir(parents=True)
+    (stage / "x.txt").write_text("staged-but-never-journaled")
+    (root / "keep.txt").write_text("old")
+    action, _ = inplace.recover(root)
+    assert action == "rolled-back"
+    assert (root / "keep.txt").read_text() == "old"
+    assert not stage.exists()
+
+
+def test_tampered_journal_cannot_escape_work_tree(tmp_path):
+    root = tmp_path / "wt"
+    root.mkdir()
+    outside = tmp_path / "victim.txt"
+    outside.write_text("precious")
+    (root / inplace.JOURNAL).write_text(json.dumps(
+        {"schema": 1, "state": "committing", "writes": [],
+         "deletes": ["../victim.txt"]}))
+    with pytest.raises(ApplyFault):
+        inplace.recover(root)
+    assert outside.read_text() == "precious"
+    assert (root / inplace.JOURNAL).exists(), "refused journal is kept"
+
+
+def test_next_inplace_merge_auto_recovers(repo):
+    """An interrupted commit's journal is resolved automatically at the
+    start of the next --inplace merge — no manual --resume needed."""
+    stage = repo / inplace.STAGE_DIR
+    stage.mkdir()
+    (stage / "leftover.txt").write_text("from an interrupted run")
+    (repo / inplace.JOURNAL).write_text(json.dumps(
+        {"schema": 1, "state": "committing",
+         "writes": ["leftover.txt"], "deletes": []}))
+    rc = run_merge_cli()
+    assert rc == 0
+    assert (repo / "leftover.txt").read_text() == "from an interrupted run"
+    assert not (repo / inplace.JOURNAL).exists()
+    assert "bar" in (repo / "src/util.ts").read_text()

@@ -123,6 +123,40 @@ def test_stale_lock_recovery(driver_repo):
     assert lock.stat().st_mtime > old + 3600, "latch must be refreshed"
 
 
+def test_engine_failure_leaves_file_as_git_materialized_it(driver_repo):
+    """CLI failure inside the driver: %A must be left exactly as git
+    materialized it (ours — so git's own conflict handling wins), the
+    file stays unmerged, and the latch is cleared so the NEXT driver
+    invocation retries the full merge instead of copying back a stale
+    resolution."""
+    repo, env = driver_repo
+    make_branches(repo)
+    ours = (repo / "a.ts").read_bytes()  # HEAD content git hands as %A
+    env = dict(env)
+    env["SEMMERGE_FAULT"] = "apply:fault"
+    env["SEMMERGE_STRICT"] = "1"
+    proc = git(["merge", "--no-ff", "branch-b", "-m", "x"], repo,
+               check=False, env=env)
+    assert proc.returncode != 0, "an engine fault must not auto-merge"
+    assert (repo / "a.ts").read_bytes() == ours, \
+        "%A must be byte-identical to what git materialized"
+    status = git(["status", "--porcelain"], repo).stdout
+    assert any(line.startswith("UU") or line.startswith("AA")
+               for line in status.splitlines()), \
+        "the file must stay unmerged for the user to resolve"
+    assert not (repo / ".git" / ".semmerge.lock").exists(), \
+        "a failed run must clear the latch so the next invocation retries"
+    # And the retry (fault removed) succeeds from the clean state.
+    git(["merge", "--abort"], repo)
+    env.pop("SEMMERGE_FAULT")
+    env.pop("SEMMERGE_STRICT")
+    proc = git(["merge", "--no-ff", "branch-b", "-m", "retry"], repo,
+               check=False, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    merged = (repo / "a.ts").read_text()
+    assert "salute" in merged and "added" in merged
+
+
 def test_divergent_rename_surfaces_conflict(driver_repo):
     repo, env = driver_repo
     git(["checkout", "-qb", "conf-a"], repo)

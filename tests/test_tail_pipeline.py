@@ -137,6 +137,31 @@ def test_pipelined_matches_host_oracle(monkeypatch):
     assert got[3] == [c.to_dict() for c in conf_h]
 
 
+def test_chain_decode_fault_surfaces_typed_not_hung(monkeypatch):
+    """A fault injected into the pipelined chain decode (worker thread)
+    must surface to the consumer as a typed KernelFault — the pool must
+    not swallow it or wedge the shard walk (tentpole: chain-decode
+    injection point feeding the degradation ladder)."""
+    from semantic_merge_tpu.errors import KernelFault
+    from semantic_merge_tpu.ops.fused import TailPipeline, TailPlan
+    from semantic_merge_tpu.utils import faults
+    faults.reset()
+    monkeypatch.setenv("SEMMERGE_FAULT", "chain:fault")
+    plan = TailPlan(TailPipeline(workers=2, shard_rows=4), 10,
+                    lambda lo, hi: ([], [], []))
+    plan.prefetch()
+    with pytest.raises(KernelFault) as exc_info:
+        plan.decode_all()
+    assert exc_info.value.stage == "chain"
+    faults.reset()
+    monkeypatch.delenv("SEMMERGE_FAULT")
+    # A fresh plan over the same pipeline still works (no poisoning).
+    plan2 = TailPlan(TailPipeline(workers=2, shard_rows=4), 10,
+                     lambda lo, hi: (list(range(lo, hi)), [], []))
+    addr, _, _ = plan2.decode_all()
+    assert addr == list(range(10))
+
+
 def test_shard_ranges_contract():
     assert shard_ranges(0, 8) == []
     assert shard_ranges(1, 8) == [(0, 1)]

@@ -47,6 +47,31 @@ def test_real_artifacts_validate(schema, artifacts):
     assert schema.validate_events(events.read_text().splitlines()) == []
 
 
+def test_degradation_records_validate(schema, tmp_path):
+    """A trace carrying the fault-containment layer's records — a
+    ``degradation`` span (cli.py ladder) and the fault metric series —
+    must validate; the span/labels are part of the documented schema."""
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    tracer = trace_mod.Tracer(enabled=True)
+    with tracer.phase("merge", backend="tpu"):
+        obs_spans.record("degradation", 0.0, layer="cli",
+                         **{"from": "tpu", "to": "host",
+                            "fault": "KernelFault", "stage": "kernel"})
+    obs_metrics.REGISTRY.counter(
+        "merge_degradations_total", "t").inc(
+        1, **{"from": "tpu", "to": "host", "fault": "KernelFault"})
+    obs_metrics.REGISTRY.counter(
+        "subprocess_retries_total", "t").inc(1, method="diff")
+    trace = tmp_path / ".semmerge-trace.json"
+    tracer.write(trace)
+    data = json.loads(trace.read_text())
+    assert schema.validate_trace(data) == []
+    assert schema.validate_degradations(data) == []
+    names = {s["name"] for s in data["spans"]}
+    assert "degradation" in names
+
+
 def test_script_cli_exit_codes(artifacts):
     trace, events = artifacts
     ok = subprocess.run([sys.executable, str(_SCRIPT), str(trace),

@@ -121,6 +121,97 @@ def test_external_program_can_implement_the_protocol(tmp_path):
         backend.close()
 
 
+def counter_total(name):
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    metric = obs_metrics.REGISTRY.to_dict().get("counters", {}).get(name, {})
+    return sum(s["value"] for s in metric.get("series", []))
+
+
+def test_wedged_worker_hits_deadline_not_a_hang(monkeypatch):
+    """A worker that sleeps past the request deadline: the call must
+    return in bounded time as a WorkerFault (process-group killed),
+    with the deadline kill and the bounded respawn retry observable in
+    metrics — never a hang on readline()."""
+    import time as _time
+    from semantic_merge_tpu.errors import WorkerFault
+    from semantic_merge_tpu.utils import faults
+    monkeypatch.setenv("SEMMERGE_FAULT", "worker-serve:hang=60")
+    faults.reset()
+    kills0 = counter_total("subprocess_deadline_kills_total")
+    retries0 = counter_total("subprocess_retries_total")
+    b = SubprocessBackend(deadline=0.75, max_retries=1)
+    t0 = _time.monotonic()
+    try:
+        with pytest.raises(WorkerError) as exc_info:
+            b.diff(BASE, LEFT, base_rev="r", seed="s")
+    finally:
+        b.close()
+    elapsed = _time.monotonic() - t0
+    assert elapsed < 30, f"wedged worker must be bounded, took {elapsed:.1f}s"
+    assert isinstance(exc_info.value, WorkerFault)
+    assert exc_info.value.cause == "deadline"
+    assert counter_total("subprocess_deadline_kills_total") >= kills0 + 2, \
+        "both the first attempt and the respawned resend must be killed"
+    assert counter_total("subprocess_retries_total") == retries0 + 1, \
+        "exactly one bounded respawn-and-resend"
+
+
+def test_garbage_speaking_worker_faults_cleanly(monkeypatch):
+    from semantic_merge_tpu.errors import WorkerFault
+    from semantic_merge_tpu.utils import faults
+    monkeypatch.setenv("SEMMERGE_FAULT", "worker-serve:garbage")
+    faults.reset()
+    b = SubprocessBackend(max_retries=1)
+    try:
+        with pytest.raises(WorkerError) as exc_info:
+            b.diff(BASE, LEFT, base_rev="r", seed="s")
+    finally:
+        b.close()
+    assert isinstance(exc_info.value, WorkerFault)
+    assert exc_info.value.cause == "protocol"
+
+
+def test_respawn_and_resend_recovers_transparently(tmp_path):
+    """A worker that dies before answering its first request, once: the
+    supervised call respawns, resends, and succeeds — the caller never
+    sees the failure."""
+    flag = tmp_path / "died-once"
+    wrapper = tmp_path / "flaky_worker.py"
+    wrapper.write_text(textwrap.dedent(f"""
+        import os, runpy, sys
+        flag = {str(flag)!r}
+        if not os.path.exists(flag):
+            open(flag, "w").close()
+            sys.stdin.readline()  # swallow the request, die unanswered
+            sys.exit(9)
+        sys.argv = ["worker", "--backend", "host"]
+        runpy.run_module("semantic_merge_tpu.runtime.worker",
+                         run_name="__main__")
+    """))
+    retries0 = counter_total("subprocess_retries_total")
+    b = SubprocessBackend(worker_cmd=[sys.executable, str(wrapper)],
+                          max_retries=1)
+    host = get_backend("host")
+    try:
+        ops = b.diff(BASE, LEFT, base_rev="r", seed="s")
+        expected = host.diff(BASE, LEFT, base_rev="r", seed="s")
+    finally:
+        b.close()
+        host.close()
+    assert [o.to_dict() for o in ops] == [o.to_dict() for o in expected]
+    assert flag.exists(), "the first worker must really have died"
+    assert counter_total("subprocess_retries_total") == retries0 + 1
+
+
+def test_worker_error_is_a_merge_fault():
+    # The ladder catches MergeFault; WorkerError must be inside that
+    # taxonomy or a dead worker would escape as a raw traceback.
+    from semantic_merge_tpu.errors import MergeFault, WorkerFault
+    assert issubclass(WorkerError, WorkerFault)
+    assert issubclass(WorkerError, MergeFault)
+    assert WorkerError("x").exit_code == 12
+
+
 def test_config_selects_worker_cmd(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     (tmp_path / ".semmerge.toml").write_text(

@@ -58,6 +58,22 @@ FAULT_METRIC_LABELS = {
     "subprocess_deadline_kills_total": ("method",),
 }
 
+#: Meta keys every ``service.*`` span must carry (which verb the
+#: request was for — the daemon's per-request span contract).
+SERVICE_SPAN_META = ("verb",)
+
+#: Span names of the service layer (service/daemon.py request path).
+SERVICE_SPANS = ("service.accept", "service.queue_wait", "service.execute")
+
+#: Label keys of the service-layer metric series. Series of these
+#: names carrying other label sets are schema drift.
+SERVICE_METRIC_LABELS = {
+    "service_requests_total": ("outcome", "verb"),
+    "declcache_hits_total": (),
+    "declcache_misses_total": (),
+    "declcache_evictions_total": (),
+}
+
 #: Required keys of a BENCH JSON record (the driver contract).
 BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 
@@ -67,6 +83,8 @@ BENCH_NUMERIC_OPTIONAL = (
     "host_tail_ms", "device_roundtrip_ms", "incremental_ms",
     "full_scan_device_ms", "full_scan_host_ms", "vs_full_scan_device",
     "strict_ms", "nonstrict_ms", "strict_conflicts", "strict_motion_ops",
+    "cold_ms", "warm_ms", "warm_speedup", "declcache_hit_rate",
+    "daemon_rss_mb",
 )
 
 
@@ -204,6 +222,58 @@ def validate_degradations(data: Any) -> List[str]:
     return errors
 
 
+def validate_service(data: Any) -> List[str]:
+    """Validate the merge-service records of a trace/events-shaped
+    artifact (or a daemon status payload's ``metrics`` block): every
+    ``service.*`` span carries its per-request meta (``verb``), the
+    service metric series carry their documented label sets, and
+    ``service_queue_depth`` — when present — is a plain gauge."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["service: top level must be a JSON object"]
+    for i, row in enumerate(data.get("spans", [])):
+        if not isinstance(row, dict):
+            continue
+        name = row.get("name")
+        if not isinstance(name, str) or not name.startswith("service."):
+            continue
+        if name not in SERVICE_SPANS:
+            errors.append(f"trace.spans[{i}]: unknown service span {name!r}")
+        meta = row.get("meta")
+        if not isinstance(meta, dict):
+            errors.append(f"trace.spans[{i}]: service span needs meta")
+            continue
+        for key in SERVICE_SPAN_META:
+            if not isinstance(meta.get(key), str) or not meta.get(key):
+                errors.append(f"trace.spans[{i}]: service span meta "
+                              f"missing/empty {key!r}")
+    metrics = data.get("metrics", data)
+    if not isinstance(metrics, dict):
+        return errors
+    counters = metrics.get("counters", {})
+    for name, labels in SERVICE_METRIC_LABELS.items():
+        m = counters.get(name) if isinstance(counters, dict) else None
+        if not isinstance(m, dict):
+            continue
+        for j, s in enumerate(m.get("series", [])):
+            got = tuple(sorted((s.get("labels") or {}).keys()))
+            if got != tuple(sorted(labels)):
+                errors.append(f"metrics.counters.{name}[{j}]: labels {got} "
+                              f"!= documented {tuple(sorted(labels))}")
+    gauges = metrics.get("gauges", {})
+    depth = gauges.get("service_queue_depth") if isinstance(gauges, dict) \
+        else None
+    if isinstance(depth, dict):
+        for j, s in enumerate(depth.get("series", [])):
+            if (s.get("labels") or {}) != {}:
+                errors.append(f"metrics.gauges.service_queue_depth[{j}]: "
+                              f"must carry no labels")
+            if not _is_num(s.get("value")) or s.get("value") < 0:
+                errors.append(f"metrics.gauges.service_queue_depth[{j}]: "
+                              f"value must be a number >= 0")
+    return errors
+
+
 def validate_phase_coverage(data: Any, required) -> List[str]:
     """Check a trace artifact's span/phase names include ``required`` —
     the drift guard for load-bearing phase names (e.g. the apply-layer
@@ -315,6 +385,7 @@ def main(argv: List[str]) -> int:
             trace = json.load(fh)
         errors.extend(validate_trace(trace))
         errors.extend(validate_degradations(trace))
+        errors.extend(validate_service(trace))
     except (OSError, json.JSONDecodeError) as exc:
         errors.append(f"trace: unreadable ({exc})")
     if len(argv) == 2:

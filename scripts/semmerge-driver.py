@@ -99,11 +99,18 @@ def main() -> None:
     )
     if not same_merge:
         lock.write_text(f"{head} {merge_head}")
+        # Warm path by default: repeated driver invocations in one
+        # rebase/merge train are exactly the workload the service
+        # daemon amortizes. auto falls back to one-shot on any
+        # connect/spawn failure, so this never costs correctness; an
+        # explicit SEMMERGE_DAEMON (off/require) is respected.
+        env = dict(os.environ)
+        env.setdefault("SEMMERGE_DAEMON", "auto")
         try:
             code = subprocess.run(
                 [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
                  base_commit, head, merge_head, "--inplace", "--git"],
-                cwd=repo_root,
+                cwd=repo_root, env=env,
             ).returncode
         except BaseException:
             # A crashed run must not latch; the next invocation retries.

@@ -1,6 +1,25 @@
+"""Package entry point.
+
+Daemon delegation happens HERE, before ``cli`` (and with it jax, the
+backends, the engine) is imported: when ``SEMMERGE_DAEMON=auto|require``
+hands a merge-shaped invocation to a warm daemon, this process only
+ever pays for the thin client (:mod:`semantic_merge_tpu.service.client`)
+— milliseconds instead of the cold-start imports the daemon exists to
+amortize. Any path that does not delegate (mode off, non-verb command,
+auto-mode fallback) proceeds through the normal CLI unchanged.
+"""
 import sys
 
-from .cli import main
+
+def _main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from .service import client
+    code = client.delegate(argv)
+    if code is not None:
+        return code
+    from .cli import main
+    return main(argv)
+
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_main())

@@ -59,6 +59,43 @@ class WorkerError(WorkerFault):
 IDEMPOTENT_METHODS = frozenset({"buildAndDiff", "diff", "compose", "ping"})
 
 
+# --- keep-alive worker sharing (daemon warm state) -------------------------
+#
+# The CLI builds a fresh backend per merge rung and closes it at rung
+# end, so a one-shot process pays one worker spawn per merge. The merge
+# service daemon (service/daemon.py) sets SEMMERGE_WORKER_KEEPALIVE=1 in
+# its own environment: backend instances then check a process-global
+# worker out of this registry (keyed by the worker command line) instead
+# of spawning, and close() leaves it running — the supervised child
+# stays warm across requests. Requests sharing a worker serialize their
+# write+read round-trips on the registry lock entry; supervision
+# (deadline group-kill, respawn-and-resend) is unchanged and a killed
+# shared worker is dropped from the registry so the next request
+# respawns it.
+
+_SHARED_LOCK = threading.Lock()
+_SHARED: Dict[tuple, "tuple[subprocess.Popen, threading.Lock]"] = {}
+
+
+def _keepalive_enabled() -> bool:
+    import os
+    return os.environ.get("SEMMERGE_WORKER_KEEPALIVE", "").strip() == "1"
+
+
+def shutdown_shared() -> None:
+    """Close every keep-alive worker (daemon shutdown path)."""
+    with _SHARED_LOCK:
+        procs = [proc for proc, _ in _SHARED.values()]
+        _SHARED.clear()
+    for proc in procs:
+        if proc.poll() is None:
+            try:
+                proc.stdin.close()
+                proc.wait(timeout=5)
+            except Exception:
+                kill_process_group(proc)
+
+
 class SubprocessBackend:
     name = "subprocess"
     extensions = frozenset(TS_EXTENSIONS)
@@ -71,6 +108,7 @@ class SubprocessBackend:
             sys.executable, "-m", "semantic_merge_tpu.runtime.worker",
             "--backend", "host"]
         self._proc: Optional[subprocess.Popen] = None
+        self._io_lock = threading.Lock()
         self._next_id = 0
         self._deadline = (deadline if deadline is not None
                           else env_seconds("SEMMERGE_WORKER_TIMEOUT", 120.0))
@@ -86,21 +124,33 @@ class SubprocessBackend:
 
     # --- protocol plumbing -------------------------------------------------
 
+    def _spawn(self) -> subprocess.Popen:
+        # The default worker imports this package; make that work
+        # from any cwd (the CLI usually runs inside a user repo).
+        import os
+        import pathlib
+        env = dict(os.environ)
+        pkg_root = str(pathlib.Path(__file__).resolve().parents[2])
+        parts = [pkg_root, env.get("PYTHONPATH", "")]
+        env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
+        # Own session: deadline expiry kills the worker's whole
+        # process group without touching the CLI's.
+        return subprocess.Popen(
+            self._cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, bufsize=1, env=env, start_new_session=True)
+
     def _ensure_proc(self) -> subprocess.Popen:
         if self._proc is None or self._proc.poll() is not None:
-            # The default worker imports this package; make that work
-            # from any cwd (the CLI usually runs inside a user repo).
-            import os
-            import pathlib
-            env = dict(os.environ)
-            pkg_root = str(pathlib.Path(__file__).resolve().parents[2])
-            parts = [pkg_root, env.get("PYTHONPATH", "")]
-            env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
-            # Own session: deadline expiry kills the worker's whole
-            # process group without touching the CLI's.
-            self._proc = subprocess.Popen(
-                self._cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                text=True, bufsize=1, env=env, start_new_session=True)
+            if _keepalive_enabled():
+                key = tuple(self._cmd)
+                with _SHARED_LOCK:
+                    entry = _SHARED.get(key)
+                    if entry is None or entry[0].poll() is not None:
+                        entry = (self._spawn(), threading.Lock())
+                        _SHARED[key] = entry
+                self._proc, self._io_lock = entry
+            else:
+                self._proc = self._spawn()
         return self._proc
 
     def _call(self, method: str, params: Dict) -> Dict:
@@ -128,6 +178,14 @@ class SubprocessBackend:
 
     def _call_once(self, method: str, params: Dict) -> Dict:
         proc = self._ensure_proc()
+        # One request/response round-trip at a time per worker process:
+        # a keep-alive worker is shared by concurrent daemon requests,
+        # and interleaved writes on one pipe would corrupt the framing.
+        with self._io_lock:
+            return self._roundtrip(proc, method, params)
+
+    def _roundtrip(self, proc: subprocess.Popen, method: str,
+                   params: Dict) -> Dict:
         self._next_id += 1
         request = {"id": self._next_id, "method": method, "params": params}
         try:
@@ -205,6 +263,12 @@ class SubprocessBackend:
     def _shutdown(self) -> None:
         proc, self._proc = self._proc, None
         if proc is not None:
+            with _SHARED_LOCK:
+                # A torn-down worker must not be handed to the next
+                # keep-alive checkout.
+                for key, (shared, _) in list(_SHARED.items()):
+                    if shared is proc:
+                        del _SHARED[key]
             try:
                 if proc.poll() is None:
                     proc.stdin.close()
@@ -270,6 +334,11 @@ class SubprocessBackend:
         return composed, conflicts
 
     def close(self) -> None:
+        if self._proc is not None and _keepalive_enabled():
+            # Keep-alive mode: the worker outlives this backend instance
+            # (the daemon owns its lifetime via shutdown_shared()).
+            self._proc = None
+            return
         if self._proc is not None and self._proc.poll() is not None:
             self._proc = None  # already dead: nothing to hand shutdown to
         if self._proc is not None:

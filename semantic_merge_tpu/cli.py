@@ -57,6 +57,13 @@ from .utils.loggingx import logger
 CONFLICTS_ARTIFACT = ".semmerge-conflicts.json"
 
 
+def _conflicts_path() -> pathlib.Path:
+    """The conflicts artifact lands in the request's repo root when a
+    merge service request is in scope (utils/workdir), cwd otherwise."""
+    from .utils import workdir
+    return workdir.root() / CONFLICTS_ARTIFACT
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="semmerge", description="TPU-native semantic merge engine")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -125,6 +132,33 @@ def build_parser() -> argparse.ArgumentParser:
     p_rebase.add_argument("onto", help="Revision to replay onto")
     p_rebase.add_argument("--inplace", action="store_true")
 
+    p_serve = sub.add_parser("serve",
+                             help="Run the warm-state merge service daemon "
+                                  "on a unix socket (see runbook: Service "
+                                  "mode)")
+    p_serve.add_argument("--socket", default=None,
+                         help="Unix socket path (default: "
+                              "SEMMERGE_SERVICE_SOCKET, else "
+                              "$XDG_RUNTIME_DIR/semmerge.sock, else "
+                              "/tmp/semmerge-<uid>.sock)")
+    p_serve.add_argument("--workers", type=int, default=None,
+                         help="Executor threads (SEMMERGE_SERVICE_WORKERS, "
+                              "default 4)")
+    p_serve.add_argument("--queue", type=int, default=None,
+                         help="Admission queue bound (SEMMERGE_SERVICE_QUEUE,"
+                              " default 16); a full queue rejects with a "
+                              "typed WorkerFault, exit 12")
+    p_serve.add_argument("--idle-exit", type=float, default=None,
+                         help="Exit after this many idle seconds "
+                              "(SEMMERGE_SERVICE_IDLE_EXIT, default 900; "
+                              "0 disables)")
+    p_serve.add_argument("--events", default=None,
+                         help="Write the daemon's span/event stream to this "
+                              "JSONL path on exit")
+    p_serve.add_argument("--status", action="store_true",
+                         help="Query a running daemon's status and exit "
+                              "(does not start one)")
+
     p_stats = sub.add_parser("stats",
                              help="Pretty-print a semmerge trace/metrics "
                                   "artifact (.semmerge-trace.json, "
@@ -138,6 +172,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_stats.add_argument("--prometheus", action="store_true",
                          help="Render the artifact's metrics as Prometheus "
                               "text exposition")
+    p_stats.add_argument("--daemon", action="store_true",
+                         help="Query the live merge service daemon instead "
+                              "of reading an artifact file")
 
     p_train = sub.add_parser("train-matcher",
                              help="Train the decl-similarity matcher (orbax "
@@ -180,6 +217,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             return cmd_train_matcher(args)
         if args.command == "stats":
             return cmd_stats(args)
+        if args.command == "serve":
+            return cmd_serve(args)
     except subprocess.CalledProcessError as exc:
         cmd = exc.cmd if isinstance(exc.cmd, str) else " ".join(map(str, exc.cmd))
         print(f"error: subprocess failed ({cmd}): exit {exc.returncode}", file=sys.stderr)
@@ -286,9 +325,12 @@ def cmd_semdiff(args: argparse.Namespace) -> int:
 
 
 def _strict_mode(args: argparse.Namespace) -> bool:
-    """Fail-fast mode: ``--no-degrade`` or ``SEMMERGE_STRICT=1``."""
+    """Fail-fast mode: ``--no-degrade`` or ``SEMMERGE_STRICT=1`` (read
+    through the request overlay so daemon requests carry their client's
+    posture)."""
+    from .utils import reqenv
     return (getattr(args, "no_degrade", False)
-            or os.environ.get("SEMMERGE_STRICT", "").strip() == "1")
+            or (reqenv.get("SEMMERGE_STRICT", "") or "").strip() == "1")
 
 
 def _fail_fast(fault: MergeFault) -> int:
@@ -322,8 +364,9 @@ def _record_degradation(frm: str, to: str, fault: MergeFault,
 
 def cmd_semmerge(args: argparse.Namespace) -> int:
     if getattr(args, "resume", False):
-        from .runtime.inplace import recover
-        action, n_writes = recover()
+        from .runtime.inplace import recover, repo_lock
+        with repo_lock():
+            action, n_writes = recover()
         detail = f" ({n_writes} writes)" if action == "rolled-forward" else ""
         print(f"inplace recovery: {action}{detail}")
         return 0
@@ -334,14 +377,18 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
     logger.info("Starting semantic merge base=%s A=%s B=%s", args.base, args.a, args.b)
     if args.inplace:
         # A journal/stage left by an interrupted --inplace commit is
-        # resolved before this merge touches anything.
-        from .runtime.inplace import recover
-        recover()
+        # resolved before this merge touches anything; recovery mutates
+        # the work tree, so it holds the same repo lock as the commit.
+        from .runtime.inplace import recover, repo_lock
+        with repo_lock():
+            recover()
     tracer = Tracer(enabled=args.trace, profile_dir=args.profile)
     try:
         return _merge_ladder(args, tracer, strict=_strict_mode(args))
     finally:
         tracer.write()
+        from .frontend.declcache import publish_metrics
+        publish_metrics()
 
 
 def _merge_ladder(args: argparse.Namespace, tracer: Tracer,
@@ -482,7 +529,7 @@ def _semantic_attempt(args: argparse.Namespace, config, backend,
             return 1
         # A clean merge must not leave a stale artifact from a previous
         # conflicted run next to a success exit code.
-        pathlib.Path(CONFLICTS_ARTIFACT).unlink(missing_ok=True)
+        _conflicts_path().unlink(missing_ok=True)
 
         with tracer.phase("materialize"), fault_boundary("apply"):
             from .runtime.git import temp_tree
@@ -545,11 +592,14 @@ def _semantic_attempt(args: argparse.Namespace, config, backend,
             return 2
 
         if args.inplace:
-            # Crash-safe publish: stage → journal → atomic renames.
-            # Text-merge deletions propagate through the same journal.
+            # Crash-safe publish: stage → journal → atomic renames,
+            # under the repo-level lock so concurrent --inplace runs
+            # (one-shot or daemon) exclude each other. Text-merge
+            # deletions propagate through the same journal.
             with fault_boundary("commit"):
-                from .runtime.inplace import commit_tree_inplace
-                commit_tree_inplace(merged_tree, deletes=deleted_paths)
+                from .runtime.inplace import commit_tree_inplace, repo_lock
+                with repo_lock():
+                    commit_tree_inplace(merged_tree, deletes=deleted_paths)
 
         with tracer.phase("notes"):
             notes_put(resolve_rev(args.a), OpLog(result.op_log_left))
@@ -581,11 +631,14 @@ def _textual_rung(args: argparse.Namespace, tracer: Tracer) -> int:
             if conflicts:
                 _write_conflict_reports(conflicts)
                 return 1
-            pathlib.Path(CONFLICTS_ARTIFACT).unlink(missing_ok=True)
+            _conflicts_path().unlink(missing_ok=True)
             if args.inplace:
                 with fault_boundary("commit"):
-                    from .runtime.inplace import commit_tree_inplace
-                    commit_tree_inplace(merged_tree, deletes=deleted_paths)
+                    from .runtime.inplace import (commit_tree_inplace,
+                                                  repo_lock)
+                    with repo_lock():
+                        commit_tree_inplace(merged_tree,
+                                            deletes=deleted_paths)
     logger.info("Merge complete (textual fallback)")
     return 0
 
@@ -605,8 +658,9 @@ def cmd_semrebase(args: argparse.Namespace) -> int:
         emit_files(merged)
         if args.inplace:
             # Same crash-safe two-phase commit as semmerge --inplace.
-            from .runtime.inplace import commit_tree_inplace
-            commit_tree_inplace(merged)
+            from .runtime.inplace import commit_tree_inplace, repo_lock
+            with repo_lock():
+                commit_tree_inplace(merged)
             _cleanup([merged])
         else:
             print(str(merged))
@@ -615,11 +669,65 @@ def cmd_semrebase(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Start (or, with ``--status``, query) the merge service daemon."""
+    from .service import client as service_client
+    if args.status:
+        try:
+            status = service_client.call_control("status", path=args.socket)
+        except service_client.DaemonUnavailable as exc:
+            print(f"semmerge serve: no daemon running ({exc})",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(status, indent=2, default=str))
+        return 0
+    from .service.daemon import Daemon
+    daemon = Daemon(socket_path=args.socket, workers=args.workers,
+                    queue_size=args.queue, idle_exit=args.idle_exit,
+                    events_path=args.events)
+    return daemon.serve_forever()
+
+
 def cmd_stats(args: argparse.Namespace) -> int:
     """Pretty-print an observability artifact: a ``.semmerge-trace.json``
     trace, a ``.semmerge-events.jsonl`` span/event stream, or a metrics
     registry dump (``SEMMERGE_METRICS=path``). Rendering reads only the
-    file — it works on artifacts from long-gone processes."""
+    file — it works on artifacts from long-gone processes. With
+    ``--daemon`` the data comes from the live merge service instead."""
+    if getattr(args, "daemon", False):
+        from .service import client as service_client
+        try:
+            status = service_client.call_control("status")
+        except service_client.DaemonUnavailable as exc:
+            print(f"error: no merge service daemon reachable ({exc})",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(status, indent=2, default=str))
+            return 0
+        if args.prometheus:
+            from .obs.metrics import render_prometheus_from_dict
+            print(render_prometheus_from_dict(status.get("metrics", {})),
+                  end="")
+            return 0
+        decl = status.get("declcache") or {}
+        print(f"daemon pid={status.get('pid')} "
+              f"uptime={status.get('uptime_s', 0.0):.1f}s "
+              f"socket={status.get('socket')}")
+        print(f"requests: served={status.get('served_total', 0)} "
+              f"queue_depth={status.get('queue_depth', 0)} "
+              f"in_flight={status.get('in_flight', 0)} "
+              f"workers={status.get('workers', 0)}")
+        print(f"declcache: hit_rate={status.get('declcache_hit_rate', 0.0):.3f} "
+              f"hits={decl.get('hits', 0)} misses={decl.get('misses', 0)} "
+              f"evictions={decl.get('evictions', 0)} "
+              f"entries={decl.get('entries', 0)}")
+        print(f"memory: rss_mb={status.get('rss_mb', 0.0):.1f} "
+              f"repos_tracked={status.get('repos_tracked', 0)}")
+        for line in _render_stats({"counters": status.get("metrics", {}).get(
+                "counters", {})}):
+            print(line)
+        return 0
     path = pathlib.Path(args.artifact)
     if not path.is_file():
         print(f"error: no artifact at {path} (run `semmerge ... --trace` "
@@ -751,7 +859,8 @@ def cmd_train_matcher(args: argparse.Namespace) -> int:
 
 def _write_conflict_reports(conflicts: Sequence[object]) -> None:
     payload = [c.to_dict() if hasattr(c, "to_dict") else c for c in conflicts]
-    pathlib.Path(CONFLICTS_ARTIFACT).write_text(json.dumps(payload, indent=2), encoding="utf-8")
+    _conflicts_path().write_text(json.dumps(payload, indent=2),
+                                 encoding="utf-8")
 
 
 def _cleanup(paths: Iterable[pathlib.Path]) -> None:

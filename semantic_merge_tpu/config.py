@@ -136,7 +136,13 @@ def find_config_file(start: pathlib.Path) -> pathlib.Path | None:
 
 
 def load_config(start: pathlib.Path | None = None) -> Config:
-    start = pathlib.Path(start) if start is not None else pathlib.Path.cwd()
+    if start is not None:
+        start = pathlib.Path(start)
+    else:
+        # Scoped request root inside a merge service request, process
+        # cwd otherwise (utils/workdir).
+        from .utils import workdir
+        start = workdir.root()
     cfg_path = find_config_file(start)
     config = Config(root=cfg_path.parent if cfg_path else start)
     if cfg_path is None:

@@ -122,6 +122,13 @@ STAGE_FAULTS = {
     "chain": KernelFault,
     "worker": WorkerFault,
     "worker-serve": WorkerFault,
+    # The merge service daemon (service/daemon.py) is an out-of-process
+    # worker from the client's point of view, so its stages classify as
+    # WorkerFault (exit 12) — except deadline expiry, which the daemon
+    # raises as DeadlineFault explicitly.
+    "service:accept": WorkerFault,
+    "service:dispatch": WorkerFault,
+    "service:execute": WorkerFault,
     "materialize": ApplyFault,
     "apply": ApplyFault,
     "commit": ApplyFault,

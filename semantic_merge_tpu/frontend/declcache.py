@@ -23,6 +23,19 @@ files, not 30k), and repeated merges in one process (watch mode, the
 bench harness, the merge driver's repo-level run) hit across calls —
 the reference's "warm cache e2e merge ≤ 10 s" budget
 (reference ``architecture.md:313``).
+
+The intended *cross-invocation* consumer of that warm state is the
+merge service daemon (:mod:`semantic_merge_tpu.service`): a one-shot
+CLI process dies with its cache, but ``semmerge serve`` keeps this
+process-global cache alive across requests, so the Nth merge of a repo
+re-scans only the files that changed since the first. Hit/miss/eviction
+counts are visible two ways: per-merge in the trace counters
+(``decl_cache_hits``/``decl_cache_misses``), and cumulatively in the
+obs registry (``declcache_hits_total`` / ``declcache_misses_total`` /
+``declcache_evictions_total``, delta-published by
+:func:`publish_metrics` at the end of each merge/request — per-``get``
+counter updates would tax the scan hot path for numbers nobody reads
+mid-merge).
 """
 from __future__ import annotations
 
@@ -180,3 +193,31 @@ def configure(memory_cap_mb: int) -> None:
     cache = global_cache()
     if cache is not None:
         cache.set_cap_mb(max(1, memory_cap_mb // 2))
+
+
+_PUBLISHED = {"hits": 0, "misses": 0, "evictions": 0}
+_PUBLISH_METRICS = {"hits": "declcache_hits_total",
+                    "misses": "declcache_misses_total",
+                    "evictions": "declcache_evictions_total"}
+_PUBLISH_HELP = {"hits": "Decl-cache lookups served from cache",
+                 "misses": "Decl-cache lookups that re-scanned",
+                 "evictions": "Decl-cache entries evicted by the byte cap"}
+
+
+def publish_metrics() -> None:
+    """Delta-sync the cache's internal counters into the obs registry.
+
+    Called at the end of each merge (CLI) and each service request
+    (daemon) rather than on every ``get``: one registry update per
+    merge instead of one per file lookup. Safe when the cache is
+    disabled or was never touched."""
+    cache = _GLOBAL
+    if cache is None:
+        return
+    from ..obs import metrics as obs_metrics
+    for field, metric in _PUBLISH_METRICS.items():
+        current = getattr(cache, field)
+        delta = current - _PUBLISHED[field]
+        if delta > 0:
+            obs_metrics.REGISTRY.counter(metric, _PUBLISH_HELP[field]).inc(delta)
+        _PUBLISHED[field] = current

@@ -21,11 +21,15 @@ from collections import OrderedDict
 from typing import Dict, Iterable, Iterator, List
 
 from ..frontend.snapshot import SOURCE_EXTENSIONS, Snapshot
+from ..utils import workdir
 
 
 def run_git(args: Iterable[str], cwd: pathlib.Path | None = None) -> str:
+    # cwd=None resolves to the scoped request root when inside a merge
+    # service request, the process cwd otherwise (utils/workdir).
     proc = subprocess.run(["git", *args], check=True, stdout=subprocess.PIPE,
-                          text=True, cwd=cwd)
+                          text=True, cwd=cwd if cwd is not None
+                          else workdir.current())
     return proc.stdout.strip()
 
 
@@ -50,7 +54,8 @@ def archive_bytes(rev: str, cwd: pathlib.Path | None = None) -> bytes:
     """One ``git archive`` round-trip for a revision's full tree."""
     resolved = resolve_rev(rev, cwd=cwd)
     proc = subprocess.run(["git", "archive", resolved], check=True,
-                          stdout=subprocess.PIPE, cwd=cwd)
+                          stdout=subprocess.PIPE,
+                          cwd=cwd if cwd is not None else workdir.current())
     return proc.stdout
 
 

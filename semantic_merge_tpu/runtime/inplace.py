@@ -29,23 +29,114 @@ A process killed at ANY point leaves one of two recoverable states:
 :func:`recover` implements both and is invoked automatically at the
 start of every ``--inplace`` merge and explicitly by
 ``semmerge --resume``.
+
+Cross-process exclusion: the stage/journal protocol is crash-safe but
+not *concurrent*-safe — two simultaneous ``--inplace`` merges in the
+same work tree would interleave on ``.semmerge-stage/`` and clobber
+each other's journal. :func:`repo_lock` is the shared repo-level mutex:
+an ``O_EXCL`` lockfile carrying ``pid mtime``, with the same staleness
+heuristic as the merge driver's latch (old mtime, or a recorded pid
+that no longer exists). The one-shot CLI takes it around every
+``--inplace`` merge and the service daemon takes the same lock for its
+requests, so daemon and one-shot runs exclude each other too.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import pathlib
 import shutil
-from typing import Iterable, List, Tuple
+import time
+from typing import Iterable, Iterator, List, Tuple
 
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
-from ..utils import faults
+from ..utils import faults, workdir
 from ..utils.loggingx import logger
 
 JOURNAL = ".semmerge-journal.json"
 STAGE_DIR = ".semmerge-stage"
 JOURNAL_SCHEMA = 1
+
+LOCKFILE = ".semmerge-inplace.lock"
+#: Same age cutoff as the merge driver's ``.git/.semmerge.lock`` latch.
+STALE_LOCK_SECONDS = 3600.0
+
+
+def _lock_is_stale(path: pathlib.Path) -> bool:
+    """A lock left by a dead or long-gone process: old mtime (the
+    driver-latch heuristic), or a recorded pid that no longer exists."""
+    try:
+        st = path.stat()
+    except OSError:
+        return False  # raced with the owner's own unlink
+    if time.time() - st.st_mtime > STALE_LOCK_SECONDS:
+        return True
+    try:
+        pid = int(path.read_text(encoding="utf-8").split()[0])
+    except (OSError, ValueError, IndexError):
+        return False  # unreadable content: trust mtime alone
+    if pid == os.getpid():
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        pass
+    return False
+
+
+@contextlib.contextmanager
+def repo_lock(root: pathlib.Path | None = None,
+              timeout: float | None = None) -> Iterator[pathlib.Path]:
+    """Repo-level ``--inplace`` mutex: ``O_CREAT|O_EXCL`` on
+    ``.semmerge-inplace.lock`` under ``root`` (default: the scoped
+    working directory). Blocks up to ``timeout`` seconds
+    (``SEMMERGE_INPLACE_LOCK_TIMEOUT``, default 600; 0 waits forever),
+    reclaiming stale locks on the way; expiry raises an
+    :class:`~semantic_merge_tpu.errors.ApplyFault` (exit 13) so a
+    wedged peer surfaces as a contained fault, not a silent hang."""
+    root = pathlib.Path(root) if root is not None else workdir.root()
+    path = root / LOCKFILE
+    if timeout is None:
+        from ..utils.procs import env_seconds
+        timeout = env_seconds("SEMMERGE_INPLACE_LOCK_TIMEOUT", 600.0)
+    deadline = time.monotonic() + timeout if timeout > 0 else None
+    waited = False
+    while True:
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            break
+        except FileExistsError:
+            if _lock_is_stale(path):
+                logger.warning("reclaiming stale in-place lock %s", path)
+                obs_metrics.REGISTRY.counter(
+                    "semmerge_inplace_lock_stale_total",
+                    "Stale repo-level in-place locks reclaimed").inc(1)
+                path.unlink(missing_ok=True)
+                continue
+            if deadline is not None and time.monotonic() > deadline:
+                from ..errors import ApplyFault
+                raise ApplyFault(
+                    f"timed out after {timeout:g}s waiting for the "
+                    f"in-place lock {path}", stage="commit",
+                    cause="lock-timeout")
+            waited = True
+            time.sleep(0.05)
+    try:
+        os.write(fd, f"{os.getpid()} {int(time.time())}\n".encode("ascii"))
+    finally:
+        os.close(fd)
+    if waited:
+        obs_metrics.REGISTRY.counter(
+            "semmerge_inplace_lock_waits_total",
+            "In-place merges that waited for the repo lock").inc(1)
+    try:
+        yield path
+    finally:
+        path.unlink(missing_ok=True)
 
 
 def _safe_rel(rel: str) -> pathlib.PurePosixPath:
@@ -62,7 +153,7 @@ def commit_tree_inplace(tree: pathlib.Path, deletes: Iterable[str] = (),
                         root: pathlib.Path | None = None) -> None:
     """Publish ``tree`` into ``root`` (default cwd) crash-safely."""
     tree = pathlib.Path(tree)
-    root = pathlib.Path(root) if root is not None else pathlib.Path.cwd()
+    root = pathlib.Path(root) if root is not None else workdir.root()
     stage = root / STAGE_DIR
     if stage.exists():
         shutil.rmtree(stage)
@@ -125,7 +216,7 @@ def _roll_forward(root: pathlib.Path, journal: dict) -> None:
 def pending_state(root: pathlib.Path | None = None) -> str:
     """``"none"`` | ``"committing"`` | ``"staged-only"`` — what an
     earlier interrupted in-place commit left behind."""
-    root = pathlib.Path(root) if root is not None else pathlib.Path.cwd()
+    root = pathlib.Path(root) if root is not None else workdir.root()
     if (root / JOURNAL).exists():
         return "committing"
     if (root / STAGE_DIR).exists():
@@ -142,7 +233,7 @@ def recover(root: pathlib.Path | None = None) -> Tuple[str, int]:
     touched). A torn/unreadable journal rolls back: the journal write
     is atomic, so an unreadable one cannot have committed anything.
     """
-    root = pathlib.Path(root) if root is not None else pathlib.Path.cwd()
+    root = pathlib.Path(root) if root is not None else workdir.root()
     jpath = root / JOURNAL
     stage = root / STAGE_DIR
     if jpath.exists():

@@ -15,6 +15,7 @@ import subprocess
 import tempfile
 
 from ..core.ops import OpLog
+from ..utils import workdir
 
 NOTES_REF = "semmerge"
 
@@ -27,7 +28,7 @@ def notes_put(commit: str, oplog: OpLog, namespace: str = NOTES_REF) -> None:
         tmp_file.write_bytes(oplog.to_json_bytes())
         subprocess.run(
             ["git", "notes", "--ref", namespace, "add", "-f", "-F", str(tmp_file), commit],
-            check=True,
+            check=True, cwd=workdir.current(),
         )
     except subprocess.CalledProcessError:
         pass  # Notes are optional; never fail the merge over them.
@@ -40,6 +41,7 @@ def notes_get(commit: str, namespace: str = NOTES_REF) -> OpLog | None:
         proc = subprocess.run(
             ["git", "notes", "--ref", namespace, "show", commit],
             check=True, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd=workdir.current(),
         )
     except subprocess.CalledProcessError:
         return None

@@ -141,6 +141,11 @@ class Tracer:
         if not self.enabled:
             return
         path = pathlib.Path(path)
+        if not path.is_absolute():
+            # Relative artifacts land in the request root when a merge
+            # service request is in scope (utils/workdir), cwd otherwise.
+            from ..utils import workdir
+            path = workdir.root() / path
         path.write_text(payload, encoding="utf-8")
         if self._recorder is not None:
             self._recorder.write_jsonl(

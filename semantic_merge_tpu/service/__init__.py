@@ -1,0 +1,29 @@
+"""Warm-state merge service: ``semmerge serve`` daemon + thin client.
+
+One-shot semmerge pays its worst costs before the first op is diffed:
+interpreter + jax import, XLA compilation of the fused merge program,
+a cold decl cache, prettier/tsc discovery, a fresh subprocess worker.
+The reference's warm-cache budget (architecture.md:313 — "warm cache
+e2e merge ≤ 10 s" vs 40 s cold) assumes exactly the long-lived process
+this package provides: a daemon on a unix socket holding all of that
+state across requests, and a client that delegates merge-shaped CLI
+invocations to it.
+
+Layout:
+
+- :mod:`~semantic_merge_tpu.service.protocol` — wire format (newline
+  JSON-RPC, the :mod:`runtime.worker` idiom), socket-path resolution,
+  request-env capture;
+- :mod:`~semantic_merge_tpu.service.daemon` — the server: bounded
+  admission queue, executor threads, per-repo serialization of
+  ``--inplace`` work, warm caches, graceful lifecycle;
+- :mod:`~semantic_merge_tpu.service.client` — the client:
+  ``SEMMERGE_DAEMON=auto|require|off`` delegation with
+  spawn-if-absent and a hard guarantee that auto mode never fails a
+  merge the one-shot path would have completed.
+
+The contract throughout is *byte parity*: a request executed by the
+daemon produces the same tree bytes, artifacts, exit code, and notes
+payloads as the same argv run one-shot (``tests/test_service.py``
+enforces this against the golden corpus).
+"""

@@ -1,0 +1,275 @@
+"""Merge-service client: delegate merge-shaped CLI invocations to a
+warm daemon, with a hard never-worse-than-one-shot guarantee.
+
+``SEMMERGE_DAEMON`` selects the posture:
+
+- ``off`` (default) — never delegate; plain one-shot CLI.
+- ``auto`` — connect to a running daemon, spawn one if absent (with a
+  startup handshake), and on ANY transport failure — no daemon, spawn
+  timeout, protocol garbage, connection died mid-request — fall back
+  to the in-process one-shot path. The work tree is never left worse
+  than a one-shot run: delegation failures happen before this process
+  touches the tree, and a daemon killed mid-``--inplace`` leaves the
+  journaled state the one-shot path's ``recover()`` resolves first.
+- ``require`` — delegate or fail with the ``WorkerFault`` exit (12);
+  for tests and deployments that must not silently run cold.
+
+A *typed* wire error (``exit_code`` present) is a final answer in both
+auto and require modes — the daemon executed the request and the fault
+is the result, exactly as a one-shot run with the same injected fault
+would have exited; falling back and re-running would turn a
+deterministic typed failure into a double execution.
+
+:func:`delegate` is called from ``__main__`` BEFORE ``cli`` (and
+therefore jax) is imported — the client path costs milliseconds, which
+is the whole point of the warm daemon.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import protocol
+
+#: Exit for ``require`` mode with no usable daemon — the WorkerFault
+#: code (errors.EXIT_CODES), hardcoded so this module never imports
+#: the heavy package half.
+_REQUIRE_FAILED_EXIT = 12
+
+_Conn = Tuple[socket.socket, Any, Any]  # (sock, rfile, wfile)
+
+
+class DaemonUnavailable(Exception):
+    """No daemon could be reached/spawned, or the transport broke."""
+
+
+def mode() -> str:
+    return os.environ.get("SEMMERGE_DAEMON", "off").strip().lower()
+
+
+def delegate(argv: Sequence[str]) -> Optional[int]:
+    """Run ``argv`` (full CLI argv, ``argv[0]`` the subcommand) on the
+    daemon. Returns the exit code, or ``None`` when the invocation
+    should proceed in-process (daemon mode off, non-verb command, or
+    auto-mode transport failure)."""
+    argv = [str(a) for a in argv]
+    if not argv or argv[0] not in protocol.VERBS:
+        return None
+    m = mode()
+    if m not in ("auto", "require"):
+        return None
+    if os.environ.get("_SEMMERGE_IN_DAEMON"):
+        return None  # belt and suspenders: the daemon never re-delegates
+    try:
+        return _run_on_daemon(argv[0], argv[1:])
+    except DaemonUnavailable as exc:
+        if m == "require":
+            sys.stderr.write(f"semmerge: daemon required but unavailable: "
+                             f"{exc} (exit {_REQUIRE_FAILED_EXIT})\n")
+            return _REQUIRE_FAILED_EXIT
+        return None  # auto: warm path failed, run one-shot
+
+
+def _run_on_daemon(verb: str, rest: List[str]) -> int:
+    deadline = _env_float("SEMMERGE_SERVICE_DEADLINE", 0.0)
+    sock, rfile, wfile = _connect_or_spawn()
+    try:
+        params: Dict[str, Any] = {
+            "argv": rest,
+            "cwd": os.getcwd(),
+            "env": protocol.request_env(),
+        }
+        if deadline > 0:
+            params["deadline_s"] = deadline
+            # Transport timeout trails the request deadline: the daemon
+            # answers deadline expiry itself (typed DeadlineFault); the
+            # socket timeout only catches a wedged daemon.
+            sock.settimeout(deadline + 30.0)
+        try:
+            protocol.write_message(wfile, {"id": 1, "method": verb,
+                                           "params": params})
+            resp = protocol.read_message(rfile)
+        except (OSError, ValueError, protocol.ProtocolError) as exc:
+            raise DaemonUnavailable(f"transport failed: {exc}") from exc
+        if resp is None:
+            raise DaemonUnavailable("daemon closed the connection "
+                                    "mid-request")
+        if resp.get("id") != 1:
+            raise DaemonUnavailable("response id mismatch")
+        error = resp.get("error")
+        if error is not None:
+            exit_code = error.get("exit_code")
+            if isinstance(exit_code, int):
+                # Typed fault: a FINAL answer (see module docstring).
+                message = error.get("message", "")
+                if message:
+                    sys.stderr.write(f"semmerge: {message} "
+                                     f"(exit {exit_code})\n")
+                return exit_code
+            raise DaemonUnavailable(
+                f"protocol error: {error.get('message', 'unknown')}")
+        result = resp.get("result")
+        if not isinstance(result, dict) or "exit_code" not in result:
+            raise DaemonUnavailable("malformed result frame")
+        sys.stdout.write(result.get("stdout", ""))
+        sys.stderr.write(result.get("stderr", ""))
+        sys.stdout.flush()
+        sys.stderr.flush()
+        return int(result["exit_code"])
+    finally:
+        _close(sock, rfile, wfile)
+
+
+# ----------------------------------------------------------------------
+# connection management
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _close(sock, rfile, wfile) -> None:
+    for closable in (rfile, wfile, sock):
+        try:
+            closable.close()
+        except OSError:
+            pass
+
+
+def _try_connect(path: str, timeout: float = 5.0) -> Optional[_Conn]:
+    """Connect + ``hello`` handshake. ``None`` means nothing usable is
+    listening (absent socket, stale socket, or a peer that cannot
+    complete the handshake)."""
+    if not os.path.exists(path):
+        return None
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    try:
+        sock.connect(path)
+        rfile = sock.makefile("r", encoding="utf-8")
+        wfile = sock.makefile("w", encoding="utf-8")
+        protocol.write_message(wfile, {
+            "id": 0, "method": "hello",
+            "params": {"version": protocol.PROTOCOL_VERSION}})
+        resp = protocol.read_message(rfile)
+    except (OSError, ValueError, protocol.ProtocolError):
+        with contextlib.suppress(OSError):
+            sock.close()
+        return None
+    if not (isinstance(resp, dict) and resp.get("id") == 0
+            and isinstance(resp.get("result"), dict)
+            and resp["result"].get("ok")):
+        _close(sock, rfile, wfile)
+        return None
+    sock.settimeout(None)
+    return sock, rfile, wfile
+
+
+def _spawn_daemon(path: str) -> subprocess.Popen:
+    """Start a detached daemon on ``path``. Its cwd is ``/`` so any
+    repo-relative work missing the request working-dir scope fails
+    loudly instead of landing in whichever repo spawned the daemon.
+    ``SEMMERGE_FAULT`` is stripped — injection is per-request (it rides
+    the request env overlay), not a property of the daemon process."""
+    env = dict(os.environ)
+    env.pop("SEMMERGE_FAULT", None)
+    env.pop("SEMMERGE_DAEMON", None)
+    log_path = path + ".log"
+    with open(log_path, "ab") as log:
+        return subprocess.Popen(
+            [sys.executable, "-m", "semantic_merge_tpu", "serve",
+             "--socket", path],
+            stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+            cwd="/", env=env, start_new_session=True)
+
+
+def _connect_or_spawn() -> _Conn:
+    path = protocol.socket_path()
+    conn = _try_connect(path)
+    if conn is not None:
+        return conn
+    spawn_timeout = _env_float("SEMMERGE_SERVICE_SPAWN_TIMEOUT", 30.0)
+    proc = _spawn_daemon(path)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < spawn_timeout:
+        conn = _try_connect(path)
+        if conn is not None:
+            return conn
+        if proc.poll() is not None:
+            # The spawned process exited — either it lost a startup
+            # race to another daemon (which should now be connectable)
+            # or it failed to come up.
+            conn = _try_connect(path)
+            if conn is not None:
+                return conn
+            raise DaemonUnavailable(
+                f"daemon exited rc={proc.returncode} during startup "
+                f"(log: {path}.log)")
+        time.sleep(0.1)
+    raise DaemonUnavailable(
+        f"daemon did not come up within {spawn_timeout:g}s "
+        f"(log: {path}.log)")
+
+
+# ----------------------------------------------------------------------
+# control plane (status / shutdown — used by the CLI, bench, tests)
+
+
+def call_control(method: str, params: Optional[dict] = None,
+                 path: Optional[str] = None, timeout: float = 10.0) -> dict:
+    """One control-method round trip against a RUNNING daemon (never
+    spawns). Raises :class:`DaemonUnavailable` when none is reachable
+    or the answer is not a result frame."""
+    resolved = protocol.socket_path(path)
+    conn = _try_connect(resolved, timeout=timeout)
+    if conn is None:
+        raise DaemonUnavailable(f"no daemon on {resolved}")
+    sock, rfile, wfile = conn
+    try:
+        sock.settimeout(timeout)
+        try:
+            protocol.write_message(wfile, {"id": 1, "method": method,
+                                           "params": params or {}})
+            resp = protocol.read_message(rfile)
+        except (OSError, ValueError, protocol.ProtocolError) as exc:
+            raise DaemonUnavailable(f"transport failed: {exc}") from exc
+        if not (isinstance(resp, dict) and resp.get("id") == 1
+                and isinstance(resp.get("result"), dict)):
+            raise DaemonUnavailable(f"malformed {method} response")
+        return resp["result"]
+    finally:
+        _close(sock, rfile, wfile)
+
+
+def call_verb(verb: str, params: dict, path: Optional[str] = None,
+              timeout: Optional[float] = None) -> dict:
+    """Raw verb request against a RUNNING daemon, returning the full
+    response frame (``result`` or ``error``) — the bench and the
+    concurrency tests drive the protocol directly with this."""
+    resolved = protocol.socket_path(path)
+    conn = _try_connect(resolved, timeout=timeout or 10.0)
+    if conn is None:
+        raise DaemonUnavailable(f"no daemon on {resolved}")
+    sock, rfile, wfile = conn
+    try:
+        sock.settimeout(timeout)
+        try:
+            protocol.write_message(wfile, {"id": 1, "method": verb,
+                                           "params": params})
+            resp = protocol.read_message(rfile)
+        except (OSError, ValueError, protocol.ProtocolError) as exc:
+            raise DaemonUnavailable(f"transport failed: {exc}") from exc
+        if resp is None:
+            raise DaemonUnavailable("daemon closed the connection")
+        return resp
+    finally:
+        _close(sock, rfile, wfile)

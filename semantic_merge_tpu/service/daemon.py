@@ -1,0 +1,551 @@
+"""The merge service daemon: warm state behind a unix socket.
+
+One process holds everything a one-shot CLI rebuilds per invocation —
+the jitted fused program and XLA compile cache, the process-global decl
+cache, the keep-alive subprocess worker, prettier/tsc discovery — and
+executes merge-shaped requests against it. Requests flow:
+
+    accept (handler thread)  →  bounded queue  →  executor thread
+
+- **Admission**: ``service.accept`` span; a full queue rejects with a
+  typed ``WorkerFault`` (exit 12, ``cause="queue-full"``) instead of
+  unbounded buffering.
+- **Dispatch**: the executor records ``service.queue_wait``, enforces
+  the request deadline (expiry → ``DeadlineFault``, exit 15 — the
+  PR-4 ladder's deadline semantics over the wire), and serializes
+  same-repo ``--inplace`` requests behind a per-repo lock; the
+  cross-process half of that exclusion is the ``O_EXCL`` lockfile the
+  CLI's commit path takes (:func:`runtime.inplace.repo_lock`).
+- **Execute**: ``service.execute`` span around the real CLI ``main``
+  under the request's working-dir scope (:mod:`utils.workdir`) and env
+  overlay (:mod:`utils.reqenv`), stdout/stderr routed per-thread back
+  to the client. Every ``MergeFault`` — including injected
+  ``service:*`` stage faults — becomes a typed wire error; the daemon
+  itself never dies of a request.
+
+Lifecycle: SIGTERM/SIGINT stop admission, drain in-flight work
+(bounded by ``SEMMERGE_SERVICE_DRAIN_TIMEOUT``), then exit. A stale
+socket left by a dead daemon is detected by a probe connect and
+replaced; a live daemon on the socket makes a second ``serve`` exit 0
+immediately. An idle daemon exits after ``SEMMERGE_SERVICE_IDLE_EXIT``
+seconds; idle per-repo state is reaped after ``SEMMERGE_SERVICE_TTL``.
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import pathlib
+import queue
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..errors import DeadlineFault, MergeFault, WorkerFault, fault_boundary
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from ..utils import faults, reqenv, workdir
+from ..utils.loggingx import logger
+from ..utils.procs import env_seconds
+from . import protocol
+
+_OUTCOME_BY_EXIT = {0: "ok", 1: "conflicts", 2: "typecheck", 3: "git-error"}
+
+_REQUESTS_HELP = "Service requests, by verb and outcome"
+_QUEUE_DEPTH_HELP = "Requests currently waiting in the admission queue"
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _rss_mb() -> float:
+    """Resident set size in MiB, from ``/proc/self/status`` (Linux);
+    best-effort 0.0 elsewhere."""
+    try:
+        with open("/proc/self/status", encoding="ascii") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0.0
+
+
+class _ThreadTee(io.TextIOBase):
+    """A stdout/stderr stand-in routing writes to a per-thread buffer
+    when one is pushed (an executor running a request) and to the real
+    stream otherwise (daemon logs, stray prints from handler threads).
+    ``print``/``sys.stdout`` resolve at call time, so swapping this in
+    once at startup covers every write the CLI makes."""
+
+    def __init__(self, fallback) -> None:
+        self._fallback = fallback
+        self._tls = threading.local()
+
+    def push(self, buf: io.StringIO) -> None:
+        self._tls.buf = buf
+
+    def pop(self) -> None:
+        self._tls.buf = None
+
+    def _target(self):
+        return getattr(self._tls, "buf", None) or self._fallback
+
+    def write(self, s: str) -> int:
+        return self._target().write(s)
+
+    def flush(self) -> None:
+        try:
+            self._target().flush()
+        except (OSError, ValueError):
+            pass
+
+    def writable(self) -> bool:
+        return True
+
+    @property
+    def encoding(self):  # some libraries sniff it off sys.stdout
+        return getattr(self._fallback, "encoding", "utf-8")
+
+
+class _Request:
+    __slots__ = ("id", "verb", "argv", "cwd", "env", "deadline_s",
+                 "t_accept", "done", "response")
+
+    def __init__(self, req_id, verb: str, params: Dict[str, Any]) -> None:
+        self.id = req_id
+        self.verb = verb
+        self.argv = [str(a) for a in (params.get("argv") or [])]
+        self.cwd = str(params.get("cwd") or "/")
+        env = params.get("env") or {}
+        self.env = {str(k): str(v) for k, v in env.items()}
+        raw_deadline = params.get("deadline_s")
+        self.deadline_s = float(raw_deadline) if raw_deadline else 0.0
+        self.t_accept = time.monotonic()
+        self.done = threading.Event()
+        self.response: Optional[Dict[str, Any]] = None
+
+
+class Daemon:
+    """One ``semmerge serve`` process. Construct, then
+    :meth:`serve_forever`."""
+
+    def __init__(self, socket_path: Optional[str] = None,
+                 workers: Optional[int] = None,
+                 queue_size: Optional[int] = None,
+                 idle_exit: Optional[float] = None,
+                 repo_ttl: Optional[float] = None,
+                 events_path: Optional[str] = None) -> None:
+        self._socket_path = protocol.socket_path(socket_path)
+        self._workers_n = workers if workers is not None else \
+            max(1, _env_int("SEMMERGE_SERVICE_WORKERS", 4))
+        qsize = queue_size if queue_size is not None else \
+            _env_int("SEMMERGE_SERVICE_QUEUE", 16)
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max(1, qsize))
+        self._idle_exit = idle_exit if idle_exit is not None else \
+            env_seconds("SEMMERGE_SERVICE_IDLE_EXIT", 900.0)
+        self._repo_ttl = repo_ttl if repo_ttl is not None else \
+            env_seconds("SEMMERGE_SERVICE_TTL", 600.0)
+        self._events_path = events_path
+        self._recorder: Optional[obs_spans.SpanRecorder] = None
+        self._stop = threading.Event()
+        self._locks_lock = threading.Lock()
+        self._repo_locks: Dict[str, Dict[str, Any]] = {}
+        self._state_lock = threading.Lock()
+        self._in_flight = 0
+        self._served = 0
+        self._last_activity = time.monotonic()
+        self._t0 = time.time()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def serve_forever(self) -> int:
+        self._configure_process_env()
+        sock = self._bind()
+        if sock is None:
+            # A live daemon already owns the socket: not an error —
+            # whoever raced us to it serves the requests.
+            print(f"semmerge serve: daemon already running on "
+                  f"{self._socket_path}")
+            return 0
+        if self._events_path:
+            self._recorder = obs_spans.SpanRecorder()
+            obs_spans.activate(self._recorder)
+        self._install_stdio_router()
+        self._install_signal_handlers()
+        from ..utils.jaxenv import enable_compile_cache
+        enable_compile_cache()
+        for _ in range(self._workers_n):
+            threading.Thread(target=self._executor, daemon=True).start()
+        if self._repo_ttl > 0:
+            threading.Thread(target=self._reaper, daemon=True).start()
+        logger.info("merge service listening on %s (%d workers, queue %d)",
+                    self._socket_path, self._workers_n, self._queue.maxsize)
+        try:
+            self._accept_loop(sock)
+        finally:
+            self._teardown(sock)
+        return 0
+
+    def _configure_process_env(self) -> None:
+        """The daemon's own process posture: never self-delegate, keep
+        normal GC cadence (``utils/gctune``: freezing per-request
+        garbage into the permanent generation would leak it), share one
+        supervised subprocess worker across requests."""
+        os.environ["_SEMMERGE_IN_DAEMON"] = "1"
+        os.environ["SEMMERGE_DAEMON"] = "off"
+        os.environ["SEMMERGE_GC_TUNE"] = "0"
+        os.environ["SEMMERGE_WORKER_KEEPALIVE"] = "1"
+
+    def _bind(self) -> Optional[socket.socket]:
+        path = self._socket_path
+        if os.path.exists(path):
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            probe.settimeout(2.0)
+            try:
+                probe.connect(path)
+            except OSError:
+                # Nothing listening: a dead daemon's leftover. Replace.
+                logger.warning("replacing stale service socket %s", path)
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+            else:
+                probe.close()
+                return None
+            finally:
+                with contextlib.suppress(OSError):
+                    probe.close()
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(path)
+        with contextlib.suppress(OSError):
+            os.chmod(path, 0o600)
+        sock.listen(64)
+        return sock
+
+    def _install_stdio_router(self) -> None:
+        if not isinstance(sys.stdout, _ThreadTee):
+            sys.stdout = _ThreadTee(sys.stdout)
+        if not isinstance(sys.stderr, _ThreadTee):
+            sys.stderr = _ThreadTee(sys.stderr)
+
+    def _install_signal_handlers(self) -> None:
+        def _on_signal(signum, frame):
+            logger.info("signal %d: draining and shutting down", signum)
+            self._stop.set()
+        try:
+            signal.signal(signal.SIGTERM, _on_signal)
+            signal.signal(signal.SIGINT, _on_signal)
+        except ValueError:
+            pass  # not the main thread (embedded/test use)
+
+    def _accept_loop(self, sock: socket.socket) -> None:
+        sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _addr = sock.accept()
+            except socket.timeout:
+                self._maybe_idle_exit()
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _maybe_idle_exit(self) -> None:
+        if self._idle_exit <= 0:
+            return
+        with self._state_lock:
+            busy = self._in_flight > 0
+        if busy or not self._queue.empty():
+            return
+        if time.monotonic() - self._last_activity > self._idle_exit:
+            logger.info("idle for %.0fs: exiting", self._idle_exit)
+            self._stop.set()
+
+    def _teardown(self, sock: socket.socket) -> None:
+        drain = env_seconds("SEMMERGE_SERVICE_DRAIN_TIMEOUT", 30.0)
+        deadline = time.monotonic() + drain if drain > 0 else None
+        while True:
+            with self._state_lock:
+                busy = self._in_flight > 0
+            if not busy and self._queue.empty():
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                logger.warning("drain timeout: abandoning in-flight work")
+                break
+            time.sleep(0.05)
+        with contextlib.suppress(OSError):
+            sock.close()
+        with contextlib.suppress(OSError):
+            os.unlink(self._socket_path)
+        from ..backends.subproc import shutdown_shared
+        shutdown_shared()
+        if self._recorder is not None:
+            obs_spans.deactivate(self._recorder)
+            with contextlib.suppress(OSError):
+                self._recorder.write_jsonl(pathlib.Path(self._events_path))
+        logger.info("merge service stopped (%d requests served)",
+                    self._served)
+
+    # ------------------------------------------------------------------
+    # connection handling (one thread per client connection)
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("r", encoding="utf-8")
+        wfile = conn.makefile("w", encoding="utf-8")
+        try:
+            while True:
+                msg = protocol.read_message(rfile)
+                if msg is None:
+                    break
+                self._last_activity = time.monotonic()
+                req_id = msg.get("id")
+                method = msg.get("method")
+                params = msg.get("params") or {}
+                if method == "hello":
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": {"ok": True, "pid": os.getpid(),
+                                   "version": protocol.PROTOCOL_VERSION}})
+                    continue
+                if method == "status":
+                    protocol.write_message(wfile,
+                                           {"id": req_id,
+                                            "result": self.status()})
+                    continue
+                if method == "shutdown":
+                    protocol.write_message(wfile,
+                                           {"id": req_id,
+                                            "result": {"ok": True}})
+                    self._stop.set()
+                    break
+                if method not in protocol.VERBS:
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "error": {"message": f"unknown method {method!r}"}})
+                    continue
+                self._serve_request(req_id, method, params, wfile)
+        except (protocol.ProtocolError, OSError, ValueError):
+            pass  # client went away or spoke garbage: drop the connection
+        finally:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def _serve_request(self, req_id, verb: str, params: Dict[str, Any],
+                       wfile) -> None:
+        req = _Request(req_id, verb, params)
+        with reqenv.overlay(req.env):
+            try:
+                with obs_spans.span("service.accept", layer="service",
+                                    verb=verb), \
+                        fault_boundary("service:accept"):
+                    faults.check("service:accept")
+                    try:
+                        self._queue.put_nowait(req)
+                    except queue.Full:
+                        raise WorkerFault(
+                            f"admission queue full "
+                            f"({self._queue.maxsize} waiting)",
+                            stage="service:accept", cause="queue-full")
+            except MergeFault as fault:
+                self._count_request(verb, "rejected")
+                protocol.write_message(wfile, {
+                    "id": req.id, "error": protocol.fault_error(fault)})
+                return
+        self._publish_queue_depth()
+        req.done.wait()
+        self._last_activity = time.monotonic()
+        if req.response is not None:
+            protocol.write_message(wfile, req.response)
+
+    # ------------------------------------------------------------------
+    # execution (executor thread pool)
+
+    def _executor(self) -> None:
+        while True:
+            try:
+                req = self._queue.get(timeout=0.3)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            self._publish_queue_depth()
+            with self._state_lock:
+                self._in_flight += 1
+            try:
+                self._execute(req)
+            finally:
+                with self._state_lock:
+                    self._in_flight -= 1
+                    self._served += 1
+                self._last_activity = time.monotonic()
+                req.done.set()
+
+    def _execute(self, req: _Request) -> None:
+        verb = req.verb
+        queue_wait = time.monotonic() - req.t_accept
+        obs_spans.record("service.queue_wait", queue_wait, layer="service",
+                         verb=verb)
+        outcome = "fault"
+        with reqenv.overlay(req.env):
+            try:
+                if req.deadline_s and queue_wait > req.deadline_s:
+                    raise DeadlineFault(
+                        f"request waited {queue_wait:.3f}s past its "
+                        f"{req.deadline_s:g}s deadline",
+                        stage="service:dispatch", cause="deadline")
+                with fault_boundary("service:dispatch"):
+                    faults.check("service:dispatch")
+                with self._repo_lock_for(req):
+                    code, out, err, t_start, t_end = self._run_cli(req)
+                outcome = _OUTCOME_BY_EXIT.get(code, f"exit-{code}")
+                req.response = {
+                    "id": req.id,
+                    "result": {
+                        "exit_code": code,
+                        "stdout": out,
+                        "stderr": err,
+                        "meta": {
+                            "pid": os.getpid(),
+                            "queue_wait_s": round(queue_wait, 6),
+                            "t_execute_start": t_start,
+                            "t_execute_end": t_end,
+                        },
+                    },
+                }
+            except MergeFault as fault:
+                req.response = {"id": req.id,
+                                "error": protocol.fault_error(fault)}
+            finally:
+                from ..frontend.declcache import publish_metrics
+                publish_metrics()
+                self._count_request(verb, outcome)
+                self._reactivate_recorder()
+
+    def _run_cli(self, req: _Request):
+        """The actual CLI invocation: ``service.execute`` span, request
+        working-dir scope, per-thread stdout/stderr capture. The span
+        opens AFTER the per-repo lock is held, so two same-repo
+        requests' execute windows never overlap — the serialization
+        test asserts exactly that."""
+        out_buf, err_buf = io.StringIO(), io.StringIO()
+        routed = isinstance(sys.stdout, _ThreadTee) and \
+            isinstance(sys.stderr, _ThreadTee)
+        if routed:
+            sys.stdout.push(out_buf)
+            sys.stderr.push(err_buf)
+        t_start = time.monotonic()
+        try:
+            with obs_spans.span("service.execute", layer="service",
+                                verb=req.verb), \
+                    fault_boundary("service:execute"), \
+                    workdir.scoped(req.cwd):
+                faults.check("service:execute")
+                from ..cli import main as cli_main
+                try:
+                    code = cli_main([req.verb, *req.argv])
+                except SystemExit as exc:  # argparse usage errors
+                    code = exc.code if isinstance(exc.code, int) else 2
+        finally:
+            t_end = time.monotonic()
+            if routed:
+                sys.stdout.pop()
+                sys.stderr.pop()
+        return code, out_buf.getvalue(), err_buf.getvalue(), t_start, t_end
+
+    def _repo_lock_for(self, req: _Request):
+        """Same-repo ``--inplace`` requests serialize; everything else
+        (read-only verbs, different repos) overlaps freely. The lock
+        key is the resolved request root — the tree being mutated."""
+        if req.verb not in ("semmerge", "semrebase") or \
+                "--inplace" not in req.argv:
+            return contextlib.nullcontext()
+        key = str(pathlib.Path(req.cwd).resolve())
+        with self._locks_lock:
+            entry = self._repo_locks.setdefault(
+                key, {"lock": threading.Lock(), "last": 0.0})
+            entry["last"] = time.time()
+        return entry["lock"]
+
+    def _reaper(self) -> None:
+        """Evict per-repo state idle past the TTL."""
+        interval = max(1.0, min(self._repo_ttl / 2.0, 60.0))
+        while not self._stop.wait(interval):
+            cutoff = time.time() - self._repo_ttl
+            with self._locks_lock:
+                for key in [k for k, e in self._repo_locks.items()
+                            if e["last"] < cutoff
+                            and not e["lock"].locked()]:
+                    del self._repo_locks[key]
+
+    def _reactivate_recorder(self) -> None:
+        """A request that ran with ``--trace`` activated (and then
+        deactivated) its own recorder; restore the daemon's events
+        recorder so capture continues across requests."""
+        if self._recorder is not None and \
+                obs_spans.current() is not self._recorder:
+            obs_spans.activate(self._recorder)
+
+    # ------------------------------------------------------------------
+    # introspection
+
+    def _count_request(self, verb: str, outcome: str) -> None:
+        obs_metrics.REGISTRY.counter(
+            "service_requests_total", _REQUESTS_HELP).inc(
+                1, verb=verb, outcome=outcome)
+
+    def _publish_queue_depth(self) -> None:
+        obs_metrics.REGISTRY.gauge(
+            "service_queue_depth", _QUEUE_DEPTH_HELP).set(
+                self._queue.qsize())
+
+    def status(self) -> Dict[str, Any]:
+        from ..frontend.declcache import global_cache
+        cache = global_cache()
+        decl = cache.stats() if cache is not None else {}
+        hits = decl.get("hits", 0)
+        lookups = hits + decl.get("misses", 0)
+        with self._state_lock:
+            in_flight, served = self._in_flight, self._served
+        return {
+            "ok": True,
+            "pid": os.getpid(),
+            "version": protocol.PROTOCOL_VERSION,
+            "socket": self._socket_path,
+            "uptime_s": round(time.time() - self._t0, 3),
+            "queue_depth": self._queue.qsize(),
+            "in_flight": in_flight,
+            "served_total": served,
+            "workers": self._workers_n,
+            "repos_tracked": len(self._repo_locks),
+            "rss_mb": round(_rss_mb(), 3),
+            "declcache": decl,
+            "declcache_hit_rate": (hits / lookups) if lookups else 0.0,
+            "metrics": obs_metrics.REGISTRY.to_dict(),
+        }
+
+
+def main(argv=None) -> int:  # pragma: no cover - thin alias
+    """``python -m semantic_merge_tpu.service.daemon`` convenience."""
+    import argparse
+    parser = argparse.ArgumentParser(prog="semmerge-daemon")
+    parser.add_argument("--socket", default=None)
+    args = parser.parse_args(argv)
+    return Daemon(socket_path=args.socket).serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""Merge-service wire protocol: newline-delimited JSON-RPC over a unix
+socket — the same framing the out-of-process language worker speaks on
+stdio (:mod:`semantic_merge_tpu.runtime.worker`), so both process seams
+in the system read the same on the wire.
+
+Request/response shapes::
+
+    → {"id": 1, "method": "semmerge",
+       "params": {"argv": ["BASE", "A", "B", "--inplace"],
+                  "cwd": "/abs/repo", "env": {"SEMMERGE_STRICT": "1"},
+                  "deadline_s": 30.0}}
+    ← {"id": 1, "result": {"exit_code": 0, "stdout": "…", "stderr": "…",
+                           "meta": {"queue_wait_s": 0.001, …}}}
+
+Verb methods are the three merge-shaped CLI commands; control methods
+are ``hello`` (startup/liveness handshake carrying the protocol
+version), ``status``, and ``shutdown``. Errors come back as
+``{"id": n, "error": {"message", "fault", "stage", "exit_code"}}`` —
+a *typed* error (``exit_code`` present) is a final answer the client
+exits with; an untyped or malformed response is a transport failure
+the client treats as daemon-unavailable.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+PROTOCOL_VERSION = 1
+
+#: CLI commands a client may delegate.
+VERBS = ("semdiff", "semmerge", "semrebase")
+
+#: Env vars NOT shipped with a request: daemon-routing knobs would
+#: recurse, SEMMERGE_METRICS is a process-atexit artifact of whichever
+#: process owns it, and the service socket is connection metadata.
+_UNSHIPPED_PREFIXES = ("SEMMERGE_SERVICE_",)
+_UNSHIPPED = frozenset({"SEMMERGE_DAEMON", "SEMMERGE_METRICS"})
+
+
+class ProtocolError(Exception):
+    """The peer spoke something that is not the protocol."""
+
+
+def socket_path(explicit: Optional[str] = None) -> str:
+    """Resolve the service socket path: explicit argument, then
+    ``SEMMERGE_SERVICE_SOCKET``, then ``$XDG_RUNTIME_DIR/semmerge.sock``,
+    then a per-uid path under ``/tmp`` (world-writable dir, so the name
+    carries the uid and the daemon binds with a 0700-style unlink/bind
+    on a path only this user should own)."""
+    if explicit:
+        return explicit
+    env = os.environ.get("SEMMERGE_SERVICE_SOCKET", "").strip()
+    if env:
+        return env
+    runtime_dir = os.environ.get("XDG_RUNTIME_DIR", "").strip()
+    if runtime_dir:
+        return os.path.join(runtime_dir, "semmerge.sock")
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return f"/tmp/semmerge-{uid}.sock"
+
+
+def request_env() -> Dict[str, str]:
+    """The client's ``SEMMERGE_*`` environment, minus the unshipped set
+    — this rides with each request and is applied daemon-side as a
+    per-request overlay (:mod:`semantic_merge_tpu.utils.reqenv`), so a
+    client's ``SEMMERGE_STRICT`` / ``SEMMERGE_FAULT`` scope to its own
+    request instead of leaking into the daemon process."""
+    out: Dict[str, str] = {}
+    for key, value in os.environ.items():
+        if not key.startswith("SEMMERGE_"):
+            continue
+        if key in _UNSHIPPED or key.startswith(_UNSHIPPED_PREFIXES):
+            continue
+        out[key] = value
+    return out
+
+
+def write_message(wfile, obj: Dict[str, Any]) -> None:
+    """One JSON object, one line, flushed — a message is visible to the
+    peer the moment this returns."""
+    wfile.write(json.dumps(obj, separators=(",", ":"),
+                           default=str) + "\n")
+    wfile.flush()
+
+
+def read_message(rfile) -> Optional[Dict[str, Any]]:
+    """The next message, ``None`` on EOF. Blank lines are skipped
+    (keepalive-friendly); a non-JSON or non-object line is a
+    :class:`ProtocolError`."""
+    while True:
+        line = rfile.readline()
+        if line == "":
+            return None
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            msg = json.loads(line)
+        except ValueError as exc:
+            raise ProtocolError(f"undecodable frame: {exc}") from exc
+        if not isinstance(msg, dict):
+            raise ProtocolError(f"frame is not an object: {type(msg).__name__}")
+        return msg
+
+
+def fault_error(fault) -> Dict[str, Any]:
+    """The wire form of a typed :class:`~semantic_merge_tpu.errors.
+    MergeFault`: everything the client needs to reproduce the one-shot
+    behavior (stderr line + documented exit code)."""
+    return {
+        "message": fault.describe(),
+        "fault": type(fault).__name__,
+        "stage": fault.stage,
+        "exit_code": fault.exit_code,
+    }

@@ -25,6 +25,27 @@ emit           ``runtime.emitter.emit_files``
 commit         ``runtime.inplace.commit_tree_inplace`` (post-journal)
 =============  ========================================================
 
+Service-daemon stages (``semantic_merge_tpu/service/daemon.py``) — the
+stage name itself contains a colon, so the parser treats a leading
+``service`` segment as part of the stage, not the kind::
+
+    SEMMERGE_FAULT=service:accept:fault     # typed fault at admission
+    SEMMERGE_FAULT=service:dispatch:fault   # typed fault at dequeue
+    SEMMERGE_FAULT=service:execute:hang=2   # wedge the executor 2 s
+
+===================  ==================================================
+stage                call site
+===================  ==================================================
+service:accept       connection handler, post-parse / pre-enqueue
+service:dispatch     executor thread, post-dequeue / pre-repo-lock
+service:execute      executor thread, inside the execute span
+===================  ==================================================
+
+Inside the daemon the injection spec and the per-stage hit counters are
+read through the request overlay (:mod:`semantic_merge_tpu.utils.
+reqenv`): each request carries its client's ``SEMMERGE_FAULT`` and gets
+fresh counters, exactly like the one-shot process it replaces.
+
 Kinds:
 
 - ``raise`` — a plain ``RuntimeError`` (exercises the CLI's stage
@@ -50,8 +71,13 @@ import time
 from typing import Dict, Optional
 
 from ..errors import fault_for_stage
+from . import reqenv
 
 ENV_VAR = "SEMMERGE_FAULT"
+
+#: Stage names that contain a colon themselves (the service daemon's
+#: stages) — the parser joins the first two segments for these.
+COMPOUND_STAGE_PREFIX = "service"
 
 _counters: Dict[str, int] = {}
 
@@ -66,6 +92,9 @@ def _parse(env: str):
     parts = env.strip().split(":")
     if not parts or not parts[0]:
         return None
+    if parts[0] == COMPOUND_STAGE_PREFIX and len(parts) > 1 and parts[1]:
+        # service:<substage>[:kind[:nth]] — the stage IS two segments.
+        parts = [f"{parts[0]}:{parts[1]}"] + parts[2:]
     stage = parts[0]
     kind = parts[1] if len(parts) > 1 and parts[1] else "raise"
     nth = None
@@ -90,14 +119,17 @@ def check(stage: str) -> Optional[str]:
     """Injection point: fire the configured fault when ``stage``
     matches. Returns ``None`` (no spec / not this stage / not this
     hit), or the kind token for site-specific kinds."""
-    env = os.environ.get(ENV_VAR)
+    env = reqenv.get(ENV_VAR)
     if not env:
         return None
     spec = _parse(env)
     if spec is None or spec[0] != stage:
         return None
     _, kind, nth = spec
-    count = _counters[stage] = _counters.get(stage, 0) + 1
+    ov = reqenv.active()
+    counters = (_counters if ov is None
+                else ov.setdefault("__fault_counters__", {}))
+    count = counters[stage] = counters.get(stage, 0) + 1
     if nth is not None and count != nth:
         return None
     if kind == "raise":

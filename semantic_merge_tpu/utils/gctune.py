@@ -16,11 +16,19 @@ policy.
 from __future__ import annotations
 
 import gc
+import os
 
 
 def tune_for_merge() -> None:
     """Freeze everything allocated so far into the permanent generation
-    and raise collection thresholds. Idempotent; cheap to call again."""
+    and raise collection thresholds. Idempotent; cheap to call again.
+
+    ``SEMMERGE_GC_TUNE=0`` disables the tuning: long-running processes
+    (the merge service daemon sets it for itself) must keep normal
+    collection cadence — freezing per-request garbage into the
+    permanent generation would leak it for the process lifetime."""
+    if os.environ.get("SEMMERGE_GC_TUNE", "").strip() == "0":
+        return
     gc.collect()
     gc.freeze()
     gc.set_threshold(100_000, 50, 50)

@@ -1,0 +1,57 @@
+"""Per-request working directory — the daemon's cwd seam.
+
+Every one-shot entry point resolves repo-relative work (git plumbing,
+``.semmerge.toml`` discovery, conflict/trace artifacts, the in-place
+commit root) against the process cwd. That is correct for a CLI that
+``cd``s into the repo, but the merge service daemon
+(:mod:`semantic_merge_tpu.service`) executes requests for *arbitrary*
+repos from one process — and ``os.chdir`` is process-global, so two
+concurrent requests cannot each own the process cwd.
+
+This module is the seam: a :class:`contextvars.ContextVar` holding the
+request's repo root. Call sites that used to default to
+``pathlib.Path.cwd()`` default to :func:`root` instead, which returns
+the active request root when one is set and the process cwd otherwise —
+byte-identical behavior for every one-shot path (the var is never set
+there), explicit roots for daemon worker threads. ContextVars are
+per-thread by construction, so each executor thread scoping a request
+with :func:`scoped` sees only its own root.
+"""
+from __future__ import annotations
+
+import contextlib
+import pathlib
+from contextvars import ContextVar
+from typing import Iterator, Optional
+
+_ROOT: "ContextVar[Optional[str]]" = ContextVar("semmerge_workdir", default=None)
+
+
+def current() -> Optional[pathlib.Path]:
+    """The scoped request root, or ``None`` outside any request scope
+    (callers that pass ``cwd=None`` to subprocesses want exactly that)."""
+    value = _ROOT.get()
+    return pathlib.Path(value) if value is not None else None
+
+
+def root() -> pathlib.Path:
+    """The directory repo-relative work resolves against: the scoped
+    request root when inside one, the process cwd otherwise."""
+    return current() or pathlib.Path.cwd()
+
+
+def path(rel: str) -> pathlib.Path:
+    """A repo-relative artifact path (``.semmerge-conflicts.json`` and
+    friends) under :func:`root`."""
+    return root() / rel
+
+
+@contextlib.contextmanager
+def scoped(new_root: pathlib.Path | str) -> Iterator[pathlib.Path]:
+    """Scope the working directory for the current thread/context."""
+    resolved = pathlib.Path(new_root).resolve()
+    token = _ROOT.set(str(resolved))
+    try:
+        yield resolved
+    finally:
+        _ROOT.reset(token)

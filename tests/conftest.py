@@ -9,8 +9,17 @@ entry points ``__graft_entry__.dryrun_multichip`` and ``bench.py``) and
 must run before the first jax backend initialisation.
 """
 import os
+import subprocess
 import sys
 import pathlib
+import time
+
+import pytest
+
+# Hermeticity: no test (or subprocess a test spawns) silently delegates
+# to a merge service daemon unless it opts in explicitly — auto mode in
+# e.g. the driver tests would leak spawned daemons across the suite.
+os.environ.setdefault("SEMMERGE_DAEMON", "off")
 
 # Persistent XLA compilation cache: device-kernel tests compile a handful
 # of padded shapes; caching makes repeat suite runs take seconds.
@@ -21,3 +30,71 @@ from semantic_merge_tpu.utils.jaxenv import enable_compile_cache, force_cpu  # n
 enable_compile_cache()
 
 force_cpu(8)
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def spawn_service_daemon(socket_path: str, extra_env=None,
+                         timeout: float = 60.0) -> subprocess.Popen:
+    """Start a merge service daemon on ``socket_path`` and wait for its
+    handshake. Shared by the service tests and the fault matrix."""
+    from semantic_merge_tpu.service import client as service_client
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(_REPO_ROOT)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEMMERGE_DAEMON"] = "off"
+    env.pop("SEMMERGE_FAULT", None)
+    env.pop("SEMMERGE_METRICS", None)
+    log = open(socket_path + ".log", "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "semantic_merge_tpu", "serve",
+         "--socket", socket_path],
+        stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+        cwd="/", env=env, start_new_session=True)
+    log.close()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        conn = service_client._try_connect(socket_path, timeout=2.0)
+        if conn is not None:
+            service_client._close(*conn)
+            return proc
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"daemon exited rc={proc.returncode} during startup "
+                f"(log: {socket_path}.log)")
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError(f"daemon did not come up within {timeout:g}s")
+
+
+@pytest.fixture
+def daemon_factory():
+    """Spawn dedicated daemons a test may kill or wedge without
+    poisoning the shared session daemon. Leftovers are killed."""
+    procs = []
+
+    def _spawn(socket_path: str, **kwargs) -> subprocess.Popen:
+        proc = spawn_service_daemon(socket_path, **kwargs)
+        procs.append(proc)
+        return proc
+
+    yield _spawn
+    for proc in procs:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+@pytest.fixture(scope="session")
+def service_daemon(tmp_path_factory):
+    """One warm daemon for the whole session (jax import + compile are
+    paid once). Tests that kill or wedge a daemon spawn their own."""
+    sock = str(tmp_path_factory.mktemp("svc") / "daemon.sock")
+    proc = spawn_service_daemon(sock)
+    yield sock
+    from semantic_merge_tpu.service import client as service_client
+    try:
+        service_client.call_control("shutdown", path=sock)
+        proc.wait(timeout=15)
+    except Exception:
+        proc.kill()

@@ -237,6 +237,76 @@ def test_nth_hit_selector(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# Service stages: injected daemon faults land as typed wire errors
+# ---------------------------------------------------------------------------
+
+def _daemon_client_env(sock: str, **extra) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEMMERGE_DAEMON"] = "require"
+    env["SEMMERGE_SERVICE_SOCKET"] = sock
+    env.pop("SEMMERGE_FAULT", None)
+    env.update(extra)
+    return env
+
+
+SERVICE_FAULT_MATRIX = [
+    # Every daemon request stage classifies as WorkerFault (the daemon
+    # is an out-of-process worker from the client's point of view) and
+    # must come back over the wire with its exit code preserved.
+    ("service:accept", WorkerFault.exit_code),
+    ("service:dispatch", WorkerFault.exit_code),
+    ("service:execute", WorkerFault.exit_code),
+]
+
+
+@pytest.mark.parametrize("stage,code", SERVICE_FAULT_MATRIX)
+def test_service_stage_fault_is_typed_wire_error(repo, service_daemon,
+                                                 stage, code):
+    """``SEMMERGE_FAULT`` rides the request env overlay: the injected
+    stage fault fails THIS request with the documented exit code, the
+    work tree stays untouched, and the daemon serves the next request
+    — faults degrade or return typed errors, never kill the daemon."""
+    before = tree_state(repo)
+    proc = subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+         "basebr", "brA", "brB", "--inplace", "--backend", "host"],
+        cwd=repo, capture_output=True, text=True,
+        env=_daemon_client_env(service_daemon,
+                               SEMMERGE_FAULT=f"{stage}:fault"))
+    assert proc.returncode == code, \
+        f"{stage}:fault must exit {code} over the wire: {proc.stderr}"
+    assert "WorkerFault" in proc.stderr
+    assert tree_state(repo) == before, \
+        "a service-stage fault must leave the work tree bitwise untouched"
+    # The daemon survived and completes the identical request cleanly.
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+         "basebr", "brA", "brB", "--inplace", "--backend", "host"],
+        cwd=repo, capture_output=True, text=True,
+        env=_daemon_client_env(service_daemon))
+    assert proc2.returncode == 0, proc2.stderr
+    assert "bar" in (repo / "src/util.ts").read_text()
+
+
+def test_service_stages_registered_as_worker_faults():
+    from semantic_merge_tpu.errors import STAGE_FAULTS
+    for stage in ("service:accept", "service:dispatch", "service:execute"):
+        assert STAGE_FAULTS[stage] is WorkerFault
+    # The compound stage survives SEMMERGE_FAULT's colon syntax.
+    faults.reset()
+    try:
+        os.environ["SEMMERGE_FAULT"] = "service:dispatch:raise:2"
+        assert faults.check("service:dispatch") is None
+        with pytest.raises(RuntimeError):
+            faults.check("service:dispatch")
+    finally:
+        os.environ.pop("SEMMERGE_FAULT", None)
+        faults.reset()
+
+
+# ---------------------------------------------------------------------------
 # No fault injected: clean merge, no degradations recorded
 # ---------------------------------------------------------------------------
 

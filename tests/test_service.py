@@ -1,0 +1,400 @@
+"""Merge service daemon (ISSUE 7 tentpole): one-shot parity, warm-path
+fallback, and concurrency semantics.
+
+The bar the daemon must clear:
+
+- **Parity** — a request served by the daemon produces the same exit
+  code, the same work-tree bytes, the same conflicts artifact, and the
+  same git notes as the identical one-shot invocation. Byte-for-byte,
+  across clean merges, conflicts, and strict-mode typed faults.
+- **Never worse than one-shot** — under ``SEMMERGE_DAEMON=auto``, a
+  daemon SIGKILLed mid-request (or one that cannot bind/spawn at all)
+  must not fail a merge the one-shot path would complete: the client
+  falls back in-process and the tree matches the one-shot result.
+- **Admission/locking** — same-repo ``--inplace`` requests serialize
+  (their ``service.execute`` windows are disjoint); different-repo
+  requests overlap on the executor pool.
+"""
+import contextlib
+import hashlib
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from semantic_merge_tpu.cli import CONFLICTS_ARTIFACT, main
+from semantic_merge_tpu.errors import ApplyFault, ParseFault, WorkerFault
+from semantic_merge_tpu.runtime import inplace
+from semantic_merge_tpu.utils import faults
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+ARTIFACTS = {".semmerge-conflicts.json", ".semmerge-trace.json",
+             ".semmerge-events.jsonl", ".semmerge-journal.json"}
+
+MERGE_ARGV = ["semmerge", "basebr", "brA", "brB",
+              "--inplace", "--backend", "host"]
+
+
+def git(args, cwd):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def commit_all(root, msg):
+    git(["add", "-A"], root)
+    env = {"GIT_AUTHOR_DATE": "2024-01-01T00:00:00Z",
+           "GIT_COMMITTER_DATE": "2024-01-01T00:00:00Z"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        git(["commit", "-q", "-m", msg], root)
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+
+
+def build_repo(root: pathlib.Path, conflict: bool = False) -> pathlib.Path:
+    """The test_faults repo shape, buildable at any path (parity needs
+    two bit-identical repos — pinned dates make the commit shas equal,
+    so notes comparisons line up too). ``conflict=True`` adds opposing
+    edits to the same ``notes.txt`` line: a guaranteed textual conflict
+    (exit 1) while the semantic .ts merge still succeeds."""
+    root.mkdir(parents=True)
+    git(["init", "-q", "-b", "main"], root)
+    git(["config", "user.email", "t@example.com"], root)
+    git(["config", "user.name", "t"], root)
+    (root / "src").mkdir()
+    (root / "src/util.ts").write_text(
+        "export function foo(n: number): number {\n  return n;\n}\n")
+    (root / "notes.txt").write_text("hello\n")
+    commit_all(root, "base")
+    git(["branch", "basebr"], root)
+    git(["checkout", "-qb", "brA"], root)
+    (root / "src/util.ts").write_text(
+        "export function bar(n: number): number {\n  return n;\n}\n")
+    if conflict:
+        (root / "notes.txt").write_text("hello-from-A\n")
+    commit_all(root, "rename foo->bar")
+    git(["checkout", "-q", "main"], root)
+    git(["checkout", "-qb", "brB"], root)
+    (root / "extra.ts").write_text(
+        "export function extra(s: string): string { return s; }\n")
+    (root / "notes.txt").write_text(
+        "hello-from-B\n" if conflict else "hello\nworld\n")
+    commit_all(root, "add extra + edit notes")
+    git(["checkout", "-q", "main"], root)
+    return root
+
+
+def tree_state(root: pathlib.Path) -> dict:
+    out = {}
+    for p in sorted(root.rglob("*")):
+        if not p.is_file():
+            continue
+        rel = p.relative_to(root).as_posix()
+        if rel.startswith(".git/") or rel.split("/")[0] in ARTIFACTS \
+                or rel.startswith(inplace.STAGE_DIR + "/"):
+            continue
+        out[rel] = hashlib.sha256(p.read_bytes()).hexdigest()
+    return out
+
+
+def semmerge_notes(root: pathlib.Path) -> dict:
+    """``git notes --ref semmerge`` payloads for both merged heads —
+    ``(rc, stdout)`` so "no note" (rc 1) compares equal too."""
+    out = {}
+    for rev in ("brA", "brB"):
+        proc = subprocess.run(
+            ["git", "notes", "--ref", "semmerge", "show", rev],
+            cwd=root, capture_output=True, text=True)
+        out[rev] = (proc.returncode, proc.stdout)
+    return out
+
+
+@contextlib.contextmanager
+def oneshot_env(cwd: pathlib.Path, extra: dict):
+    """Run the in-process one-shot CLI exactly as a fresh shell would:
+    chdir into the repo, daemon mode off, scenario env applied, fault
+    counters reset — and everything restored afterwards."""
+    keys = {"SEMMERGE_DAEMON", "SEMMERGE_FAULT", "SEMMERGE_STRICT"} \
+        | set(extra)
+    saved = {k: os.environ.get(k) for k in keys}
+    old_cwd = os.getcwd()
+    os.chdir(cwd)
+    os.environ["SEMMERGE_DAEMON"] = "off"
+    os.environ.pop("SEMMERGE_FAULT", None)
+    os.environ.pop("SEMMERGE_STRICT", None)
+    os.environ.update(extra)
+    faults.reset()
+    try:
+        yield
+    finally:
+        faults.reset()
+        os.chdir(old_cwd)
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+
+
+def client_env(sock: str, **extra) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEMMERGE_DAEMON"] = "require"
+    env["SEMMERGE_SERVICE_SOCKET"] = sock
+    env.pop("SEMMERGE_FAULT", None)
+    env.pop("SEMMERGE_STRICT", None)
+    env.update(extra)
+    return env
+
+
+def run_client(repo: pathlib.Path, env: dict, *argv, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu",
+         *(argv or MERGE_ARGV)],
+        cwd=repo, capture_output=True, text=True, env=env, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# Byte parity: daemon ≡ one-shot
+# ---------------------------------------------------------------------------
+
+PARITY_SCENARIOS = [
+    # (repo shape, request env, documented exit code)
+    pytest.param("clean", {}, 0, id="clean-merge-exit0"),
+    pytest.param("conflict", {}, 1, id="textual-conflict-exit1"),
+    pytest.param("clean",
+                 {"SEMMERGE_FAULT": "scan:fault", "SEMMERGE_STRICT": "1"},
+                 ParseFault.exit_code, id="strict-parse-fault-exit10"),
+    pytest.param("clean",
+                 {"SEMMERGE_FAULT": "apply:fault", "SEMMERGE_STRICT": "1"},
+                 ApplyFault.exit_code, id="strict-apply-fault-exit13"),
+]
+
+
+@pytest.mark.parametrize("shape,extra_env,expected", PARITY_SCENARIOS)
+def test_daemon_matches_one_shot(tmp_path, service_daemon, shape,
+                                 extra_env, expected):
+    """The acceptance bar: same exit code, same tree bytes, same
+    conflicts artifact, same notes — whether the merge ran one-shot or
+    through the warm daemon (request env overlay carrying the scenario's
+    fault/strict posture)."""
+    one = build_repo(tmp_path / "oneshot", conflict=shape == "conflict")
+    two = build_repo(tmp_path / "daemon", conflict=shape == "conflict")
+    with oneshot_env(one, extra_env):
+        rc_one = main(MERGE_ARGV)
+    assert rc_one == expected
+
+    proc = run_client(two, client_env(service_daemon, **extra_env))
+    assert proc.returncode == rc_one, \
+        f"daemon exit {proc.returncode} != one-shot {rc_one}: {proc.stderr}"
+    assert tree_state(one) == tree_state(two), \
+        "daemon and one-shot must produce byte-identical work trees"
+    art_one = one / CONFLICTS_ARTIFACT
+    art_two = two / CONFLICTS_ARTIFACT
+    assert art_one.exists() == art_two.exists()
+    if art_one.exists():
+        assert json.loads(art_one.read_text()) == \
+            json.loads(art_two.read_text())
+    assert semmerge_notes(one) == semmerge_notes(two)
+
+
+# ---------------------------------------------------------------------------
+# auto mode: never worse than one-shot
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_daemon_mid_request_auto_falls_back(tmp_path,
+                                                    daemon_factory):
+    """SIGKILL the daemon while it holds the request wedged inside
+    ``service:execute`` (hang fault): the auto-mode client must detect
+    the dead transport, fall back in-process, and complete the merge
+    with the exact one-shot tree — the dead daemon never touched it."""
+    repo = build_repo(tmp_path / "repo")
+    ref = build_repo(tmp_path / "ref")
+    sock = str(tmp_path / "kill.sock")
+    daemon_proc = daemon_factory(sock)
+
+    from semantic_merge_tpu.service import client as svc
+    client = subprocess.Popen(
+        [sys.executable, "-m", "semantic_merge_tpu", *MERGE_ARGV],
+        cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+        env=client_env(sock, SEMMERGE_DAEMON="auto",
+                       SEMMERGE_FAULT="service:execute:hang=120"))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if svc.call_control("status", path=sock)["in_flight"] >= 1:
+            break
+        time.sleep(0.1)
+    else:
+        client.kill()
+        pytest.fail("request never reached the daemon's execute stage")
+    os.kill(daemon_proc.pid, signal.SIGKILL)
+
+    _out, err = client.communicate(timeout=300)
+    assert client.returncode == 0, \
+        f"auto mode must fall back to a clean one-shot merge: {err}"
+    with oneshot_env(ref, {}):
+        assert main(MERGE_ARGV) == 0
+    assert tree_state(repo) == tree_state(ref), \
+        "fallback tree must match the one-shot result"
+    assert not (repo / ".semmerge-journal.json").exists()
+
+
+def test_auto_mode_spawns_daemon_when_absent(tmp_path):
+    """auto with no daemon on the socket spawns one (handshake-gated),
+    runs the merge warm, and leaves the daemon serving."""
+    from semantic_merge_tpu.service import client as svc
+    repo = build_repo(tmp_path / "repo")
+    sock = str(tmp_path / "auto.sock")
+    pid = None
+    try:
+        proc = run_client(repo, client_env(sock, SEMMERGE_DAEMON="auto"))
+        assert proc.returncode == 0, proc.stderr
+        assert "bar" in (repo / "src/util.ts").read_text()
+        st = svc.call_control("status", path=sock)
+        pid = st["pid"]
+        assert st["served_total"] >= 1
+    finally:
+        with contextlib.suppress(Exception):
+            svc.call_control("shutdown", path=sock)
+        if pid is not None:
+            for _ in range(150):
+                try:
+                    os.kill(pid, 0)
+                except OSError:
+                    break
+                time.sleep(0.1)
+            else:
+                with contextlib.suppress(OSError):
+                    os.kill(pid, signal.SIGKILL)
+
+
+def test_require_mode_without_daemon_exits_worker_code(tmp_path,
+                                                       monkeypatch):
+    """Client postures, in-process (spawn stubbed to an immediate
+    failure): require → WorkerFault exit; auto → ``None`` (fall back);
+    non-verb invocations never delegate."""
+    from semantic_merge_tpu.service import client as svc
+    assert svc._REQUIRE_FAILED_EXIT == WorkerFault.exit_code
+    monkeypatch.setenv("SEMMERGE_SERVICE_SOCKET",
+                       str(tmp_path / "absent.sock"))
+
+    class _DeadProc:
+        returncode = 1
+
+        def poll(self):
+            return self.returncode
+
+    monkeypatch.setattr(svc, "_spawn_daemon", lambda path: _DeadProc())
+    monkeypatch.setenv("SEMMERGE_DAEMON", "require")
+    assert svc.delegate(["semmerge", "basebr", "brA", "brB"]) == \
+        WorkerFault.exit_code
+    monkeypatch.setenv("SEMMERGE_DAEMON", "auto")
+    assert svc.delegate(["semmerge", "basebr", "brA", "brB"]) is None
+    monkeypatch.setenv("SEMMERGE_DAEMON", "require")
+    assert svc.delegate(["stats"]) is None
+
+
+# ---------------------------------------------------------------------------
+# Admission control: same-repo serialize, different-repo overlap
+# ---------------------------------------------------------------------------
+
+
+def _fire_requests(sock: str, requests: list) -> list:
+    """Issue protocol requests concurrently; return response frames."""
+    from semantic_merge_tpu.service import client as svc
+    frames = [None] * len(requests)
+
+    def _one(i, params):
+        frames[i] = svc.call_verb("semmerge", params, path=sock,
+                                  timeout=240)
+
+    threads = [threading.Thread(target=_one, args=(i, p))
+               for i, p in enumerate(requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    return frames
+
+
+def _execute_windows(frames: list) -> list:
+    metas = []
+    for frame in frames:
+        assert frame is not None, "request thread did not complete"
+        result = frame.get("result")
+        assert result is not None, f"unexpected error frame: {frame}"
+        assert result["exit_code"] == 0, result["stderr"]
+        assert result["meta"]["queue_wait_s"] >= 0.0
+        metas.append(result["meta"])
+    return sorted(metas, key=lambda m: m["t_execute_start"])
+
+
+def test_same_repo_inplace_requests_serialize(tmp_path, service_daemon):
+    """Two concurrent ``--inplace`` requests against ONE repo take the
+    per-repo lock: their ``service.execute`` windows (opened after the
+    lock) must be disjoint. The 1s hang fault makes each window long
+    enough that accidental serialization can't explain the result."""
+    repo = build_repo(tmp_path / "repo")
+    params = {
+        "argv": MERGE_ARGV[1:],
+        "cwd": str(repo),
+        "env": {"SEMMERGE_FAULT": "service:execute:hang=1"},
+    }
+    first, second = _execute_windows(
+        _fire_requests(service_daemon, [dict(params), dict(params)]))
+    assert first["t_execute_end"] <= second["t_execute_start"], \
+        "same-repo --inplace execute windows must not overlap"
+    assert first["t_execute_end"] - first["t_execute_start"] >= 1.0
+    assert "bar" in (repo / "src/util.ts").read_text()
+    assert not (repo / ".semmerge-journal.json").exists()
+
+
+def test_different_repo_requests_overlap(tmp_path, service_daemon):
+    """Requests against different repos (no --inplace → no repo lock)
+    run on the executor pool concurrently: with each request wedged
+    1.5s inside execute, the windows must overlap."""
+    repos = [build_repo(tmp_path / f"repo{i}") for i in range(2)]
+    requests = [{
+        "argv": ["basebr", "brA", "brB", "--backend", "host"],
+        "cwd": str(repo),
+        "env": {"SEMMERGE_FAULT": "service:execute:hang=1.5"},
+    } for repo in repos]
+    first, second = _execute_windows(
+        _fire_requests(service_daemon, requests))
+    assert second["t_execute_start"] < first["t_execute_end"], \
+        "different-repo requests must execute concurrently"
+
+
+# ---------------------------------------------------------------------------
+# Socket lifecycle units (in-process, no daemon subprocess)
+# ---------------------------------------------------------------------------
+
+
+def test_stale_socket_replaced_live_socket_respected(tmp_path):
+    from semantic_merge_tpu.service.daemon import Daemon
+    path = str(tmp_path / "svc.sock")
+    dead = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    dead.bind(path)
+    dead.close()  # the file remains, nothing listens: a stale socket
+    assert os.path.exists(path)
+
+    listener = Daemon(socket_path=path)._bind()
+    assert listener is not None, "a stale socket must be replaced"
+    try:
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        probe.connect(path)  # genuinely listening now
+        probe.close()
+        # A second daemon probing a LIVE socket steps aside.
+        assert Daemon(socket_path=path)._bind() is None
+    finally:
+        listener.close()

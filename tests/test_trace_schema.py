@@ -72,6 +72,50 @@ def test_degradation_records_validate(schema, tmp_path):
     assert "degradation" in names
 
 
+def test_service_records_validate(schema, tmp_path):
+    """A trace carrying the merge-service layer's records — the three
+    ``service.*`` request spans and the service metric series — must
+    validate; drifted shapes (renamed span, missing verb meta, labeled
+    queue-depth gauge) are rejected."""
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    tracer = trace_mod.Tracer(enabled=True)
+    with tracer.phase("merge", backend="host"):
+        obs_spans.record("service.accept", 0.001, layer="service",
+                         verb="semmerge")
+        obs_spans.record("service.queue_wait", 0.0, layer="service",
+                         verb="semmerge")
+        obs_spans.record("service.execute", 0.25, layer="service",
+                         verb="semmerge")
+    obs_metrics.REGISTRY.counter("service_requests_total", "t").inc(
+        1, verb="semmerge", outcome="ok")
+    obs_metrics.REGISTRY.gauge("service_queue_depth", "t").set(0)
+    obs_metrics.REGISTRY.counter("declcache_hits_total", "t").inc(3)
+    trace = tmp_path / ".semmerge-trace.json"
+    tracer.write(trace)
+    data = json.loads(trace.read_text())
+    assert schema.validate_trace(data) == []
+    assert schema.validate_service(data) == []
+
+    broken = json.loads(trace.read_text())
+    for s in broken["spans"]:
+        if s["name"] == "service.execute":
+            s["name"] = "service.exec2"
+    assert any("unknown service span" in e
+               for e in schema.validate_service(broken))
+
+    broken = json.loads(trace.read_text())
+    for s in broken["spans"]:
+        if s["name"].startswith("service."):
+            s.get("meta", {}).pop("verb", None)
+    assert any("verb" in e for e in schema.validate_service(broken))
+
+    broken = json.loads(trace.read_text())
+    gauge = broken["metrics"]["gauges"]["service_queue_depth"]
+    gauge["series"][0]["labels"] = {"socket": "x"}
+    assert any("no labels" in e for e in schema.validate_service(broken))
+
+
 def test_script_cli_exit_codes(artifacts):
     trace, events = artifacts
     ok = subprocess.run([sys.executable, str(_SCRIPT), str(trace),

@@ -447,6 +447,7 @@ PRESETS = {
     "rung5i": {"files": 10000, "decls": 4, "changed": 200},
     "strict": {"files": 10000, "decls": 4, "strict": True},
     "warmserve": {"files": 48, "decls": 4, "warmserve": True},
+    "batchserve": {"files": 48, "decls": 4, "batchserve": True},
 }
 
 
@@ -707,6 +708,215 @@ def run_warmserve_bench(record: dict, args, json_only: bool = False) -> int:
         shutil.rmtree(scratch, ignore_errors=True)
 
 
+def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
+    """The ``batchserve`` preset: what continuous batching buys a WARM
+    daemon under concurrent load. One daemon (16 workers, single-device
+    engine via ``SEMMERGE_MESH=off`` so every request is batch-eligible)
+    serves the same synthetic merge at client concurrency 1 / 4 / 16;
+    overlapping fused dispatches coalesce into batched multi-merge
+    programs. Parity gates the number: a ``SEMMERGE_BATCH=require``
+    run and a ``SEMMERGE_BATCH=off`` run must exit identically and
+    leave byte-identical git-notes op-log payloads. Additive BENCH
+    fields: ``serial_merges_per_sec``, ``batch_merges_per_sec_c4`` /
+    ``_c16``, ``batch_speedup_c16``, ``batch_p50_ms`` /
+    ``batch_p99_ms`` (c16 request latency), ``mean_batch_size``,
+    ``batch_padding_waste_ratio``, ``batch_program_cache_hit_rate``."""
+    import shutil
+    import statistics
+    import subprocess
+    import tempfile
+    import threading
+
+    from semantic_merge_tpu.service import client as svc_client
+
+    scratch = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-batchserve-"))
+    repo = scratch / "repo"
+    sock = str(scratch / "daemon.sock")
+    _build_service_repo(repo, args.files, args.decls)
+
+    child_env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    prior_pp = child_env.get("PYTHONPATH", "")
+    child_env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{prior_pp}"
+                               if prior_pp else pkg_root)
+    child_env["SEMMERGE_DAEMON"] = "off"
+    child_env.pop("SEMMERGE_FAULT", None)
+    child_env.pop("SEMMERGE_METRICS", None)
+    # The batching daemon's deployment posture: fill the chip by
+    # coalescing requests, not by dp-sharding a single merge.
+    child_env["SEMMERGE_MESH"] = "off"
+    child_env["SEMMERGE_SERVICE_WORKERS"] = "16"
+    child_env.setdefault("SEMMERGE_BATCH_WINDOW_MS", "25")
+    if os.environ.get("SEMMERGE_BENCH_PLATFORM") == "cpu":
+        child_env["JAX_PLATFORMS"] = "cpu"
+    merge_argv = ["semmerge", "basebr", "brA", "brB", "--backend", "tpu"]
+
+    def notes_blobs():
+        blobs = []
+        for rev in ("brA", "brB"):
+            p = subprocess.run(
+                ["git", "notes", "--ref", "semmerge", "show", rev],
+                cwd=repo, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True)
+            blobs.append((p.returncode, p.stdout))
+        return blobs
+
+    def request(posture=None):
+        env = {} if posture is None else {"SEMMERGE_BATCH": posture}
+        t0 = time.perf_counter()
+        frame = svc_client.call_verb(
+            "semmerge",
+            {"argv": merge_argv[1:], "cwd": str(repo), "env": env},
+            path=sock, timeout=600)
+        wall = time.perf_counter() - t0
+        result = frame.get("result") or {}
+        return result.get("exit_code"), wall, frame
+
+    def drive(concurrency: int, per_thread: int):
+        """``concurrency`` client threads, ``per_thread`` requests
+        each, released together; returns (walls, total_wall, errors)."""
+        walls, errors = [], []
+        lock = threading.Lock()
+        barrier = threading.Barrier(concurrency)
+
+        def worker():
+            try:
+                barrier.wait()
+                for _ in range(per_thread):
+                    code, wall, frame = request()
+                    with lock:
+                        if code != 0:
+                            errors.append(f"request exit {code}: {frame}")
+                            return
+                        walls.append(wall)
+            except Exception as exc:
+                with lock:
+                    errors.append(f"client thread died: {exc}")
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        return walls, time.perf_counter() - t0, errors
+
+    daemon = None
+    try:
+        log = open(sock + ".log", "ab")
+        daemon = subprocess.Popen(
+            [sys.executable, "-m", "semantic_merge_tpu", "serve",
+             "--socket", sock],
+            stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+            cwd="/", env=child_env, start_new_session=True)
+        log.close()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            conn = svc_client._try_connect(sock, timeout=2.0)
+            if conn is not None:
+                svc_client._close(*conn)
+                break
+            if daemon.poll() is not None:
+                record["error"] = (f"daemon exited rc={daemon.returncode} "
+                                   f"during startup (log: {sock}.log)")
+                print(json.dumps(record), flush=True)
+                return 1
+            time.sleep(0.1)
+        else:
+            record["error"] = "daemon did not come up within 120s"
+            print(json.dumps(record), flush=True)
+            return 1
+
+        # Parity gate (doubles as warm-up of the B=1 batched program):
+        # require-batched vs forced-unbatched, byte-identical notes.
+        for posture in ("require", "require"):  # 2nd run is cache-warm
+            code, _, frame = request(posture)
+            if code != 0:
+                record["error"] = f"batched warm-up failed: {frame}"
+                print(json.dumps(record), flush=True)
+                return 1
+        batched_notes = notes_blobs()
+        code, _, frame = request("off")
+        if code != 0:
+            record["error"] = f"unbatched parity run failed: {frame}"
+            print(json.dumps(record), flush=True)
+            return 1
+        parity = (notes_blobs() == batched_notes)
+        record["parity"] = bool(parity)
+
+        # Untimed c16 burst: compiles the larger-B batched programs so
+        # the timed sweep measures steady state, as the other presets do.
+        _, _, errs = drive(16, 1)
+        if errs:
+            record["error"] = f"warm burst failed: {errs[0]}"
+            print(json.dumps(record), flush=True)
+            return 1
+
+        walls1, total1, errs1 = drive(1, 6)
+        walls4, total4, errs4 = drive(4, 4)
+        walls16, total16, errs16 = drive(16, 2)
+        for errs in (errs1, errs4, errs16):
+            if errs:
+                record["error"] = errs[0]
+                print(json.dumps(record), flush=True)
+                return 1
+        serial_rate = len(walls1) / total1
+        rate4 = len(walls4) / total4
+        rate16 = len(walls16) / total16
+        lat = sorted(walls16)
+        p50 = statistics.median(lat)
+        p99 = lat[min(len(lat) - 1, round(0.99 * (len(lat) - 1)))]
+
+        status = svc_client.call_control("status", path=sock, timeout=30)
+        batch = status.get("batch") or {}
+        cache = batch.get("program_cache") or {}
+
+        record["metric"] = (
+            f"merges/sec (continuous batching, warm daemon, concurrency "
+            f"16 vs 1, {args.files} files x {args.decls} decls, "
+            f"parity={'ok' if parity else 'FAIL'})")
+        record["value"] = round(rate16, 2)
+        record["unit"] = "merges/sec"
+        record["vs_baseline"] = round(rate16 / serial_rate, 3)
+        record["serial_merges_per_sec"] = round(serial_rate, 2)
+        record["batch_merges_per_sec_c4"] = round(rate4, 2)
+        record["batch_merges_per_sec_c16"] = round(rate16, 2)
+        record["batch_speedup_c16"] = round(rate16 / serial_rate, 3)
+        record["batch_p50_ms"] = round(p50 * 1e3, 1)
+        record["batch_p99_ms"] = round(p99 * 1e3, 1)
+        record["mean_batch_size"] = round(
+            float(batch.get("mean_batch_size", 0.0)), 3)
+        record["batch_padding_waste_ratio"] = round(
+            float(batch.get("padding_waste_ratio", 0.0)), 4)
+        record["batch_program_cache_hit_rate"] = round(
+            float(cache.get("hit_rate", 0.0)), 4)
+        if not json_only:
+            print(f"# serial (c1):  {serial_rate:6.2f} merges/sec",
+                  file=sys.stderr)
+            print(f"# batched (c4): {rate4:6.2f} merges/sec",
+                  file=sys.stderr)
+            print(f"# batched (c16):{rate16:6.2f} merges/sec "
+                  f"({rate16 / serial_rate:.1f}x serial)  "
+                  f"p50={p50 * 1e3:.0f}ms p99={p99 * 1e3:.0f}ms",
+                  file=sys.stderr)
+            print(f"# mean batch size: {record['mean_batch_size']}  "
+                  f"padding waste: {record['batch_padding_waste_ratio']}  "
+                  f"program cache hit rate: "
+                  f"{record['batch_program_cache_hit_rate']}",
+                  file=sys.stderr)
+        print(json.dumps(record), flush=True)
+        return 0 if parity else 1
+    finally:
+        if daemon is not None:
+            try:
+                svc_client.call_control("shutdown", path=sock, timeout=10)
+                daemon.wait(timeout=30)
+            except Exception:
+                daemon.kill()
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
 def run_incremental_bench(record: dict, args, n_changed: int,
                           json_only: bool = False) -> int:
     """The rung5i scenario: a 10k-file tree where only ``n_changed``
@@ -832,6 +1042,9 @@ def main() -> int:
         # Entirely subprocess-shaped (one-shot CLIs + a spawned daemon):
         # the parent needs no accelerator, no backend, no GC tuning.
         return run_warmserve_bench(record, args, json_only=args.json_only)
+    if args.preset == "batchserve":
+        # Same shape: all merges run inside the spawned daemon.
+        return run_batchserve_bench(record, args, json_only=args.json_only)
 
     # Accelerator acquisition, hardened (round 1 died here with rc=1 and
     # no JSON): probe the relay-backed TPU plugin in a throwaway

@@ -11,7 +11,8 @@ JSON record emitted by ``bench.py``. Run standalone::
 
 Exit 0 when everything conforms, 1 with one line per violation
 otherwise. The tier-1 suite imports :func:`validate_trace` /
-:func:`validate_events` / :func:`validate_bench` directly
+:func:`validate_events` / :func:`validate_bench` / :func:`validate_batch`
+directly
 (``tests/test_trace_schema.py``), so trace-format drift fails CI before
 it reaches a consumer.
 
@@ -74,17 +75,39 @@ SERVICE_METRIC_LABELS = {
     "declcache_evictions_total": (),
 }
 
+#: Span names of the continuous-batching layer (batch/). The window
+#: span is leader-side; pack/dispatch/scatter wrap one batched fused
+#: dispatch each.
+BATCH_SPANS = ("batch.window", "batch.pack", "batch.dispatch",
+               "batch.scatter")
+
+#: Meta keys every ``batch.*`` span must carry (how many valid requests
+#: the window/round held).
+BATCH_SPAN_META = ("requests",)
+
+#: Label keys of the batching metric series. ``batch_requests_total``
+#: is the per-request outcome counter; ``batch_size`` is a plain
+#: histogram; ``batch_padding_waste_ratio`` a plain gauge in [0, 1].
+BATCH_METRIC_LABELS = {
+    "batch_requests_total": ("outcome",),
+}
+
 #: Required keys of a BENCH JSON record (the driver contract).
 BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 
 #: Additive BENCH fields that must be numbers when present (the
-#: host-tail, strict-preset, incremental, and roundtrip extensions).
+#: host-tail, strict-preset, incremental, roundtrip, and batched-serve
+#: extensions).
 BENCH_NUMERIC_OPTIONAL = (
     "host_tail_ms", "device_roundtrip_ms", "incremental_ms",
     "full_scan_device_ms", "full_scan_host_ms", "vs_full_scan_device",
     "strict_ms", "nonstrict_ms", "strict_conflicts", "strict_motion_ops",
     "cold_ms", "warm_ms", "warm_speedup", "declcache_hit_rate",
     "daemon_rss_mb",
+    "serial_merges_per_sec", "batch_merges_per_sec_c4",
+    "batch_merges_per_sec_c16", "batch_speedup_c16",
+    "batch_p50_ms", "batch_p99_ms", "mean_batch_size",
+    "batch_padding_waste_ratio", "batch_program_cache_hit_rate",
 )
 
 
@@ -274,6 +297,70 @@ def validate_service(data: Any) -> List[str]:
     return errors
 
 
+def validate_batch(data: Any) -> List[str]:
+    """Validate the continuous-batching records of a trace/events-shaped
+    artifact (or a daemon status payload's ``metrics`` block): every
+    ``batch.*`` span is a documented one and carries its ``requests``
+    meta, ``batch_requests_total`` series carry exactly the ``outcome``
+    label, ``batch_size`` is an unlabeled histogram, and
+    ``batch_padding_waste_ratio`` an unlabeled gauge in [0, 1]."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["batch: top level must be a JSON object"]
+    for i, row in enumerate(data.get("spans", [])):
+        if not isinstance(row, dict):
+            continue
+        name = row.get("name")
+        if not isinstance(name, str) or not name.startswith("batch."):
+            continue
+        if name not in BATCH_SPANS:
+            errors.append(f"trace.spans[{i}]: unknown batch span {name!r}")
+        meta = row.get("meta")
+        if not isinstance(meta, dict):
+            errors.append(f"trace.spans[{i}]: batch span needs meta")
+            continue
+        for key in BATCH_SPAN_META:
+            v = meta.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"trace.spans[{i}]: batch span meta "
+                              f"{key!r} must be an int >= 0")
+    metrics = data.get("metrics", data)
+    if not isinstance(metrics, dict):
+        return errors
+    counters = metrics.get("counters", {})
+    for name, labels in BATCH_METRIC_LABELS.items():
+        m = counters.get(name) if isinstance(counters, dict) else None
+        if not isinstance(m, dict):
+            continue
+        for j, s in enumerate(m.get("series", [])):
+            got = tuple(sorted((s.get("labels") or {}).keys()))
+            if got != tuple(sorted(labels)):
+                errors.append(f"metrics.counters.{name}[{j}]: labels {got} "
+                              f"!= documented {tuple(sorted(labels))}")
+    hists = metrics.get("histograms", {})
+    size = hists.get("batch_size") if isinstance(hists, dict) else None
+    if isinstance(size, dict):
+        for j, s in enumerate(size.get("series", [])):
+            if (s.get("labels") or {}) != {}:
+                errors.append(f"metrics.histograms.batch_size[{j}]: "
+                              f"must carry no labels")
+    gauges = metrics.get("gauges", {})
+    waste = gauges.get("batch_padding_waste_ratio") \
+        if isinstance(gauges, dict) else None
+    if isinstance(waste, dict):
+        for j, s in enumerate(waste.get("series", [])):
+            if (s.get("labels") or {}) != {}:
+                errors.append(
+                    f"metrics.gauges.batch_padding_waste_ratio[{j}]: "
+                    f"must carry no labels")
+            v = s.get("value")
+            if not _is_num(v) or not (0.0 <= v <= 1.0):
+                errors.append(
+                    f"metrics.gauges.batch_padding_waste_ratio[{j}]: "
+                    f"value must be a number in [0, 1]")
+    return errors
+
+
 def validate_phase_coverage(data: Any, required) -> List[str]:
     """Check a trace artifact's span/phase names include ``required`` —
     the drift guard for load-bearing phase names (e.g. the apply-layer
@@ -386,6 +473,7 @@ def main(argv: List[str]) -> int:
         errors.extend(validate_trace(trace))
         errors.extend(validate_degradations(trace))
         errors.extend(validate_service(trace))
+        errors.extend(validate_batch(trace))
     except (OSError, json.JSONDecodeError) as exc:
         errors.append(f"trace: unreadable ({exc})")
     if len(argv) == 2:

@@ -52,6 +52,16 @@ class TpuTSBackend:
         # JAX is definitively up here: mirror compile/compile-cache
         # monitoring into the shared metrics registry.
         obs_device.ensure_jax_listeners()
+        if mesh is None:
+            # SEMMERGE_MESH=off pins the single-device kernels even on
+            # a multi-chip host — the deployment posture of a batching
+            # service daemon, which fills the chips by coalescing
+            # concurrent merges (batch/) instead of sharding one
+            # merge's decl axis.
+            import os
+            if os.environ.get("SEMMERGE_MESH", "").strip().lower() in (
+                    "off", "none", "single", "0"):
+                mesh = False
         if mesh is None and len(devices) > 1:
             # Multi-chip: shard the merge kernels' decl/op axis over a
             # dp mesh by default (BASELINE north star: the file/decl
@@ -380,6 +390,15 @@ class TpuTSBackend:
                         symbol_maps=maps,
                     )
                     return result, composed, conflicts
+        from .. import batch as batch_mod
+        if batch_mod.posture() == "require":
+            # Only reachable when the fused (batchable) path was not
+            # taken: ineligible configuration, a foldable
+            # changeSignature pair, or exhausted capacity retries.
+            from ..errors import BatchFault
+            raise BatchFault(
+                "SEMMERGE_BATCH=require but this merge is ineligible for "
+                "the batched fused path", stage="batch")
         with obs_spans.span("build_and_diff", layer="backend",
                             backend=self.name):
             result = self.build_and_diff(

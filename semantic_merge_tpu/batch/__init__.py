@@ -1,0 +1,146 @@
+"""Continuous batching: coalesce concurrent merges into fused
+multi-merge device dispatches.
+
+The warm daemon (service mode) amortizes imports and compile state, but
+every request still owns the device for a full fused dispatch, so
+concurrent clients queue serially on the kernel+fetch window. This
+package sits between the service daemon and the fused engine and packs
+many *independent* merge requests into ONE shape-bucketed batched
+program — the continuous-batching discipline of an inference stack,
+applied to the merge kernel:
+
+- :mod:`~semantic_merge_tpu.batch.scheduler` — a micro-batch window
+  (``SEMMERGE_BATCH_WINDOW_MS``, bounded in-flight batches) admitting
+  queued requests into shape buckets;
+- :mod:`~semantic_merge_tpu.batch.packer` — stacks the already
+  bucket-padded encoded snapshots along a new leading merge axis
+  (the core/encode bucket ladder keeps the co-batch key space small);
+- :mod:`~semantic_merge_tpu.batch.dispatcher` — runs one batched fused
+  program (the single-merge kernel body vmapped over the merge axis;
+  padding rows are inert replicas whose outputs are never scattered
+  back) and scatters the packed per-merge rows to each request, whose
+  host tail (``TailPlan`` decode → materialize → columnar apply) then
+  runs per request, unchanged and byte-identical to an unbatched run.
+
+The daemon activates ONE process-global :class:`BatchScheduler`
+(:func:`activate` / :func:`deactivate`); the fused engine consults
+:func:`plan_for_request` at its device-dispatch seam. Posture, read
+through the per-request env overlay (``SEMMERGE_BATCH``):
+
+- ``off``     — bypass the subsystem entirely (inline dispatch);
+- ``auto``    — batch when a scheduler is active; any batching fault
+  degrades *that request only* to the inline unbatched dispatch
+  (never worse than one-shot); the default;
+- ``require`` — must batch: an inactive scheduler, an ineligible
+  request, or a batching fault raises a typed
+  :class:`~semantic_merge_tpu.errors.BatchFault` (exit 16 in strict
+  mode; otherwise the CLI ladder degrades the run).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .dispatcher import collect_request, submit_request
+from .packer import BatchRequest, batch_bucket, pack_group
+from .scheduler import BatchScheduler
+
+__all__ = [
+    "BatchRequest", "BatchScheduler", "activate", "batch_bucket",
+    "collect_request", "current", "deactivate", "degrade_or_raise",
+    "pack_group", "plan_for_request", "posture", "submit_request",
+]
+
+#: Per-request posture knob (carried by the daemon's request overlay).
+ENV_POSTURE = "SEMMERGE_BATCH"
+
+_lock = threading.Lock()
+_active: Optional[BatchScheduler] = None
+
+
+def activate(**kwargs) -> BatchScheduler:
+    """Start (or return) the process-global batch scheduler. The
+    service daemon calls this around executor spawn; one-shot runs
+    never do, so the engine seam stays inert outside service mode."""
+    global _active
+    with _lock:
+        if _active is not None and _active.alive():
+            return _active
+        _active = BatchScheduler(**kwargs).start()
+        return _active
+
+
+def deactivate() -> None:
+    """Stop the process-global scheduler (daemon teardown). Queued
+    requests are failed with a typed fault so waiting threads degrade
+    to the inline dispatch instead of hanging."""
+    global _active
+    with _lock:
+        sched = _active
+        _active = None
+    if sched is not None:
+        sched.stop()
+
+
+def current() -> Optional[BatchScheduler]:
+    """The live scheduler, or ``None`` (stopped schedulers read as
+    absent so racing requests fall through to inline dispatch)."""
+    sched = _active
+    return sched if sched is not None and sched.alive() else None
+
+
+def posture() -> str:
+    """``SEMMERGE_BATCH`` through the request overlay: ``off`` /
+    ``auto`` (default) / ``require``; unknown values read as ``auto``."""
+    from ..utils import reqenv
+    value = (reqenv.get(ENV_POSTURE, "auto") or "auto").strip().lower()
+    return value if value in ("off", "auto", "require") else "auto"
+
+
+def plan_for_request(eligible: bool = True) -> Optional[BatchScheduler]:
+    """Route one merge at the engine's dispatch seam: the scheduler to
+    submit to, or ``None`` for the inline unbatched dispatch.
+    ``eligible`` is the engine's shape condition (single-device only —
+    the dp-sharded kernel has its own mesh program). Raises
+    :class:`~semantic_merge_tpu.errors.BatchFault` when posture
+    ``require`` cannot be satisfied."""
+    from ..errors import BatchFault
+    mode = posture()
+    sched = current()
+    if mode == "off":
+        if sched is not None:
+            _count_outcome("bypass")
+        return None
+    if sched is None:
+        if mode == "require":
+            raise BatchFault("SEMMERGE_BATCH=require but no batch "
+                             "scheduler is active", stage="batch")
+        return None
+    if not eligible:
+        if mode == "require":
+            raise BatchFault("SEMMERGE_BATCH=require but the mesh-sharded "
+                             "engine cannot join a batch", stage="batch")
+        _count_outcome("bypass")
+        return None
+    return sched
+
+
+def degrade_or_raise(fault) -> None:
+    """Policy for a batching fault at the request seam: ``require``
+    re-raises (typed, exit 16 strict); otherwise the caller falls back
+    to the inline dispatch — affected request only, co-batched requests
+    are untouched."""
+    if posture() == "require":
+        raise fault
+    from ..utils.loggingx import logger
+    logger.warning("batched dispatch degraded to inline: %s",
+                   fault.describe())
+    _count_outcome("degraded")
+
+
+def _count_outcome(outcome: str) -> None:
+    from ..obs import metrics as obs_metrics
+    obs_metrics.REGISTRY.counter(
+        "batch_requests_total",
+        "Merge requests seen by the batching subsystem, by outcome",
+    ).inc(1, outcome=outcome)

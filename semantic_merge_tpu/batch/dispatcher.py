@@ -1,0 +1,96 @@
+"""Dispatcher: run one batched fused program and scatter its rows back
+to the waiting requests.
+
+Two halves live here, on two different threads:
+
+- the **request side** (:func:`submit_request` / :func:`collect_request`)
+  runs on the merge request's own executor thread, so the per-request
+  env overlay (``utils/reqenv``) is in scope — fault injection
+  (``batch:pack`` / ``batch:dispatch`` / ``batch:scatter``) and posture
+  therefore scope to ONE request, never to its co-batched neighbors;
+- the **leader side** (:func:`dispatch_group`) runs on the scheduler's
+  dispatch pool: pack the group along the merge axis, fetch (or
+  compile) the bucket's jitted program from the fused module's program
+  cache, run it, and scatter row ``i`` of the packed output to request
+  ``i``'s future. Each row is the single-merge kernel's one-buffer
+  packed layout, so the engine's existing non-split decode — and the
+  whole host tail behind it — runs per request, unchanged.
+
+A leader-side error fails every member future; each request then
+applies its own posture at :func:`collect_request` (auto → inline
+unbatched dispatch, require → typed ``BatchFault``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..errors import fault_boundary
+from ..obs import device as obs_device
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from .packer import BatchRequest, pack_group
+
+#: Small-integer buckets for the per-dispatch valid-merge count.
+BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+
+#: Bound on a request's wait for its batch row — a wedged/killed leader
+#: must degrade the request to the inline path, not hang the daemon.
+_COLLECT_TIMEOUT_S = 300.0
+
+
+def submit_request(scheduler, dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
+                   *, nb: int, nl: int, nr: int, C: int):
+    """Request side, pre-dispatch: build the :class:`BatchRequest` and
+    enqueue it. Runs in the request thread (overlay in scope); any
+    failure is classified into a typed ``BatchFault``."""
+    from ..utils import faults
+    with fault_boundary("batch:pack"):
+        faults.check("batch:pack")
+        return scheduler.submit(BatchRequest(
+            dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
+            nb=nb, nl=nl, nr=nr, C=C))
+
+
+def collect_request(future) -> np.ndarray:
+    """Request side, post-dispatch: wait for this request's packed row.
+    The wait is bounded; leader-side errors surface here (wrapped into
+    ``BatchFault``) so the caller can apply posture per request."""
+    from ..utils import faults
+    with fault_boundary("batch:dispatch"):
+        faults.check("batch:dispatch")
+        row = future.result(timeout=_COLLECT_TIMEOUT_S)
+    with fault_boundary("batch:scatter"):
+        faults.check("batch:scatter")
+        flat = np.asarray(row)
+    from . import _count_outcome
+    _count_outcome("batched")
+    return flat
+
+
+def dispatch_group(scheduler, members) -> None:
+    """Leader side: pack → one batched program → scatter. ``members``
+    is a same-bucket-key list of ``(BatchRequest, Future)`` pairs."""
+    reqs = [req for req, _fut in members]
+    valid = len(reqs)
+    with obs_spans.span("batch.pack", layer="batch", requests=valid):
+        arrays, padded = pack_group(reqs)
+    reg = obs_metrics.REGISTRY
+    reg.histogram("batch_size",
+                  "Valid merges per batched fused dispatch",
+                  buckets=BATCH_SIZE_BUCKETS).observe(valid)
+    reg.gauge("batch_padding_waste_ratio",
+              "Merge-axis padding fraction of the last batched dispatch"
+              ).set((padded - valid) / padded)
+    geom = reqs[0]
+    with obs_spans.span("batch.dispatch", layer="batch", requests=valid,
+                        padded=padded, C=geom.C):
+        from ..ops.fused import batched_fused_program
+        program = batched_fused_program(padded, geom.nb, geom.nl,
+                                        geom.nr, geom.C)
+        flat = np.asarray(program(*arrays))
+        obs_device.record_transfer("d2h", flat.nbytes)
+    with obs_spans.span("batch.scatter", layer="batch", requests=valid):
+        for i, (_req, fut) in enumerate(members):
+            if not fut.done():
+                fut.set_result(flat[i])
+    scheduler.note_batch(valid, padded)

@@ -1,0 +1,81 @@
+"""Packer: stack co-batched merges' encoded snapshots along a new
+leading merge axis.
+
+Each request arrives with its decl columns already padded up the
+core/encode bucket ladder (``FusedMergeEngine._device_decl`` →
+``pad_to``), its op capacity ``C`` already bucketed, and its string
+hash table grown in power-of-two steps — so the co-batch **bucket key**
+``(nb, nl, nr, C, hash_cap)`` takes few distinct values and identical
+keys stack with zero per-request reshaping. The merge axis itself is
+padded up its own small ladder (:func:`batch_bucket`) so the jitted
+batched program cache stays O(log) per bucket key.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class BatchRequest:
+    """One merge's kernel inputs at the fused engine's dispatch seam:
+    the three bucket-padded decl-column device arrays, the device
+    string-hash table, the two (seed, rev) prefix digests, and the
+    static geometry the jitted program is specialized on."""
+
+    __slots__ = ("dev_b", "dev_l", "dev_r", "hash_tab", "dig_l", "dig_r",
+                 "nb", "nl", "nr", "C")
+
+    def __init__(self, dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
+                 *, nb: int, nl: int, nr: int, C: int) -> None:
+        self.dev_b = dev_b
+        self.dev_l = dev_l
+        self.dev_r = dev_r
+        self.hash_tab = hash_tab
+        self.dig_l = dig_l
+        self.dig_r = dig_r
+        self.nb = nb
+        self.nl = nl
+        self.nr = nr
+        self.C = C
+
+    @property
+    def key(self) -> Tuple[int, int, int, int, int]:
+        """The shape bucket this request can co-batch in. Requests with
+        equal keys stack directly; the hash-table capacity is part of
+        the key because it is a dynamic array dimension of the program."""
+        return (self.nb, self.nl, self.nr, self.C,
+                int(self.hash_tab.shape[0]))
+
+
+def batch_bucket(n: int) -> int:
+    """Merge-axis ladder: the next power of two ≥ ``n`` (1, 2, 4, 8, …)
+    — a small rung set so batched program shapes, like the decl
+    buckets, compile O(log) variants instead of one per batch size."""
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+def pack_group(reqs: List[BatchRequest]):
+    """Stack one co-batch group's inputs along a new leading merge
+    axis, padded up :func:`batch_bucket` by replicating request 0 —
+    padding rows are inert by construction: every lane of the vmapped
+    program is independent, and padded lanes' outputs are simply never
+    scattered back to any request.
+
+    Returns ``((b, l, r, hash_tabs, digs_l, digs_r), padded_size)``.
+    """
+    valid = len(reqs)
+    padded = batch_bucket(valid)
+    order = list(range(valid)) + [0] * (padded - valid)
+
+    def stack(field: str):
+        return jnp.stack([getattr(reqs[i], field) for i in order])
+
+    digs_l = np.stack([np.asarray(reqs[i].dig_l) for i in order])
+    digs_r = np.stack([np.asarray(reqs[i].dig_r) for i in order])
+    return ((stack("dev_b"), stack("dev_l"), stack("dev_r"),
+             stack("hash_tab"), digs_l, digs_r), padded)

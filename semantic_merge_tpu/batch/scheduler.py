@@ -1,0 +1,199 @@
+"""Micro-batch window scheduler.
+
+One leader thread owns a bounded admission queue. The first request to
+arrive opens a window of ``SEMMERGE_BATCH_WINDOW_MS``; everything that
+lands inside it (up to ``SEMMERGE_BATCH_MAX``) joins the round, is
+grouped by shape-bucket key, and each group is handed to the dispatch
+pool (``SEMMERGE_BATCH_INFLIGHT`` bounds concurrently in-flight batched
+programs — the leader keeps collecting the next window while earlier
+batches run, which is what makes the batching *continuous* rather than
+lock-step). Requests never block each other beyond the window: a
+window with one request dispatches a batch of one.
+
+The scheduler is posture-free by design — posture, fault injection and
+degradation all happen on the request threads
+(:mod:`~semantic_merge_tpu.batch.dispatcher`), where the per-request
+env overlay is in scope.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional
+
+from ..obs import spans as obs_spans
+
+#: Scheduler knobs (process env at activation — daemon-side settings).
+ENV_WINDOW_MS = "SEMMERGE_BATCH_WINDOW_MS"
+ENV_MAX_BATCH = "SEMMERGE_BATCH_MAX"
+ENV_INFLIGHT = "SEMMERGE_BATCH_INFLIGHT"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            from ..utils.loggingx import logger
+            logger.warning("invalid %s=%r ignored", name, raw)
+    return default
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(_env_float(name, float(default)))
+
+
+class BatchScheduler:
+    """The daemon-side micro-batch window: admission queue + leader
+    thread + bounded dispatch pool. One per process (see
+    ``batch.activate``)."""
+
+    def __init__(self, *, window_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 max_inflight: Optional[int] = None) -> None:
+        if window_ms is None:
+            window_ms = _env_float(ENV_WINDOW_MS, 5.0)
+        self.window_s = max(0.0, float(window_ms) / 1000.0)
+        self.max_batch = max(1, max_batch if max_batch is not None
+                             else _env_int(ENV_MAX_BATCH, 16))
+        self.max_inflight = max(1, max_inflight if max_inflight is not None
+                                else _env_int(ENV_INFLIGHT, 2))
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stopping = threading.Event()
+        self._sem = threading.Semaphore(self.max_inflight)
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.max_inflight,
+            thread_name_prefix="semmerge-batch")
+        self._lock = threading.Lock()
+        self._batches = 0
+        self._requests = 0
+        self._waste_sum = 0.0
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "BatchScheduler":
+        self._thread = threading.Thread(
+            target=self._run, name="semmerge-batch-window", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the leader and fail anything still queued — waiting
+        request threads then degrade to the inline dispatch instead of
+        hanging on an orphaned future."""
+        self._stopping.set()
+        self._queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._pool.shutdown(wait=True)
+        self._fail_pending()
+
+    def alive(self) -> bool:
+        return (self._thread is not None and self._thread.is_alive()
+                and not self._stopping.is_set())
+
+    # -- request side ------------------------------------------------------
+
+    def submit(self, request) -> Future:
+        from ..errors import BatchFault
+        if not self.alive():
+            raise BatchFault("batch scheduler is not running",
+                             stage="batch:pack")
+        fut: Future = Future()
+        self._queue.put((request, fut))
+        return fut
+
+    # -- accounting --------------------------------------------------------
+
+    def note_batch(self, valid: int, padded: int) -> None:
+        with self._lock:
+            self._batches += 1
+            self._requests += valid
+            self._waste_sum += (padded - valid) / padded
+
+    def stats(self) -> Dict[str, object]:
+        """Status-endpoint block: queue depth, mean batch size, padding
+        waste, and the batched-program cache hit rate."""
+        with self._lock:
+            batches, requests = self._batches, self._requests
+            waste_sum = self._waste_sum
+        from ..ops.fused import batched_program_cache_stats
+        return {
+            "queue_depth": self._queue.qsize(),
+            "window_ms": self.window_s * 1e3,
+            "max_batch": self.max_batch,
+            "max_inflight": self.max_inflight,
+            "batches_total": batches,
+            "requests_batched": requests,
+            "mean_batch_size": (requests / batches) if batches else 0.0,
+            "padding_waste_ratio": (waste_sum / batches) if batches else 0.0,
+            "program_cache": batched_program_cache_stats(),
+        }
+
+    # -- leader ------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None or self._stopping.is_set():
+                break
+            opened = time.perf_counter()
+            group = [item]
+            deadline = opened + self.window_s
+            while len(group) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._stopping.set()
+                    break
+                group.append(nxt)
+            obs_spans.record("batch.window",
+                             time.perf_counter() - opened, layer="batch",
+                             requests=len(group))
+            by_key: Dict[tuple, list] = {}
+            for request, fut in group:
+                by_key.setdefault(request.key, []).append((request, fut))
+            for members in by_key.values():
+                self._sem.acquire()
+                try:
+                    self._pool.submit(self._dispatch, members)
+                except RuntimeError as exc:  # pool shut down underneath
+                    self._sem.release()
+                    self._fail_members(members, exc)
+            if self._stopping.is_set():
+                break
+
+    def _dispatch(self, members) -> None:
+        from .dispatcher import dispatch_group
+        try:
+            dispatch_group(self, members)
+        except BaseException as exc:  # noqa: BLE001 — futures carry it
+            self._fail_members(members, exc)
+        finally:
+            self._sem.release()
+
+    def _fail_members(self, members, exc) -> None:
+        for _request, fut in members:
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def _fail_pending(self) -> None:
+        from ..errors import BatchFault
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                self._fail_members([item], BatchFault(
+                    "batch scheduler stopped", stage="batch:dispatch"))

@@ -8,7 +8,7 @@ with the same observable contract:
 - ``semmerge BASE A B [--inplace] [--git]`` — full 3-way semantic merge.
   Exit codes: 0 merged; 1 conflicts (written to
   ``.semmerge-conflicts.json``); 2 type errors (diagnostics on stderr);
-  3 git plumbing failure; 10-15 a contained fault under
+  3 git plumbing failure; 10-16 a contained fault under
   ``SEMMERGE_STRICT=1`` / ``--no-degrade`` (see ``errors.py`` and the
   runbook's "Failure modes" table).
 
@@ -724,6 +724,14 @@ def cmd_stats(args: argparse.Namespace) -> int:
               f"entries={decl.get('entries', 0)}")
         print(f"memory: rss_mb={status.get('rss_mb', 0.0):.1f} "
               f"repos_tracked={status.get('repos_tracked', 0)}")
+        batch = status.get("batch")
+        if batch:
+            cache = batch.get("program_cache") or {}
+            print(f"batch: queue_depth={batch.get('queue_depth', 0)} "
+                  f"batches={batch.get('batches_total', 0)} "
+                  f"mean_batch_size={batch.get('mean_batch_size', 0.0):.2f} "
+                  f"padding_waste={batch.get('padding_waste_ratio', 0.0):.3f} "
+                  f"program_cache_hit_rate={cache.get('hit_rate', 0.0):.3f}")
         for line in _render_stats({"counters": status.get("metrics", {}).get(
                 "counters", {})}):
             print(line)

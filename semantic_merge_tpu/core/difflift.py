@@ -64,12 +64,11 @@ def refine_signature_changes(diffs: List[Diff], sources=None,
                              matcher=None) -> List[Diff]:
     """Fold residual ``delete``+``add`` pairs into ``changeSig`` diffs.
 
-    The reference declares a ``changeSig`` diff kind but never produces
-    it (reference ``workers/ts/src/diff.ts:3``; TODO at reference
-    ``implementation.md:902``): editing a function's parameter or return
-    types changes its structural symbolId, so the join reports the decl
-    as deleted-and-re-added. This pass implements the designed behavior:
-    a deleted base decl and an added side decl that share
+    Editing a function's parameter or return types changes its
+    structural symbolId, so the exact-key join reports the decl as
+    deleted-and-re-added; the ``changeSig`` diff kind exists so such
+    edits can merge as one signature change instead. This pass produces
+    it: a deleted base decl and an added side decl that share
     ``(file, name, kind)`` (names non-null) are the same declaration
     with a changed signature.
 
@@ -77,8 +76,7 @@ def refine_signature_changes(diffs: List[Diff], sources=None,
     :class:`semantic_merge_tpu.models.signature.EmbeddingSignatureMatcher`)
     and ``sources`` (a :func:`source_maps` pair), a second pass scores
     the *residual* deletes/adds — declarations that were renamed AND
-    retyped, which no key can pair — by embedding similarity
-    (reference design ``architecture.md:145-153``).
+    retyped, which no key can pair — by embedding similarity.
 
     Deterministic pairing: the k-th delete with a given key pairs with
     the k-th add with that key; model pairs break ties by score then

@@ -27,9 +27,10 @@ code  meaning
 13    ``ApplyFault`` — tree materialization or in-place commit failure
 14    ``FormatFault`` — formatter failure escalated by fault injection
 15    ``DeadlineFault`` — a per-request deadline expired
+16    ``BatchFault`` — batched dispatch failed or posture unsatisfiable
 ====  =============================================================
 
-Codes 10-15 are only ever *exit* codes in strict mode or when the
+Codes 10-16 are only ever *exit* codes in strict mode or when the
 textual rung itself fails; in the default posture they name the fault
 that triggered a ladder rung (the ``fault`` label of the
 ``merge_degradations_total`` metric and ``degradation`` span).
@@ -113,6 +114,16 @@ class DeadlineFault(MergeFault):
     default_stage = "deadline"
 
 
+class BatchFault(MergeFault):
+    """Batched fused dispatch failed, or a ``SEMMERGE_BATCH=require``
+    posture could not be satisfied (``batch/``). In the default
+    posture the affected request degrades to the inline unbatched
+    dispatch — co-batched requests are never touched."""
+
+    exit_code = 16
+    default_stage = "batch"
+
+
 #: Fault class each pipeline stage wraps *unexpected* exceptions into.
 STAGE_FAULTS = {
     "snapshot": ParseFault,
@@ -129,6 +140,13 @@ STAGE_FAULTS = {
     "service:accept": WorkerFault,
     "service:dispatch": WorkerFault,
     "service:execute": WorkerFault,
+    # Continuous-batching subsystem (batch/): pack/dispatch/scatter all
+    # classify as BatchFault so the request seam can degrade the one
+    # affected request to the inline unbatched dispatch.
+    "batch": BatchFault,
+    "batch:pack": BatchFault,
+    "batch:dispatch": BatchFault,
+    "batch:scatter": BatchFault,
     "materialize": ApplyFault,
     "apply": ApplyFault,
     "commit": ApplyFault,
@@ -140,7 +158,7 @@ STAGE_FAULTS = {
 #: The documented fault exit codes, by class name (runbook table).
 EXIT_CODES = {cls.__name__: cls.exit_code for cls in
               (ParseFault, KernelFault, WorkerFault, ApplyFault,
-               FormatFault, DeadlineFault)}
+               FormatFault, DeadlineFault, BatchFault)}
 
 
 def fault_for_stage(stage: str) -> type:

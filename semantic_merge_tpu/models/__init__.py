@@ -1,15 +1,16 @@
 """Neural models for similarity matching.
 
-The reference's P1 design calls for a *similarity matcher* that pairs
-declarations across revisions when exact structural signatures diverge
-(reference ``architecture.md:145-153``: "similarity matching on
-normalized bodies"; the live differ's TODO at
-``implementation.md:902`` — ``changeSig`` is never emitted because
-there is no matcher). This package is the TPU-native answer: a
-sequence encoder over declaration token streams producing embeddings
-whose cosine similarity drives rename/changeSignature matching at
-repo scale, trained and served across a device mesh (DP/TP/PP/SP/EP —
-see :mod:`semantic_merge_tpu.parallel.mesh`).
+A structural symbolId changes whenever a declaration's signature does,
+so exact-key joins cannot pair a decl across revisions once it has
+been renamed *and* retyped — those edits surface as unrelated
+delete+add pairs. This package supplies the similarity matcher that
+closes the gap: a sequence encoder over declaration token streams
+producing embeddings whose cosine similarity drives
+rename/changeSignature pairing at repo scale, trained and served
+across a device mesh (DP/TP/PP/SP/EP — see
+:mod:`semantic_merge_tpu.parallel.mesh`). The exact-key half of the
+pairing lives in :func:`semantic_merge_tpu.core.difflift.refine_signature_changes`;
+the matcher scores only its residuals.
 """
 from .encoder import EncoderConfig, init_encoder, encoder_forward  # noqa: F401
 from .matcher import (MatcherConfig, init_matcher, make_scorer,  # noqa: F401

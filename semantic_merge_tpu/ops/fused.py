@@ -718,6 +718,57 @@ def _sharded_fn(mesh, nb: int, nl: int, nr: int,
 
 
 # --------------------------------------------------------------------------
+# Batched entry point (batch/ continuous-batching subsystem)
+# --------------------------------------------------------------------------
+# The batched program is the single-merge kernel body vmapped over a
+# new leading merge axis: every lane is independent, so lane i of the
+# batched output is bit-identical to an unbatched dispatch of request i
+# (padding lanes are inert — their rows are never scattered back).
+# Programs are cached per bucket-shape key; both the decl-column bucket
+# ladder and the merge-axis power-of-two ladder keep the key space
+# O(log), so a warm daemon compiles a handful of variants ever.
+
+_batch_prog_lock = threading.Lock()
+_batch_progs: Dict[Tuple[int, int, int, int], object] = {}
+_batch_prog_hits = 0
+_batch_prog_misses = 0
+
+
+def batched_fused_program(B: int, nb: int, nl: int, nr: int, C: int):
+    """The jitted batched fused-merge program for one bucket shape:
+    maps ``(b[B,4,nb], l[B,4,nl], r[B,4,nr], hash_tab[B,cap,10],
+    dig_l[B,16], dig_r[B,16])`` to the ``[B, 8 + 24C]`` stack of
+    one-buffer packed rows (``split=False`` layout)."""
+    global _batch_prog_hits, _batch_prog_misses
+    key = (B, nb, nl, nr, C)
+    with _batch_prog_lock:
+        prog = _batch_progs.get(key)
+        if prog is not None:
+            _batch_prog_hits += 1
+            return prog
+        _batch_prog_misses += 1
+
+    def one(b_cols, l_cols, r_cols, hash_tab, dig_l, dig_r):
+        return _fused_merge_kernel(b_cols, l_cols, r_cols, hash_tab,
+                                   dig_l, dig_r, nb=nb, nl=nl, nr=nr,
+                                   C=C, split=False)
+
+    prog = jax.jit(jax.vmap(one))
+    with _batch_prog_lock:
+        return _batch_progs.setdefault(key, prog)
+
+
+def batched_program_cache_stats() -> Dict[str, object]:
+    """Status/stats block for the batched-program cache."""
+    with _batch_prog_lock:
+        programs = len(_batch_progs)
+        hits, misses = _batch_prog_hits, _batch_prog_misses
+    total = hits + misses
+    return {"programs": programs, "hits": hits, "misses": misses,
+            "hit_rate": (hits / total) if total else 0.0}
+
+
+# --------------------------------------------------------------------------
 # Host side: decode, lazy views, conflict patch
 # --------------------------------------------------------------------------
 # Op-object materialization lives in ops/oplog_view.py now: the fused
@@ -893,20 +944,47 @@ class FusedMergeEngine:
         # a real link; SEMMERGE_SPLIT_FETCH=0 restores the one-buffer
         # packed fetch.
         split = os.environ.get("SEMMERGE_SPLIT_FETCH", "1") == "1"
+        # Continuous-batching seam: under an active scheduler (service
+        # mode) this merge's dispatch joins a shape-bucketed batched
+        # program instead of owning the device alone. Batched rows use
+        # the one-buffer packed layout, so split-fetch (a transport
+        # optimization; decoded values are identical) is disabled for
+        # the request. Any batching fault degrades THIS request to the
+        # inline dispatch below (posture permitting) — co-batched
+        # requests are unaffected.
+        from .. import batch as batch_mod
+        batcher = batch_mod.plan_for_request(eligible=self.mesh is None)
+        if batcher is not None:
+            split = False
         flat = mid_dev = chains_dev = None
         warm_caches = True
         for _attempt in range(4):
             C = self._bucket(max(self._cap_hint, 8 * self._dp))
             t0 = time.perf_counter()
-            if self.mesh is not None:
-                fn = _sharded_fn(self.mesh, nb, nl, nr, C, self._dp, split)
-                out_dev = fn(dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r)
+            batch_fut = None
+            if batcher is not None:
+                from ..errors import MergeFault
+                try:
+                    batch_fut = batch_mod.submit_request(
+                        batcher, dev_b, dev_l, dev_r, hash_tab,
+                        dig_l, dig_r, nb=nb, nl=nl, nr=nr, C=C)
+                except MergeFault as fault:
+                    batch_mod.degrade_or_raise(fault)
+                    batcher = None
+            if batch_fut is None:
+                if self.mesh is not None:
+                    fn = _sharded_fn(self.mesh, nb, nl, nr, C, self._dp,
+                                     split)
+                    out_dev = fn(dev_b, dev_l, dev_r, hash_tab, dig_l,
+                                 dig_r)
+                else:
+                    out_dev = _fused_merge_kernel(
+                        dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
+                        nb=nb, nl=nl, nr=nr, C=C, split=split)
+                head_dev, mid_dev, chains_dev = (out_dev if split
+                                                 else (out_dev, None, None))
             else:
-                out_dev = _fused_merge_kernel(
-                    dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
-                    nb=nb, nl=nl, nr=nr, C=C, split=split)
-            head_dev, mid_dev, chains_dev = (out_dev if split
-                                             else (out_dev, None, None))
+                head_dev = mid_dev = chains_dev = None
             if overlap_work is not None:
                 # Dispatch is async: host-side work here rides along
                 # with the device execution.
@@ -927,22 +1005,34 @@ class FusedMergeEngine:
                         _get_table((self._tbl_cache, key), nodes)
                         _get_fields((self._tbl_cache, key), nodes)
                 warm_caches = False
-            if detailed:
-                head_dev.block_until_ready()
-                obs_spans.record("kernel", time.perf_counter() - t0,
-                                 layer="ops")
-                t0 = time.perf_counter()
-            if split:
-                for d in (head_dev, mid_dev, chains_dev):
-                    try:
-                        d.copy_to_host_async()
-                    except AttributeError:
-                        pass
-            flat = np.asarray(head_dev)
-            obs_device.record_transfer("d2h", flat.nbytes)
-            if detailed:
-                obs_spans.record("fetch", time.perf_counter() - t0,
-                                 layer="ops")
+            if batch_fut is not None:
+                from ..errors import MergeFault
+                try:
+                    flat = batch_mod.collect_request(batch_fut)
+                except MergeFault as fault:
+                    batch_mod.degrade_or_raise(fault)
+                    batcher = None
+                    continue  # retry this capacity on the inline path
+                if detailed:
+                    obs_spans.record("kernel", time.perf_counter() - t0,
+                                     layer="ops")
+            else:
+                if detailed:
+                    head_dev.block_until_ready()
+                    obs_spans.record("kernel", time.perf_counter() - t0,
+                                     layer="ops")
+                    t0 = time.perf_counter()
+                if split:
+                    for d in (head_dev, mid_dev, chains_dev):
+                        try:
+                            d.copy_to_host_async()
+                        except AttributeError:
+                            pass
+                flat = np.asarray(head_dev)
+                obs_device.record_transfer("d2h", flat.nbytes)
+                if detailed:
+                    obs_spans.record("fetch", time.perf_counter() - t0,
+                                     layer="ops")
             n_l, n_r = int(flat[0]), int(flat[1])
             if not flat[4]:  # no overflow
                 break

@@ -185,6 +185,11 @@ class Daemon:
         self._install_signal_handlers()
         from ..utils.jaxenv import enable_compile_cache
         enable_compile_cache()
+        # Continuous batching: one process-global micro-batch scheduler
+        # coalesces concurrent requests' fused dispatches. Activated
+        # only here — one-shot CLI processes never batch.
+        from .. import batch
+        batch.activate()
         for _ in range(self._workers_n):
             threading.Thread(target=self._executor, daemon=True).start()
         if self._repo_ttl > 0:
@@ -291,6 +296,8 @@ class Daemon:
             sock.close()
         with contextlib.suppress(OSError):
             os.unlink(self._socket_path)
+        from .. import batch
+        batch.deactivate()
         from ..backends.subproc import shutdown_shared
         shutdown_shared()
         if self._recorder is not None:
@@ -520,6 +527,8 @@ class Daemon:
         lookups = hits + decl.get("misses", 0)
         with self._state_lock:
             in_flight, served = self._in_flight, self._served
+        from .. import batch
+        scheduler = batch.current()
         return {
             "ok": True,
             "pid": os.getpid(),
@@ -534,6 +543,7 @@ class Daemon:
             "rss_mb": round(_rss_mb(), 3),
             "declcache": decl,
             "declcache_hit_rate": (hits / lookups) if lookups else 0.0,
+            "batch": scheduler.stats() if scheduler is not None else None,
             "metrics": obs_metrics.REGISTRY.to_dict(),
         }
 

@@ -41,6 +41,21 @@ service:dispatch     executor thread, post-dequeue / pre-repo-lock
 service:execute      executor thread, inside the execute span
 ===================  ==================================================
 
+Continuous-batching stages (``semantic_merge_tpu/batch/``) parse the
+same way (``SEMMERGE_FAULT=batch:pack:fault`` …). All three fire on the
+*request's* thread, where its env overlay is in scope — so a batch
+fault lands the affected request alone on the inline unbatched path
+(posture ``auto``) or its documented exit code (``require`` + strict),
+while co-batched requests complete normally:
+
+===================  ==================================================
+stage                call site
+===================  ==================================================
+batch:pack           ``batch.dispatcher.submit_request`` (pre-enqueue)
+batch:dispatch       ``batch.dispatcher.collect_request`` (await row)
+batch:scatter        ``batch.dispatcher.collect_request`` (row fetch)
+===================  ==================================================
+
 Inside the daemon the injection spec and the per-stage hit counters are
 read through the request overlay (:mod:`semantic_merge_tpu.utils.
 reqenv`): each request carries its client's ``SEMMERGE_FAULT`` and gets
@@ -75,9 +90,10 @@ from . import reqenv
 
 ENV_VAR = "SEMMERGE_FAULT"
 
-#: Stage names that contain a colon themselves (the service daemon's
-#: stages) — the parser joins the first two segments for these.
-COMPOUND_STAGE_PREFIX = "service"
+#: Stage-name prefixes that contain a colon themselves (the service
+#: daemon's and batching subsystem's stages) — the parser joins the
+#: first two segments for these.
+COMPOUND_STAGE_PREFIXES = ("service", "batch")
 
 _counters: Dict[str, int] = {}
 
@@ -92,8 +108,9 @@ def _parse(env: str):
     parts = env.strip().split(":")
     if not parts or not parts[0]:
         return None
-    if parts[0] == COMPOUND_STAGE_PREFIX and len(parts) > 1 and parts[1]:
-        # service:<substage>[:kind[:nth]] — the stage IS two segments.
+    if parts[0] in COMPOUND_STAGE_PREFIXES and len(parts) > 1 and parts[1]:
+        # service:<substage>[:kind[:nth]] (likewise batch:<substage>) —
+        # the stage IS two segments.
         parts = [f"{parts[0]}:{parts[1]}"] + parts[2:]
     stage = parts[0]
     kind = parts[1] if len(parts) > 1 and parts[1] else "raise"

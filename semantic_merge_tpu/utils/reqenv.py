@@ -2,7 +2,8 @@
 ``SEMMERGE_*`` variables without mutating ``os.environ``.
 
 A one-shot CLI reads behavior toggles (``SEMMERGE_FAULT``,
-``SEMMERGE_STRICT``) straight from its process environment. The merge
+``SEMMERGE_STRICT``, the ``SEMMERGE_BATCH`` batching posture) straight
+from its process environment. The merge
 service daemon executes many clients' requests from one process, so a
 request's environment must scope to the request: mutating
 ``os.environ`` would race concurrent requests and forcing every

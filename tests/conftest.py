@@ -45,6 +45,8 @@ def spawn_service_daemon(socket_path: str, extra_env=None,
     env["SEMMERGE_DAEMON"] = "off"
     env.pop("SEMMERGE_FAULT", None)
     env.pop("SEMMERGE_METRICS", None)
+    if extra_env:
+        env.update(extra_env)
     log = open(socket_path + ".log", "ab")
     proc = subprocess.Popen(
         [sys.executable, "-m", "semantic_merge_tpu", "serve",

@@ -1,0 +1,367 @@
+"""Continuous-batching parity matrix (ISSUE 8 tentpole).
+
+Parity is the hard gate: every merge coalesced into a fused
+multi-merge dispatch must produce byte-identical observable output —
+op logs, composed op stream, conflict artifacts — to the same merge
+run unbatched. The matrix covers requests straddling bucket-ladder
+rungs, empty merges, conflict-bearing merges, mixed repos sharing one
+batch window, and one member degrading mid-flight while its co-batched
+neighbours complete normally. Posture semantics (``SEMMERGE_BATCH`` =
+off / auto / require) are exercised both in-process and over the
+service wire, where the client's posture rides the request env
+overlay.
+"""
+import contextlib
+import hashlib
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from semantic_merge_tpu import batch
+from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+from semantic_merge_tpu.errors import BatchFault
+from semantic_merge_tpu.obs import metrics as obs_metrics
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+from semantic_merge_tpu.utils import faults, reqenv
+
+from bench import synth_repo
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+def fingerprint(merge_result):
+    """Byte-comparable form of everything a merge observably produces:
+    both op logs, the composed stream, and the conflict artifacts."""
+    result, composed, conflicts = merge_result
+    return (
+        [op.to_dict() for op in result.op_log_left],
+        [op.to_dict() for op in result.op_log_right],
+        [op.to_dict() for op in composed],
+        [c.to_dict() for c in conflicts],
+    )
+
+
+def baseline(snaps):
+    """Unbatched reference run on a fresh single-device backend (no
+    scheduler is active when this is called)."""
+    assert batch.current() is None
+    return fingerprint(TpuTSBackend(mesh=False).merge(*snaps))
+
+
+@contextlib.contextmanager
+def active_batching(**kwargs):
+    batch.activate(**kwargs)
+    try:
+        yield batch.current()
+    finally:
+        batch.deactivate()
+
+
+def run_concurrent(jobs):
+    """Run ``jobs`` — a list of ``(snapshots, overlay_env_or_None)`` —
+    concurrently, one thread per job, released together so they land in
+    the same batch window. Each thread owns a fresh backend (pre-warmed
+    through the bypass posture so the measured merge's host phases are
+    fast enough to co-batch). Returns per-job fingerprints; re-raises
+    the first per-thread error."""
+    n = len(jobs)
+    results = [None] * n
+    errors = [None] * n
+    barrier = threading.Barrier(n)
+
+    def work(i, snaps, env):
+        try:
+            be = TpuTSBackend(mesh=False)
+            with reqenv.overlay({batch.ENV_POSTURE: "off"}):
+                be.merge(*snaps)  # warm caches off the batched path
+            barrier.wait()
+            with reqenv.overlay(env or {}):
+                results[i] = fingerprint(be.merge(*snaps))
+        except BaseException as exc:  # noqa: BLE001 - surfaced below
+            errors[i] = exc
+            with contextlib.suppress(threading.BrokenBarrierError):
+                barrier.abort()
+
+    threads = [threading.Thread(target=work, args=(i, snaps, env))
+               for i, (snaps, env) in enumerate(jobs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    for exc in errors:
+        if exc is not None:
+            raise exc
+    return results
+
+
+def outcome_total(outcome: str) -> float:
+    return obs_metrics.REGISTRY.counter(
+        "batch_requests_total").value(outcome=outcome)
+
+
+@pytest.fixture
+def single_device(monkeypatch):
+    """Pin the batch-eligible engine shape: the test mesh (8 virtual
+    CPU devices, conftest) would otherwise auto-shard every backend and
+    make each merge batch-ineligible."""
+    monkeypatch.setenv("SEMMERGE_MESH", "off")
+    faults.reset()
+    yield
+    batch.deactivate()
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Co-batched parity
+# ---------------------------------------------------------------------------
+
+def test_cobatched_same_shape_parity(single_device):
+    """Four identically-shaped concurrent merges coalesce into fused
+    multi-merge dispatches and stay byte-identical to unbatched runs."""
+    snaps = synth_repo(4, 2)
+    want = baseline(snaps)
+    with active_batching(window_ms=100.0) as sched:
+        got = run_concurrent([(snaps, None)] * 4)
+        stats = sched.stats()
+    for i, fp in enumerate(got):
+        assert fp == want, f"request {i} diverged from the unbatched run"
+    assert stats["requests_batched"] == 4
+    assert stats["mean_batch_size"] > 1.0, \
+        "identically-shaped concurrent requests must co-batch"
+
+
+def test_bucket_ladder_straddle_parity(single_device):
+    """Requests straddling bucket-ladder rungs — plus an empty merge
+    and a conflict-bearing one — share a window; each lands in its own
+    shape group and every result matches its unbatched run."""
+    base, _, _ = synth_repo(4, 2)
+    scenarios = [
+        synth_repo(3, 2),                   # small rung
+        synth_repo(6, 3),                   # middle rung
+        synth_repo(12, 2),                  # straddles the next rung
+        (base, base, base),                 # empty merge: zero ops
+        synth_repo(6, 2, divergent=True),   # conflict-bearing
+    ]
+    want = [baseline(s) for s in scenarios]
+    assert want[3][2] == [], "identical snapshots must compose to no ops"
+    assert want[4][3], "the divergent scenario must carry a conflict"
+    with active_batching(window_ms=100.0) as sched:
+        got = run_concurrent([(s, None) for s in scenarios])
+        stats = sched.stats()
+    for i, fp in enumerate(got):
+        assert fp == want[i], f"scenario {i} diverged from its unbatched run"
+    assert stats["requests_batched"] == len(scenarios)
+
+
+def test_mixed_repos_one_window_parity(single_device):
+    """Two DIFFERENT repos whose encoded shapes share a co-batch key
+    ride the same batched dispatch; rows scatter back to the right
+    request (the scope-collision hazard of cross-repo batching)."""
+    snaps_a = synth_repo(4, 2)
+
+    def relocate(snap: Snapshot) -> Snapshot:
+        return Snapshot(files=[{**f, "path": "pkg/" + f["path"]}
+                               for f in snap.files], project=snap.project)
+
+    snaps_b = tuple(relocate(s) for s in snaps_a)
+    want_a, want_b = baseline(snaps_a), baseline(snaps_b)
+    assert want_a != want_b, "relocation must change the observable ops"
+    with active_batching(window_ms=100.0) as sched:
+        got = run_concurrent([(snaps_a, None), (snaps_b, None),
+                              (snaps_a, None), (snaps_b, None)])
+        stats = sched.stats()
+    assert got[0] == want_a and got[2] == want_a
+    assert got[1] == want_b and got[3] == want_b
+    assert stats["requests_batched"] == 4
+    assert stats["mean_batch_size"] > 1.0, \
+        "same-shape merges from different repos must co-batch"
+
+
+# ---------------------------------------------------------------------------
+# Mid-flight degradation: affected request only
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("stage", ["batch:pack", "batch:dispatch",
+                                   "batch:scatter"])
+def test_midflight_fault_degrades_only_affected_request(single_device, stage):
+    """A batching fault on ONE member of a window degrades that request
+    to the inline unbatched dispatch; its co-batched neighbour completes
+    normally. Both results stay byte-identical to the unbatched run."""
+    snaps = synth_repo(4, 2)
+    want = baseline(snaps)
+    degraded_before = outcome_total("degraded")
+    batched_before = outcome_total("batched")
+    with active_batching(window_ms=100.0):
+        got = run_concurrent([
+            (snaps, {"SEMMERGE_FAULT": f"{stage}:fault"}),
+            (snaps, None),
+        ])
+    assert got[0] == want, "the degraded request must still merge correctly"
+    assert got[1] == want, "the co-batched neighbour must be untouched"
+    assert outcome_total("degraded") >= degraded_before + 1
+    assert outcome_total("batched") >= batched_before + 1
+
+
+# ---------------------------------------------------------------------------
+# Posture semantics (in-process)
+# ---------------------------------------------------------------------------
+
+def test_posture_off_bypasses_subsystem(single_device):
+    """``SEMMERGE_BATCH=off`` routes around the scheduler entirely:
+    no batch is formed and the run matches the unbatched result."""
+    snaps = synth_repo(4, 2)
+    want = baseline(snaps)
+    bypass_before = outcome_total("bypass")
+    with active_batching(window_ms=20.0) as sched:
+        with reqenv.overlay({batch.ENV_POSTURE: "off"}):
+            got = fingerprint(TpuTSBackend(mesh=False).merge(*snaps))
+        stats = sched.stats()
+    assert got == want
+    assert stats["requests_batched"] == 0, \
+        "off posture must never enqueue into the scheduler"
+    assert outcome_total("bypass") >= bypass_before + 1
+
+
+def test_posture_require_without_scheduler_raises():
+    """``require`` with no active scheduler is unsatisfiable — a typed
+    BatchFault (exit 16), never a silent inline run."""
+    assert batch.current() is None
+    with reqenv.overlay({batch.ENV_POSTURE: "require"}):
+        with pytest.raises(BatchFault) as exc_info:
+            batch.plan_for_request(eligible=True)
+    assert exc_info.value.exit_code == 16
+
+
+def test_posture_require_ineligible_engine_raises(single_device):
+    """``require`` on a mesh-sharded (batch-ineligible) engine is
+    unsatisfiable too; ``auto`` quietly bypasses instead."""
+    with active_batching(window_ms=20.0):
+        with reqenv.overlay({batch.ENV_POSTURE: "require"}):
+            with pytest.raises(BatchFault):
+                batch.plan_for_request(eligible=False)
+        assert batch.plan_for_request(eligible=False) is None
+
+
+# ---------------------------------------------------------------------------
+# Posture semantics over the service wire (satellite: reqenv overlay)
+# ---------------------------------------------------------------------------
+
+def _git(args, cwd):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _make_repo(root: pathlib.Path) -> pathlib.Path:
+    """basebr/brA/brB repo whose semantic merge equals its textual
+    merge (disjoint edits) — the shared fault-matrix shape."""
+    root.mkdir()
+    _git(["init", "-q", "-b", "main"], root)
+    _git(["config", "user.email", "t@example.com"], root)
+    _git(["config", "user.name", "t"], root)
+    (root / "src").mkdir()
+    (root / "src/util.ts").write_text(
+        "export function foo(n: number): number {\n  return n;\n}\n")
+    _git(["add", "-A"], root)
+    _git(["commit", "-q", "-m", "base"], root)
+    _git(["branch", "basebr"], root)
+    _git(["checkout", "-qb", "brA"], root)
+    (root / "src/util.ts").write_text(
+        "export function bar(n: number): number {\n  return n;\n}\n")
+    _git(["add", "-A"], root)
+    _git(["commit", "-q", "-m", "rename"], root)
+    _git(["checkout", "-q", "main"], root)
+    _git(["checkout", "-qb", "brB"], root)
+    (root / "extra.ts").write_text(
+        "export function extra(s: string): string { return s; }\n")
+    _git(["add", "-A"], root)
+    _git(["commit", "-q", "-m", "add extra"], root)
+    _git(["checkout", "-q", "main"], root)
+    return root
+
+
+def _wire_env(sock: str, **extra) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEMMERGE_DAEMON"] = "require"
+    env["SEMMERGE_SERVICE_SOCKET"] = sock
+    env.pop("SEMMERGE_FAULT", None)
+    env.update(extra)
+    return env
+
+
+def test_wire_postures_honored_inside_daemon(tmp_path, daemon_factory):
+    """The client's ``SEMMERGE_BATCH`` posture rides the request env
+    overlay into the daemon: ``require`` merges on the batched path,
+    ``off`` bypasses the scheduler — both visible in daemon status."""
+    from semantic_merge_tpu.service import client as service_client
+    sock = str(tmp_path / "batch.sock")
+    daemon_factory(sock, extra_env={
+        # Pin the daemon's engine to the batch-eligible single-device
+        # shape despite the test harness's 8-device XLA_FLAGS.
+        "SEMMERGE_MESH": "off",
+        "SEMMERGE_BATCH_WINDOW_MS": "5",
+    })
+
+    def merge_in(repo: pathlib.Path, posture: str) -> None:
+        proc = subprocess.run(
+            [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+             "basebr", "brA", "brB", "--inplace", "--backend", "tpu"],
+            cwd=repo, capture_output=True, text=True,
+            env=_wire_env(sock, SEMMERGE_BATCH=posture))
+        assert proc.returncode == 0, \
+            f"{posture} posture over the wire failed: {proc.stderr}"
+        assert "bar" in (repo / "src/util.ts").read_text()
+        assert (repo / "extra.ts").exists()
+
+    def wire_outcome(status: dict, outcome: str) -> float:
+        series = (status["metrics"].get("counters", {})
+                  .get("batch_requests_total", {}).get("series", []))
+        return sum(s["value"] for s in series
+                   if s.get("labels", {}).get("outcome") == outcome)
+
+    merge_in(_make_repo(tmp_path / "require_repo"), "require")
+    status = service_client.call_control("status", path=sock)
+    assert status["batch"] is not None, "daemon must expose batch stats"
+    batched_after_require = status["batch"]["requests_batched"]
+    assert batched_after_require >= 1, \
+        "require posture must land on the batched path"
+    assert wire_outcome(status, "batched") >= 1
+
+    merge_in(_make_repo(tmp_path / "off_repo"), "off")
+    status = service_client.call_control("status", path=sock)
+    assert wire_outcome(status, "bypass") >= 1, \
+        "off posture must bypass the scheduler inside the daemon"
+    assert status["batch"]["requests_batched"] == batched_after_require, \
+        "off posture must never enqueue into the scheduler"
+
+
+# ---------------------------------------------------------------------------
+# Device-scale fuzz (slow: real windows at service concurrency)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_batchserve_scale_parity(single_device):
+    """Concurrency-16 fuzz at bench-preset shapes: parity holds for
+    every request and batches actually form (mean size > 1)."""
+    shapes = [(4, 2), (6, 3), (12, 2), (6, 2)]
+    scenarios = [synth_repo(*shapes[i % len(shapes)],
+                            divergent=(i % 5 == 0)) for i in range(16)]
+    want = [baseline(s) for s in scenarios]
+    with active_batching(window_ms=100.0) as sched:
+        got = run_concurrent([(s, None) for s in scenarios])
+        stats = sched.stats()
+    for i, fp in enumerate(got):
+        assert fp == want[i], f"request {i} diverged at concurrency 16"
+    assert stats["requests_batched"] == 16
+    assert stats["mean_batch_size"] > 1.0
+    assert 0.0 <= stats["padding_waste_ratio"] <= 1.0
+    assert stats["program_cache"]["programs"] >= 1

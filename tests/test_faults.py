@@ -221,7 +221,8 @@ def test_no_degrade_flag_equals_strict_env(repo, monkeypatch):
 def test_exit_codes_documented_and_distinct():
     assert EXIT_CODES == {"ParseFault": 10, "KernelFault": 11,
                           "WorkerFault": 12, "ApplyFault": 13,
-                          "FormatFault": 14, "DeadlineFault": 15}
+                          "FormatFault": 14, "DeadlineFault": 15,
+                          "BatchFault": 16}
     assert len(set(EXIT_CODES.values())) == len(EXIT_CODES)
     # Reserved result codes stay distinct from fault codes.
     assert not {0, 1, 2, 3} & set(EXIT_CODES.values())
@@ -304,6 +305,70 @@ def test_service_stages_registered_as_worker_faults():
     finally:
         os.environ.pop("SEMMERGE_FAULT", None)
         faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Batch stages: typed BatchFault registration + compound-stage parsing
+# ---------------------------------------------------------------------------
+
+def test_batch_stages_registered_as_batch_faults():
+    from semantic_merge_tpu.errors import STAGE_FAULTS, BatchFault
+    assert BatchFault.exit_code == 16
+    for stage in ("batch", "batch:pack", "batch:dispatch", "batch:scatter"):
+        assert STAGE_FAULTS[stage] is BatchFault
+    # The compound stage survives SEMMERGE_FAULT's colon syntax.
+    faults.reset()
+    try:
+        os.environ["SEMMERGE_FAULT"] = "batch:pack:fault"
+        with pytest.raises(BatchFault) as exc_info:
+            faults.check("batch:pack")
+        assert exc_info.value.stage == "batch:pack"
+        assert exc_info.value.cause == "injected"
+    finally:
+        os.environ.pop("SEMMERGE_FAULT", None)
+        faults.reset()
+
+
+BATCH_FAULT_STAGES = ["batch:pack", "batch:dispatch", "batch:scatter"]
+
+
+@pytest.mark.parametrize("stage", BATCH_FAULT_STAGES)
+def test_batch_stage_fault_degrades_request_to_unbatched(repo, monkeypatch,
+                                                         stage):
+    """In the default (auto) posture an injected batch-stage fault
+    lands THIS request on the inline unbatched path: the merge still
+    succeeds with the exact result — never worse than one-shot."""
+    from semantic_merge_tpu import batch
+    expected = expected_textual_tree(repo)  # == semantic result by design
+    monkeypatch.setenv("SEMMERGE_MESH", "off")  # single-device: eligible
+    monkeypatch.setenv("SEMMERGE_FAULT", f"{stage}:fault")
+    batch.activate(window_ms=20.0)
+    try:
+        rc = run_merge_cli(backend="tpu")
+    finally:
+        batch.deactivate()
+    assert rc == 0, f"{stage}:fault must degrade to the inline dispatch"
+    assert tree_state(repo) == expected
+
+
+@pytest.mark.parametrize("stage", BATCH_FAULT_STAGES)
+def test_batch_stage_fault_strict_require_exits_16(repo, monkeypatch, stage):
+    """``SEMMERGE_BATCH=require`` + strict: the injected batch fault is
+    fatal with its documented exit code and an untouched work tree."""
+    from semantic_merge_tpu import batch
+    from semantic_merge_tpu.errors import BatchFault
+    before = tree_state(repo)
+    monkeypatch.setenv("SEMMERGE_MESH", "off")  # single-device: eligible
+    monkeypatch.setenv("SEMMERGE_FAULT", f"{stage}:fault")
+    monkeypatch.setenv("SEMMERGE_BATCH", "require")
+    monkeypatch.setenv("SEMMERGE_STRICT", "1")
+    batch.activate(window_ms=20.0)
+    try:
+        rc = run_merge_cli(backend="tpu")
+    finally:
+        batch.deactivate()
+    assert rc == BatchFault.exit_code
+    assert tree_state(repo) == before
 
 
 # ---------------------------------------------------------------------------

@@ -116,6 +116,78 @@ def test_service_records_validate(schema, tmp_path):
     assert any("no labels" in e for e in schema.validate_service(broken))
 
 
+def test_batch_records_validate(schema, tmp_path, monkeypatch):
+    """A REAL co-batched merge under the Tracer records the four
+    ``batch.*`` spans and the batching metric series; the artifact
+    passes ``validate_batch`` and drifted shapes (renamed span, missing
+    ``requests`` meta, mislabeled outcome counter, labeled histogram)
+    are rejected."""
+    import threading
+
+    import bench
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    from semantic_merge_tpu import batch
+    from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+
+    monkeypatch.setenv("SEMMERGE_MESH", "off")
+    snaps = bench.synth_repo(4, 2)
+    backends = [TpuTSBackend(mesh=False) for _ in range(2)]
+    for be in backends:
+        be.merge(*snaps)  # warm before the scheduler exists: no batching
+    tracer = trace_mod.Tracer(enabled=True)
+    batch.activate(window_ms=100.0)
+    try:
+        with tracer.phase("merge", backend="tpu"):
+            barrier = threading.Barrier(2)
+
+            def work(be):
+                barrier.wait()
+                be.merge(*snaps)
+
+            threads = [threading.Thread(target=work, args=(be,))
+                       for be in backends]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+    finally:
+        batch.deactivate()
+    trace = tmp_path / ".semmerge-trace.json"
+    tracer.write(trace)
+    data = json.loads(trace.read_text())
+    data["metrics"] = obs_metrics.REGISTRY.to_dict()
+    assert schema.validate_trace(data) == []
+    assert schema.validate_batch(data) == []
+    names = {s["name"] for s in data["spans"]}
+    assert set(schema.BATCH_SPANS) <= names, \
+        f"a co-batched merge must record all batch spans, got {names}"
+
+    broken = json.loads(json.dumps(data))
+    for s in broken["spans"]:
+        if s["name"] == "batch.dispatch":
+            s["name"] = "batch.dispatch2"
+    assert any("unknown batch span" in e
+               for e in schema.validate_batch(broken))
+
+    broken = json.loads(json.dumps(data))
+    for s in broken["spans"]:
+        if s["name"].startswith("batch."):
+            s.get("meta", {}).pop("requests", None)
+    assert any("requests" in e for e in schema.validate_batch(broken))
+
+    broken = json.loads(json.dumps(data))
+    counter = broken["metrics"]["counters"]["batch_requests_total"]
+    counter["series"][0]["labels"] = {"verb": "semmerge"}
+    assert any("batch_requests_total" in e
+               for e in schema.validate_batch(broken))
+
+    broken = json.loads(json.dumps(data))
+    hist = broken["metrics"]["histograms"]["batch_size"]
+    hist["series"][0]["labels"] = {"bucket": "x"}
+    assert any("batch_size" in e for e in schema.validate_batch(broken))
+
+
 def test_script_cli_exit_codes(artifacts):
     trace, events = artifacts
     ok = subprocess.run([sys.executable, str(_SCRIPT), str(trace),

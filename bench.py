@@ -448,6 +448,7 @@ PRESETS = {
     "strict": {"files": 10000, "decls": 4, "strict": True},
     "warmserve": {"files": 48, "decls": 4, "warmserve": True},
     "batchserve": {"files": 48, "decls": 4, "batchserve": True},
+    "overload": {"files": 24, "decls": 4, "overload": True},
 }
 
 
@@ -917,6 +918,232 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
         shutil.rmtree(scratch, ignore_errors=True)
 
 
+def run_overload_bench(record: dict, args, json_only: bool = False) -> int:
+    """The ``overload`` preset: what the resilience machinery costs and
+    buys. One daemon, deliberately constrained (2 workers, queue of 2,
+    breaker threshold 3 / cooldown 1s), driven through four phases:
+
+    1. sequential baseline          -> ``baseline_p99_ms``
+    2. 16-thread burst              -> ``overload_p99_ms`` (accepted
+       requests), ``overload_shed_rate`` (typed rejections w/
+       ``retry_after_ms`` over the whole burst)
+    3. wedge the host rung until the breaker opens, then measure
+       skip-without-attempt merges   -> ``breaker_open_latency_ms``
+    4. clear the fault, time half-open probe -> closed
+                                    -> ``breaker_recovery_s``
+
+    plus ``steady_rss_mb`` from the daemon's final status. All additive
+    BENCH fields; headline value = accepted merges/sec under the burst.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+
+    from semantic_merge_tpu.service import client as svc_client
+
+    scratch = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-overload-"))
+    repo = scratch / "repo"
+    sock = str(scratch / "daemon.sock")
+    _build_service_repo(repo, args.files, args.decls)
+
+    child_env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    prior_pp = child_env.get("PYTHONPATH", "")
+    child_env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{prior_pp}"
+                               if prior_pp else pkg_root)
+    child_env["SEMMERGE_DAEMON"] = "off"
+    child_env.pop("SEMMERGE_FAULT", None)
+    child_env.pop("SEMMERGE_METRICS", None)
+    child_env["SEMMERGE_SERVICE_WORKERS"] = "2"
+    child_env["SEMMERGE_SERVICE_QUEUE"] = "2"
+    child_env["SEMMERGE_BREAKER_THRESHOLD"] = "3"
+    child_env["SEMMERGE_BREAKER_COOLDOWN"] = "1.0"
+    if os.environ.get("SEMMERGE_BENCH_PLATFORM") == "cpu":
+        child_env["JAX_PLATFORMS"] = "cpu"
+    merge_argv = ["semmerge", "basebr", "brA", "brB", "--backend", "host"]
+
+    def request(env=None):
+        t0 = time.perf_counter()
+        frame = svc_client.call_verb(
+            "semmerge",
+            {"argv": merge_argv[1:], "cwd": str(repo), "env": env or {}},
+            path=sock, timeout=600)
+        return frame, time.perf_counter() - t0
+
+    def breaker_state(status):
+        return ((status.get("resilience") or {})
+                .get("breakers") or {}).get("host")
+
+    daemon = None
+    try:
+        log = open(sock + ".log", "ab")
+        daemon = subprocess.Popen(
+            [sys.executable, "-m", "semantic_merge_tpu", "serve",
+             "--socket", sock],
+            stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+            cwd="/", env=child_env, start_new_session=True)
+        log.close()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            conn = svc_client._try_connect(sock, timeout=2.0)
+            if conn is not None:
+                svc_client._close(*conn)
+                break
+            if daemon.poll() is not None:
+                record["error"] = (f"daemon exited rc={daemon.returncode} "
+                                   f"during startup (log: {sock}.log)")
+                print(json.dumps(record), flush=True)
+                return 1
+            time.sleep(0.1)
+        else:
+            record["error"] = "daemon did not come up within 120s"
+            print(json.dumps(record), flush=True)
+            return 1
+
+        # Phase 1 — sequential baseline (first request is the warm-up).
+        baseline_walls = []
+        for i in range(9):
+            frame, wall = request()
+            if (frame.get("result") or {}).get("exit_code") != 0:
+                record["error"] = f"baseline merge failed: {frame}"
+                print(json.dumps(record), flush=True)
+                return 1
+            if i > 0:
+                baseline_walls.append(wall)
+        baseline_walls.sort()
+        baseline_p99 = baseline_walls[
+            min(len(baseline_walls) - 1,
+                int(len(baseline_walls) * 0.99))]
+
+        # Phase 2 — 16-thread burst of 4 requests each against 2
+        # workers + queue of 2: admission control must shed the
+        # overflow with typed retry_after_ms rejections while accepted
+        # requests keep a bounded p99.
+        accepted_walls, rejected, other_errors = [], [], []
+        lock = threading.Lock()
+        barrier = threading.Barrier(16)
+
+        def burst_worker():
+            try:
+                barrier.wait()
+                for _ in range(4):
+                    frame, wall = request()
+                    err = frame.get("error") or {}
+                    with lock:
+                        if (frame.get("result") or {}).get("exit_code") == 0:
+                            accepted_walls.append(wall)
+                        elif isinstance(err.get("retry_after_ms"), int):
+                            rejected.append(err)
+                        else:
+                            other_errors.append(str(frame)[:200])
+            except Exception as exc:
+                with lock:
+                    other_errors.append(f"client thread died: {exc}")
+
+        threads = [threading.Thread(target=burst_worker)
+                   for _ in range(16)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        burst_wall = time.perf_counter() - t0
+        if other_errors:
+            record["error"] = ("burst produced undocumented failures: "
+                               + "; ".join(other_errors[:3]))
+            print(json.dumps(record), flush=True)
+            return 1
+        total_burst = len(accepted_walls) + len(rejected)
+        accepted_walls.sort()
+        overload_p99 = accepted_walls[
+            min(len(accepted_walls) - 1,
+                int(len(accepted_walls) * 0.99))] if accepted_walls else 0.0
+
+        # Phase 3 — wedge the host rung until the breaker opens, then
+        # measure the skip-without-attempt path (degrade to the textual
+        # floor with no doomed rung attempt burning latency).
+        fault_env = {"SEMMERGE_FAULT": "scan:raise"}
+        opened = False
+        for _ in range(10):
+            request(fault_env)
+            status = svc_client.call_control("status", path=sock,
+                                             timeout=30)
+            if breaker_state(status) == "open":
+                opened = True
+                break
+        if not opened:
+            record["error"] = ("host-rung breaker did not open after 10 "
+                               "consecutive injected failures")
+            print(json.dumps(record), flush=True)
+            return 1
+        open_walls = []
+        for _ in range(6):
+            frame, wall = request(fault_env)
+            if (frame.get("result") or {}).get("exit_code") == 0:
+                open_walls.append(wall)
+        open_walls.sort()
+        breaker_open_ms = (open_walls[len(open_walls) // 2] * 1e3
+                           if open_walls else 0.0)
+
+        # Phase 4 — clear the fault and time open -> half-open probe ->
+        # closed (the 1s cooldown dominates; the probe itself is one
+        # successful merge).
+        t0 = time.perf_counter()
+        recovery_s = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            frame, _ = request()
+            status = svc_client.call_control("status", path=sock,
+                                             timeout=30)
+            if breaker_state(status) == "closed":
+                recovery_s = time.perf_counter() - t0
+                break
+            time.sleep(0.2)
+        if recovery_s is None:
+            record["error"] = ("breaker did not close within 30s of the "
+                               "fault clearing")
+            print(json.dumps(record), flush=True)
+            return 1
+
+        status = svc_client.call_control("status", path=sock, timeout=30)
+        record["metric"] = (
+            f"accepted merges/sec under 16-thread overload burst "
+            f"(2 workers, queue 2, {args.files} files x {args.decls} "
+            f"decls, host backend)")
+        record["value"] = round(len(accepted_walls) / burst_wall, 2)
+        record["unit"] = "merges/sec"
+        record["vs_baseline"] = round(
+            baseline_p99 / overload_p99, 3) if overload_p99 else 0.0
+        record["overload_shed_rate"] = round(
+            len(rejected) / total_burst, 4) if total_burst else 0.0
+        record["overload_p99_ms"] = round(overload_p99 * 1e3, 1)
+        record["baseline_p99_ms"] = round(baseline_p99 * 1e3, 1)
+        record["breaker_open_latency_ms"] = round(breaker_open_ms, 1)
+        record["breaker_recovery_s"] = round(recovery_s, 3)
+        record["steady_rss_mb"] = round(float(status.get("rss_mb", 0.0)), 1)
+        if not json_only:
+            print(f"# baseline p99: {record['baseline_p99_ms']:8.1f} ms",
+                  file=sys.stderr)
+            print(f"# overload p99: {record['overload_p99_ms']:8.1f} ms  "
+                  f"shed rate: {record['overload_shed_rate']:.3f} "
+                  f"({len(rejected)}/{total_burst})", file=sys.stderr)
+            print(f"# breaker-open p50: "
+                  f"{record['breaker_open_latency_ms']:.1f} ms  "
+                  f"recovery: {record['breaker_recovery_s']:.2f} s  "
+                  f"rss: {record['steady_rss_mb']} MiB", file=sys.stderr)
+        print(json.dumps(record), flush=True)
+        return 0
+    finally:
+        if daemon is not None:
+            try:
+                svc_client.call_control("shutdown", path=sock, timeout=10)
+                daemon.wait(timeout=30)
+            except Exception:
+                daemon.kill()
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
 def run_incremental_bench(record: dict, args, n_changed: int,
                           json_only: bool = False) -> int:
     """The rung5i scenario: a 10k-file tree where only ``n_changed``
@@ -1045,6 +1272,10 @@ def main() -> int:
     if args.preset == "batchserve":
         # Same shape: all merges run inside the spawned daemon.
         return run_batchserve_bench(record, args, json_only=args.json_only)
+    if args.preset == "overload":
+        # Same shape again: admission control, breakers, and RSS are
+        # all exercised inside the spawned daemon.
+        return run_overload_bench(record, args, json_only=args.json_only)
 
     # Accelerator acquisition, hardened (round 1 died here with rc=1 and
     # no JSON): probe the relay-backed TPU plugin in a throwaway

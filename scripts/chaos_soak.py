@@ -1,0 +1,476 @@
+#!/usr/bin/env python3
+"""Chaos/soak harness for the supervised merge service (ISSUE 9).
+
+Drives a ``semmerge serve --supervise`` daemon with concurrent mixed
+traffic — clean ``--inplace`` merges, fault-injected merges that must
+degrade to the byte-exact textual rung, and strict-mode requests that
+must surface documented typed exits — while SIGKILLing the daemon at
+randomized points mid-soak. The supervisor must bring it back on the
+same socket; harness workers ride through the outage with bounded
+idempotent retries, exactly like the real client.
+
+Invariants checked (the acceptance bar):
+
+- **No corrupted or duplicated commits**: after the soak (plus one
+  clean settling merge per repo), every repo's work tree is byte-exact
+  against the known merge result, with no journal/stage/lock debris.
+- **Byte-identical responses or documented typed exits**: every
+  response is a result with exit 0 (clean / degraded) or the request
+  shape's documented typed exit; nothing else.
+- **Self-healing observable**: daemon pid changes across kills;
+  restarts appear in the supervisor's metrics dump.
+- **Bounded memory**: final daemon RSS stays under the hard watermark.
+
+Run standalone::
+
+    python scripts/chaos_soak.py --requests 200 --repos 8 \
+        --concurrency 8 --kills 2 --seed 1 --json
+
+Exit 0 when every invariant holds, 1 otherwise. The tier-1 smoke
+(``tests/test_chaos.py``) imports :func:`run_soak` directly; the
+slow-marked full soak runs a longer schedule with memory pressure.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import pathlib
+import random
+import signal
+import socket as socketlib
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from semantic_merge_tpu.service import protocol  # noqa: E402
+
+#: The merged tree every soak repo must converge on (A renames foo->bar
+#: in util.ts, B adds extra.ts and appends to notes.txt — disjoint
+#: edits, so semantic and textual rungs agree byte-for-byte).
+EXPECTED_TREE = {
+    "src/util.ts": "export function bar(n: number): number {\n"
+                   "  return n;\n}\n",
+    "notes.txt": "hello\nworld\n",
+    "extra.ts": "export function extra(s: string): string { return s; }\n",
+}
+
+#: Engine artifacts excluded from tree comparison.
+ARTIFACTS = {".semmerge-conflicts.json", ".semmerge-trace.json",
+             ".semmerge-events.jsonl", ".semmerge-journal.json"}
+
+#: Request shapes: (name, request env overlay, documented exit codes).
+#: Fault-injected non-strict merges must land on the textual rung
+#: (exit 0); strict ones surface the scan's ParseFault (10) — or, once
+#: the chaos traffic has tripped the host-rung circuit breaker, the
+#: breaker-open WorkerFault (12). Anything else fails the soak.
+SHAPES = [
+    ("clean", {}, {0}),
+    ("degrade-scan", {"SEMMERGE_FAULT": "scan:raise"}, {0}),
+    ("degrade-apply", {"SEMMERGE_FAULT": "apply:fault"}, {0}),
+    ("strict-scan", {"SEMMERGE_FAULT": "scan:fault",
+                     "SEMMERGE_STRICT": "1"}, {10, 12}),
+]
+
+
+def _git(args, cwd):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def build_repo(root: pathlib.Path) -> pathlib.Path:
+    root.mkdir(parents=True)
+    _git(["init", "-q", "-b", "main"], root)
+    _git(["config", "user.email", "t@example.com"], root)
+    _git(["config", "user.name", "t"], root)
+    env = dict(os.environ,
+               GIT_AUTHOR_DATE="2024-01-01T00:00:00Z",
+               GIT_COMMITTER_DATE="2024-01-01T00:00:00Z")
+
+    def commit(msg):
+        subprocess.run(["git", "add", "-A"], cwd=root, check=True,
+                       stdout=subprocess.DEVNULL)
+        subprocess.run(["git", "commit", "-q", "-m", msg], cwd=root,
+                       check=True, env=env, stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+
+    (root / "src").mkdir()
+    (root / "src/util.ts").write_text(
+        "export function foo(n: number): number {\n  return n;\n}\n")
+    (root / "notes.txt").write_text("hello\n")
+    commit("base")
+    _git(["branch", "basebr"], root)
+    _git(["checkout", "-qb", "brA"], root)
+    (root / "src/util.ts").write_text(EXPECTED_TREE["src/util.ts"])
+    commit("rename foo->bar")
+    _git(["checkout", "-q", "main"], root)
+    _git(["checkout", "-qb", "brB"], root)
+    (root / "extra.ts").write_text(EXPECTED_TREE["extra.ts"])
+    (root / "notes.txt").write_text(EXPECTED_TREE["notes.txt"])
+    commit("add extra + edit notes")
+    _git(["checkout", "-q", "main"], root)
+    return root
+
+
+def tree_errors(root: pathlib.Path) -> List[str]:
+    """Byte-exactness + debris check for one settled repo."""
+    errors = []
+    for rel, want in EXPECTED_TREE.items():
+        p = root / rel
+        if not p.is_file():
+            errors.append(f"{root.name}: missing {rel}")
+        elif p.read_text() != want:
+            errors.append(f"{root.name}: {rel} bytes differ")
+    for debris in (".semmerge-journal.json", ".semmerge-stage",
+                   ".semmerge-inplace.lock",
+                   ".semmerge-inplace.lock.breaker"):
+        if (root / debris).exists():
+            errors.append(f"{root.name}: leftover {debris}")
+    extra = hashlib.sha256()  # unexpected tracked-tree files
+    for p in sorted(root.rglob("*")):
+        if not p.is_file():
+            continue
+        rel = p.relative_to(root).as_posix()
+        if rel.startswith(".git/") or rel.split("/")[0] in ARTIFACTS:
+            continue
+        if rel not in EXPECTED_TREE:
+            errors.append(f"{root.name}: unexpected file {rel}")
+        extra.update(rel.encode())
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# Wire plumbing (the harness IS a client: idempotent bounded retries)
+# ---------------------------------------------------------------------------
+
+class Transport(Exception):
+    """Connection-level failure: daemon dead/respawning. Retryable."""
+
+
+def _request_once(sock_path: str, params: Dict[str, Any],
+                  timeout: float = 120.0) -> Dict[str, Any]:
+    s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+    s.settimeout(timeout)
+    try:
+        s.connect(sock_path)
+        rfile = s.makefile("r", encoding="utf-8")
+        wfile = s.makefile("w", encoding="utf-8")
+        protocol.write_message(wfile, {"id": 1, "method": "semmerge",
+                                       "params": params})
+        resp = protocol.read_message(rfile)
+    except (OSError, protocol.ProtocolError) as exc:
+        raise Transport(str(exc)) from exc
+    finally:
+        try:
+            s.close()
+        except OSError:
+            pass
+    if resp is None:
+        raise Transport("connection closed before a response (daemon "
+                        "killed mid-request)")
+    return resp
+
+
+def request(sock_path: str, repo: pathlib.Path, shape_env: Dict[str, str],
+            stats: Dict[str, Any], deadline_s: float = 180.0) -> Dict:
+    """One merge request with the real client's resilience posture:
+    an idempotency key pinned across attempts, transport failures
+    retried until the supervisor brings the daemon back, typed
+    ``retry_after_ms`` rejections honored."""
+    params = {
+        "argv": ["basebr", "brA", "brB", "--inplace", "--backend", "host"],
+        "cwd": str(repo),
+        "env": shape_env,
+        "idempotency_key": f"{os.getpid():x}-{os.urandom(8).hex()}",
+    }
+    deadline = time.monotonic() + deadline_s
+    attempt = 0
+    while True:
+        try:
+            resp = _request_once(sock_path, params)
+        except Transport as exc:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"daemon never came back within {deadline_s:g}s: "
+                    f"{exc}") from exc
+            attempt += 1
+            with stats["lock"]:
+                stats["transport_retries"] += 1
+            time.sleep(min(0.2 * (2 ** min(attempt, 4)), 2.0))
+            continue
+        err = resp.get("error")
+        if err and isinstance(err.get("retry_after_ms"), int) \
+                and "exit_code" in err:
+            if time.monotonic() > deadline:
+                return resp
+            with stats["lock"]:
+                stats["shed_retries"] += 1
+            time.sleep(err["retry_after_ms"] / 1000.0)
+            continue
+        return resp
+
+
+# ---------------------------------------------------------------------------
+# Supervised daemon lifecycle
+# ---------------------------------------------------------------------------
+
+def spawn_supervised(sock_path: str, dump_path: pathlib.Path,
+                     extra_env: Optional[Dict[str, str]] = None,
+                     workers: int = 8) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": str(REPO_ROOT),
+        "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+        "SEMMERGE_DAEMON": "off",
+        "SEMMERGE_METRICS": str(dump_path),
+        "SEMMERGE_SUPERVISE_BACKOFF": "0.1",
+        "SEMMERGE_SERVICE_WORKERS": str(workers),
+    })
+    env.pop("SEMMERGE_FAULT", None)
+    env.pop("SEMMERGE_STRICT", None)
+    if extra_env:
+        env.update(extra_env)
+    log = open(sock_path + ".log", "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "semantic_merge_tpu", "serve",
+         "--supervise", "--socket", sock_path],
+        stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+        cwd="/", env=env, start_new_session=True)
+    log.close()
+    return proc
+
+
+def daemon_status(sock_path: str, timeout: float = 5.0) -> Optional[dict]:
+    s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+    s.settimeout(timeout)
+    try:
+        s.connect(sock_path)
+        rfile = s.makefile("r", encoding="utf-8")
+        wfile = s.makefile("w", encoding="utf-8")
+        protocol.write_message(wfile, {"id": 1, "method": "status",
+                                       "params": {}})
+        resp = protocol.read_message(rfile)
+        return (resp or {}).get("result")
+    except (OSError, protocol.ProtocolError):
+        return None
+    finally:
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+def wait_daemon(sock_path: str, sup: subprocess.Popen,
+                timeout: float = 180.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if sup.poll() is not None:
+            raise RuntimeError(f"supervisor exited rc={sup.returncode} "
+                               f"(log: {sock_path}.log)")
+        status = daemon_status(sock_path)
+        if status:
+            return status
+        time.sleep(0.2)
+    raise RuntimeError(f"daemon not up within {timeout:g}s "
+                       f"(log: {sock_path}.log)")
+
+
+# ---------------------------------------------------------------------------
+# The soak
+# ---------------------------------------------------------------------------
+
+def run_soak(workdir: pathlib.Path, *, requests: int = 200, repos: int = 8,
+             concurrency: int = 8, kills: int = 2, seed: int = 1,
+             hard_mb: float = 4096.0,
+             extra_env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Run the full scenario; returns the report (see module doc)."""
+    rng = random.Random(seed)
+    workdir = pathlib.Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    repo_paths = [build_repo(workdir / f"repo{i}") for i in range(repos)]
+    sock = str(workdir / "chaos.sock")
+    dump = workdir / "supervisor-metrics.json"
+    env = {"SEMMERGE_RSS_HARD_MB": str(hard_mb)}
+    env.update(extra_env or {})
+    sup = spawn_supervised(sock, dump, extra_env=env)
+
+    stats: Dict[str, Any] = {
+        "lock": threading.Lock(), "transport_retries": 0,
+        "shed_retries": 0, "outcomes": {}, "bad_responses": [],
+        "kills": 0, "pids_seen": set(),
+    }
+    report: Dict[str, Any] = {"requests": requests, "errors": []}
+    t0 = time.monotonic()
+    try:
+        status = wait_daemon(sock, sup)
+        stats["pids_seen"].add(status["pid"])
+
+        # The request schedule: shapes spread over repos, kill points
+        # scattered through the middle of the run.
+        schedule = [(rng.randrange(repos), SHAPES[rng.randrange(len(SHAPES))])
+                    for _ in range(requests)]
+        kill_points = sorted(rng.sample(
+            range(requests // 4, max(requests // 4 + kills, 3 * requests // 4)),
+            kills)) if kills else []
+        done = {"n": 0}
+        sem = threading.Semaphore(concurrency)
+        threads: List[threading.Thread] = []
+
+        def fire(repo_idx: int, shape) -> None:
+            name, shape_env, allowed = shape
+            try:
+                resp = request(sock, repo_paths[repo_idx], dict(shape_env),
+                               stats)
+            except RuntimeError as exc:
+                with stats["lock"]:
+                    stats["bad_responses"].append(f"{name}: {exc}")
+                return
+            finally:
+                sem.release()
+            code = None
+            if "result" in resp:
+                code = resp["result"].get("exit_code")
+            elif "error" in resp:
+                code = resp["error"].get("exit_code")
+            with stats["lock"]:
+                stats["outcomes"].setdefault(name, {}).setdefault(
+                    str(code), 0)
+                stats["outcomes"][name][str(code)] += 1
+                if code not in allowed:
+                    stats["bad_responses"].append(
+                        f"{name}: exit {code!r} not in documented {allowed} "
+                        f"({resp.get('error') or ''})")
+
+        for i, (repo_idx, shape) in enumerate(schedule):
+            if kill_points and i == kill_points[0]:
+                kill_points.pop(0)
+                status = daemon_status(sock)
+                if status:
+                    try:
+                        os.kill(status["pid"], signal.SIGKILL)
+                        with stats["lock"]:
+                            stats["kills"] += 1
+                    except OSError:
+                        pass
+            sem.acquire()
+            t = threading.Thread(target=fire, args=(repo_idx, shape))
+            t.start()
+            threads.append(t)
+            done["n"] = i + 1
+        for t in threads:
+            t.join(timeout=300)
+
+        # Settle: one clean merge per repo resolves any journal left by
+        # a SIGKILL mid-commit, then the tree must be byte-exact.
+        final = wait_daemon(sock, sup)
+        stats["pids_seen"].add(final["pid"])
+        for repo in repo_paths:
+            resp = request(sock, repo, {}, stats)
+            code = (resp.get("result") or resp.get("error") or {}) \
+                .get("exit_code")
+            if code != 0:
+                report["errors"].append(
+                    f"{repo.name}: settling merge exited {code!r}")
+        for repo in repo_paths:
+            report["errors"].extend(tree_errors(repo))
+
+        final = daemon_status(sock) or final
+        counters = (final.get("metrics") or {}).get("counters", {})
+
+        def _counter_total(name):
+            series = counters.get(name, {}).get("series")
+            if series is None:
+                return None
+            return sum(s["value"] for s in series)
+
+        # Breaker/shedding state of the (possibly respawned) daemon —
+        # proves the resilience machinery was live during the chaos.
+        report["breaker_transitions"] = _counter_total(
+            "breaker_transitions_total")
+        report["shed_total"] = _counter_total("service_shed_total")
+        report["breakers"] = (final.get("resilience") or {}).get("breakers")
+        report["final_rss_mb"] = final.get("rss_mb")
+        if report["final_rss_mb"] is None \
+                or report["final_rss_mb"] >= hard_mb:
+            report["errors"].append(
+                f"final RSS {report['final_rss_mb']} outside the "
+                f"{hard_mb:g} MiB hard watermark")
+        report["served_total"] = final.get("served_total")
+    finally:
+        # Orderly shutdown so the supervisor's metrics dump lands.
+        if sup.poll() is None:
+            sup.send_signal(signal.SIGTERM)
+            try:
+                sup.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+                sup.wait(timeout=10)
+
+    report["elapsed_s"] = round(time.monotonic() - t0, 3)
+    report["outcomes"] = stats["outcomes"]
+    report["transport_retries"] = stats["transport_retries"]
+    report["shed_retries"] = stats["shed_retries"]
+    report["kills"] = stats["kills"]
+    report["daemon_pids_seen"] = len(stats["pids_seen"])
+    report["errors"].extend(stats["bad_responses"])
+    if stats["kills"] and report["daemon_pids_seen"] < 2:
+        report["errors"].append(
+            "daemon was SIGKILLed but no respawned pid was ever observed")
+    try:
+        metrics = json.loads(dump.read_text())
+        series = metrics.get("counters", {}).get(
+            "supervisor_restarts_total", {}).get("series", [])
+        report["supervisor_restarts"] = sum(s["value"] for s in series)
+    except (OSError, ValueError):
+        report["supervisor_restarts"] = None
+    if stats["kills"] and not report["supervisor_restarts"]:
+        report["errors"].append(
+            "supervisor restarts not observable in the metrics dump")
+    report["ok"] = not report["errors"]
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Chaos/soak the supervised merge service")
+    parser.add_argument("--requests", type=int, default=200)
+    parser.add_argument("--repos", type=int, default=8)
+    parser.add_argument("--concurrency", type=int, default=8)
+    parser.add_argument("--kills", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--hard-mb", type=float, default=4096.0)
+    parser.add_argument("--workdir", default=None,
+                        help="Scratch dir (default: a fresh temp dir)")
+    parser.add_argument("--json", action="store_true",
+                        help="Emit the full report as JSON")
+    args = parser.parse_args(argv)
+    if args.workdir:
+        workdir = pathlib.Path(args.workdir)
+    else:
+        import tempfile
+        workdir = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-chaos-"))
+    report = run_soak(workdir, requests=args.requests, repos=args.repos,
+                      concurrency=args.concurrency, kills=args.kills,
+                      seed=args.seed, hard_mb=args.hard_mb)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(f"soak: {report['requests']} requests, "
+              f"{report['kills']} kills, "
+              f"{report['transport_retries']} transport retries, "
+              f"rss {report.get('final_rss_mb')} MiB, "
+              f"{report['elapsed_s']}s -> "
+              f"{'OK' if report['ok'] else 'FAIL'}")
+        for err in report["errors"]:
+            print(f"  {err}", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

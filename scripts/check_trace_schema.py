@@ -92,6 +92,31 @@ BATCH_METRIC_LABELS = {
     "batch_requests_total": ("outcome",),
 }
 
+#: Label keys of the resilience-layer metric series (admission control
+#: and load shedding in service/daemon.py, circuit breakers in
+#: service/resilience.py, supervised restart in service/supervisor.py
+#: and backends/subproc.py, bounded program caches in ops/fused.py).
+#: Series of these names carrying other label sets are schema drift.
+RESILIENCE_METRIC_LABELS = {
+    "service_shed_total": ("reason",),
+    "breaker_transitions_total": ("rung", "to"),
+    "subprocess_respawns_total": ("reason",),
+    "supervisor_restarts_total": ("reason",),
+    "program_cache_evictions_total": ("cache",),
+    "service_idempotent_replays_total": (),
+}
+
+#: Documented load-shed reasons (runbook, "Overload & self-healing").
+#: Queue-full is deliberately NOT a shed reason: it keeps its own
+#: ``service_requests_total{outcome="rejected"}`` accounting.
+SHED_REASONS = ("rss-hard", "rss-soft", "projected-deadline")
+
+#: Circuit-breaker states as published in the ``breaker_state`` gauge.
+BREAKER_STATES = (0, 1, 2)  # closed / open / half-open
+
+#: Breaker transition targets (``breaker_transitions_total{to=…}``).
+BREAKER_TARGETS = ("closed", "open", "half-open")
+
 #: Required keys of a BENCH JSON record (the driver contract).
 BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 
@@ -108,6 +133,8 @@ BENCH_NUMERIC_OPTIONAL = (
     "batch_merges_per_sec_c16", "batch_speedup_c16",
     "batch_p50_ms", "batch_p99_ms", "mean_batch_size",
     "batch_padding_waste_ratio", "batch_program_cache_hit_rate",
+    "overload_shed_rate", "overload_p99_ms", "baseline_p99_ms",
+    "breaker_open_latency_ms", "breaker_recovery_s", "steady_rss_mb",
 )
 
 
@@ -361,6 +388,92 @@ def validate_batch(data: Any) -> List[str]:
     return errors
 
 
+def validate_resilience(data: Any) -> List[str]:
+    """Validate the overload/self-healing records of a trace/events-
+    shaped artifact (or a daemon status payload's ``metrics`` block):
+    the resilience metric series carry their documented label sets,
+    ``service_shed_total`` reasons are documented ones, the
+    ``breaker_state`` gauge carries exactly a ``rung`` label with a
+    value in {0 closed, 1 open, 2 half-open}, ``service_rss_mb`` is an
+    unlabeled non-negative gauge, and every ``supervisor.restart`` span
+    carries its restart meta (``reason``/``attempt``/``rc``)."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["resilience: top level must be a JSON object"]
+    for i, row in enumerate(data.get("spans", [])):
+        if not isinstance(row, dict) or row.get("name") != "supervisor.restart":
+            continue
+        meta = row.get("meta")
+        if not isinstance(meta, dict):
+            errors.append(f"trace.spans[{i}]: supervisor.restart span "
+                          f"needs meta")
+            continue
+        if not isinstance(meta.get("reason"), str) or not meta.get("reason"):
+            errors.append(f"trace.spans[{i}]: supervisor.restart meta "
+                          f"missing/empty 'reason'")
+        attempt = meta.get("attempt")
+        if not isinstance(attempt, int) or isinstance(attempt, bool) \
+                or attempt < 1:
+            errors.append(f"trace.spans[{i}]: supervisor.restart meta "
+                          f"'attempt' must be an int >= 1")
+        if "rc" not in meta:
+            errors.append(f"trace.spans[{i}]: supervisor.restart meta "
+                          f"missing 'rc'")
+    metrics = data.get("metrics", data)
+    if not isinstance(metrics, dict):
+        return errors
+    counters = metrics.get("counters", {})
+    if not isinstance(counters, dict):
+        counters = {}
+    for name, labels in RESILIENCE_METRIC_LABELS.items():
+        m = counters.get(name)
+        if not isinstance(m, dict):
+            continue
+        for j, s in enumerate(m.get("series", [])):
+            got = tuple(sorted((s.get("labels") or {}).keys()))
+            if got != tuple(sorted(labels)):
+                errors.append(f"metrics.counters.{name}[{j}]: labels {got} "
+                              f"!= documented {tuple(sorted(labels))}")
+    shed = counters.get("service_shed_total")
+    if isinstance(shed, dict):
+        for j, s in enumerate(shed.get("series", [])):
+            reason = (s.get("labels") or {}).get("reason")
+            if reason not in SHED_REASONS:
+                errors.append(f"metrics.counters.service_shed_total[{j}]: "
+                              f"reason {reason!r} not in {SHED_REASONS}")
+    trans = counters.get("breaker_transitions_total")
+    if isinstance(trans, dict):
+        for j, s in enumerate(trans.get("series", [])):
+            to = (s.get("labels") or {}).get("to")
+            if to not in BREAKER_TARGETS:
+                errors.append(
+                    f"metrics.counters.breaker_transitions_total[{j}]: "
+                    f"to {to!r} not in {BREAKER_TARGETS}")
+    gauges = metrics.get("gauges", {})
+    if not isinstance(gauges, dict):
+        gauges = {}
+    state = gauges.get("breaker_state")
+    if isinstance(state, dict):
+        for j, s in enumerate(state.get("series", [])):
+            got = tuple(sorted((s.get("labels") or {}).keys()))
+            if got != ("rung",):
+                errors.append(f"metrics.gauges.breaker_state[{j}]: labels "
+                              f"{got} != ('rung',)")
+            if s.get("value") not in BREAKER_STATES:
+                errors.append(f"metrics.gauges.breaker_state[{j}]: value "
+                              f"{s.get('value')!r} not in {BREAKER_STATES}")
+    rss = gauges.get("service_rss_mb")
+    if isinstance(rss, dict):
+        for j, s in enumerate(rss.get("series", [])):
+            if (s.get("labels") or {}) != {}:
+                errors.append(f"metrics.gauges.service_rss_mb[{j}]: must "
+                              f"carry no labels")
+            if not _is_num(s.get("value")) or s.get("value") < 0:
+                errors.append(f"metrics.gauges.service_rss_mb[{j}]: value "
+                              f"must be a number >= 0")
+    return errors
+
+
 def validate_phase_coverage(data: Any, required) -> List[str]:
     """Check a trace artifact's span/phase names include ``required`` —
     the drift guard for load-bearing phase names (e.g. the apply-layer
@@ -474,6 +587,7 @@ def main(argv: List[str]) -> int:
         errors.extend(validate_degradations(trace))
         errors.extend(validate_service(trace))
         errors.extend(validate_batch(trace))
+        errors.extend(validate_resilience(trace))
     except (OSError, json.JSONDecodeError) as exc:
         errors.append(f"trace: unreadable ({exc})")
     if len(argv) == 2:

@@ -103,7 +103,8 @@ class SubprocessBackend:
     def __init__(self, worker_cmd: Optional[List[str]] = None, *,
                  deadline: Optional[float] = None,
                  max_retries: Optional[int] = None,
-                 retry_backoff: float = 0.05) -> None:
+                 retry_backoff: float = 0.05,
+                 retry_backoff_cap: Optional[float] = None) -> None:
         self._cmd = worker_cmd or [
             sys.executable, "-m", "semantic_merge_tpu.runtime.worker",
             "--backend", "host"]
@@ -115,6 +116,11 @@ class SubprocessBackend:
         self._max_retries = (max_retries if max_retries is not None
                              else int(env_seconds("SEMMERGE_WORKER_RETRIES", 1)))
         self._retry_backoff = retry_backoff
+        self._retry_backoff_cap = (
+            retry_backoff_cap if retry_backoff_cap is not None
+            else env_seconds("SEMMERGE_WORKER_BACKOFF_CAP", 2.0))
+        #: Why the last worker went down — labels the respawn counter.
+        self._down_reason: Optional[str] = None
 
     def configure(self, config) -> None:
         cmd = getattr(config.engine, "worker_cmd", None)
@@ -139,18 +145,37 @@ class SubprocessBackend:
             self._cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             text=True, bufsize=1, env=env, start_new_session=True)
 
+    def _note_respawn(self, reason: str) -> None:
+        obs_metrics.REGISTRY.counter(
+            "subprocess_respawns_total",
+            "Workers respawned after a previous one went down, by reason",
+        ).inc(1, reason=reason)
+
     def _ensure_proc(self) -> subprocess.Popen:
         if self._proc is None or self._proc.poll() is not None:
+            # A recorded teardown reason, or the worker died under us
+            # without one (crash between requests). First-ever spawns
+            # carry neither and are not respawns.
+            reason = self._down_reason
+            if reason is None and self._proc is not None:
+                reason = "worker-exit"
             if _keepalive_enabled():
                 key = tuple(self._cmd)
                 with _SHARED_LOCK:
                     entry = _SHARED.get(key)
                     if entry is None or entry[0].poll() is not None:
+                        if reason is None and entry is not None:
+                            reason = "worker-exit"
                         entry = (self._spawn(), threading.Lock())
                         _SHARED[key] = entry
+                        if reason:
+                            self._note_respawn(reason)
                 self._proc, self._io_lock = entry
             else:
                 self._proc = self._spawn()
+                if reason:
+                    self._note_respawn(reason)
+            self._down_reason = None
         return self._proc
 
     def _call(self, method: str, params: Dict) -> Dict:
@@ -173,7 +198,11 @@ class SubprocessBackend:
                 logger.warning("worker %s failed (%s); respawning and "
                                "resending (attempt %d/%d)", method, exc,
                                attempt + 2, attempts)
-                time.sleep(self._retry_backoff * (2 ** attempt))
+                # Exponential with a cap: repeated deaths back off hard
+                # enough to stop thrashing spawn/die loops, but a
+                # bounded retry never sleeps unboundedly long.
+                time.sleep(min(self._retry_backoff * (2 ** attempt),
+                               self._retry_backoff_cap))
         raise AssertionError("unreachable")
 
     def _call_once(self, method: str, params: Dict) -> Dict:
@@ -192,24 +221,24 @@ class SubprocessBackend:
             proc.stdin.write(json.dumps(request) + "\n")
             proc.stdin.flush()
         except (BrokenPipeError, OSError) as exc:
-            self._shutdown()
+            self._shutdown(reason="pipe-broken")
             raise WorkerError(f"worker pipe broke during {method}: {exc}",
                               cause=type(exc).__name__) from exc
         line = self._read_response_line(proc, method)
         if not line:
             code = proc.poll()
-            self._shutdown()
+            self._shutdown(reason="worker-exit")
             raise WorkerError(
                 f"worker exited (rc={code}) without answering {method}",
                 cause="worker-exit")
         try:
             response = json.loads(line)
         except json.JSONDecodeError as exc:
-            self._shutdown()
+            self._shutdown(reason="protocol")
             raise WorkerError(f"worker spoke non-JSON: {line[:200]!r}",
                               cause="protocol") from exc
         if response.get("id") != request["id"]:
-            self._shutdown()
+            self._shutdown(reason="protocol")
             raise WorkerError(
                 f"worker answered id {response.get('id')} to {request['id']}",
                 cause="protocol")
@@ -245,7 +274,7 @@ class SubprocessBackend:
         if not done.wait(self._deadline):
             kill_process_group(proc)
             done.wait(5.0)
-            self._shutdown()
+            self._shutdown(reason="deadline")
             obs_metrics.REGISTRY.counter(
                 "subprocess_deadline_kills_total",
                 "Workers killed for exceeding the request deadline",
@@ -255,12 +284,13 @@ class SubprocessBackend:
                 f"{method}; process group killed", cause="deadline")
         result = box[0] if box else ""
         if isinstance(result, Exception):
-            self._shutdown()
+            self._shutdown(reason="pipe-broken")
             raise WorkerError(f"worker pipe broke during {method}: {result}",
                               cause=type(result).__name__) from result
         return result
 
-    def _shutdown(self) -> None:
+    def _shutdown(self, reason: Optional[str] = None) -> None:
+        self._down_reason = reason
         proc, self._proc = self._proc, None
         if proc is not None:
             with _SHARED_LOCK:

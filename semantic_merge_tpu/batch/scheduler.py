@@ -72,6 +72,8 @@ class BatchScheduler:
         self._batches = 0
         self._requests = 0
         self._waste_sum = 0.0
+        self._active = 0
+        self._inflight_cap = self.max_inflight
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -108,6 +110,15 @@ class BatchScheduler:
         self._queue.put((request, fut))
         return fut
 
+    def set_inflight_cap(self, cap: int) -> None:
+        """Shrink (or restore) the effective in-flight bound without
+        rebuilding the pool — the daemon's RSS-watermark response. The
+        semaphore keeps its full count; the leader additionally honors
+        this soft cap before dispatching, so a shrink takes effect as
+        running batches finish."""
+        with self._lock:
+            self._inflight_cap = max(1, min(int(cap), self.max_inflight))
+
     # -- accounting --------------------------------------------------------
 
     def note_batch(self, valid: int, padded: int) -> None:
@@ -128,6 +139,7 @@ class BatchScheduler:
             "window_ms": self.window_s * 1e3,
             "max_batch": self.max_batch,
             "max_inflight": self.max_inflight,
+            "inflight_cap": self._inflight_cap,
             "batches_total": batches,
             "requests_batched": requests,
             "mean_batch_size": (requests / batches) if batches else 0.0,
@@ -164,14 +176,29 @@ class BatchScheduler:
             for request, fut in group:
                 by_key.setdefault(request.key, []).append((request, fut))
             for members in by_key.values():
-                self._sem.acquire()
+                self._acquire_slot()
                 try:
                     self._pool.submit(self._dispatch, members)
                 except RuntimeError as exc:  # pool shut down underneath
-                    self._sem.release()
+                    self._release_slot()
                     self._fail_members(members, exc)
             if self._stopping.is_set():
                 break
+
+    def _acquire_slot(self) -> None:
+        self._sem.acquire()
+        while True:
+            with self._lock:
+                if self._active < self._inflight_cap \
+                        or self._stopping.is_set():
+                    self._active += 1
+                    return
+            time.sleep(0.002)
+
+    def _release_slot(self) -> None:
+        with self._lock:
+            self._active -= 1
+        self._sem.release()
 
     def _dispatch(self, members) -> None:
         from .dispatcher import dispatch_group
@@ -180,7 +207,7 @@ class BatchScheduler:
         except BaseException as exc:  # noqa: BLE001 — futures carry it
             self._fail_members(members, exc)
         finally:
-            self._sem.release()
+            self._release_slot()
 
     def _fail_members(self, members, exc) -> None:
         for _request, fut in members:

@@ -158,6 +158,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("--status", action="store_true",
                          help="Query a running daemon's status and exit "
                               "(does not start one)")
+    p_serve.add_argument("--supervise", action="store_true",
+                         help="Run under a supervisor that respawns a "
+                              "crashed daemon with capped backoff "
+                              "(SEMMERGE_SUPERVISE_BACKOFF[_CAP], "
+                              "SEMMERGE_SUPERVISE_MAX_RESTARTS); a clean "
+                              "exit (idle-exit, shutdown) ends supervision")
 
     p_stats = sub.add_parser("stats",
                              help="Pretty-print a semmerge trace/metrics "
@@ -391,36 +397,71 @@ def cmd_semmerge(args: argparse.Namespace) -> int:
         publish_metrics()
 
 
+def _breaker_open_fault(rung: str) -> MergeFault:
+    from .errors import WorkerFault
+    return WorkerFault(f"circuit breaker open for rung {rung!r}: "
+                       f"skipping without an attempt", stage="breaker",
+                       cause="breaker-open")
+
+
 def _merge_ladder(args: argparse.Namespace, tracer: Tracer,
                   *, strict: bool) -> int:
     """Walk the degradation ladder: resolved backend → host backend →
     whole-tree textual 3-way merge. Conflicts (exit 1) and type errors
     (exit 2) are merge *results* and never degrade; only
-    :class:`MergeFault` moves the run down a rung."""
+    :class:`MergeFault` moves the run down a rung.
+
+    Each rung consults its circuit breaker (service/resilience.py): a
+    rung whose breaker is open is skipped *without* paying the failed
+    attempt — the skip is recorded as a normal degradation with
+    ``cause="breaker-open"``. Rung outcomes feed the breaker: merge
+    results (0/1/2) count as rung success; a :class:`MergeFault` counts
+    as failure. The board is a no-op outside the daemon unless
+    ``SEMMERGE_BREAKER=on``."""
+    from .service.resilience import breakers
+    board = breakers()
     backend, config = _resolve_backend(args.backend)
     rung_name = getattr(backend, "name", "?")
     host_like = rung_name in ("host", "ts_host")
-    try:
-        try:
-            return _semantic_attempt(args, config, backend, tracer)
-        finally:
-            backend.close()
-    except MergeFault as fault:
+    if not board.allow(rung_name):
+        backend.close()
+        fault = _breaker_open_fault(rung_name)
         if strict:
             return _fail_fast(fault)
         _record_degradation(rung_name, "text" if host_like else "host",
                             fault, tracer)
-    if not host_like:
+    else:
         try:
-            with fault_boundary("merge"):
-                host_backend, host_config = _resolve_backend("host")
             try:
-                return _semantic_attempt(args, host_config, host_backend,
-                                         tracer)
+                code = _semantic_attempt(args, config, backend, tracer)
             finally:
-                host_backend.close()
+                backend.close()
+            board.record_success(rung_name)
+            return code
         except MergeFault as fault:
-            _record_degradation("host", "text", fault, tracer)
+            board.record_failure(rung_name)
+            if strict:
+                return _fail_fast(fault)
+            _record_degradation(rung_name, "text" if host_like else "host",
+                                fault, tracer)
+    if not host_like:
+        if not board.allow("host"):
+            _record_degradation("host", "text", _breaker_open_fault("host"),
+                                tracer)
+        else:
+            try:
+                with fault_boundary("merge"):
+                    host_backend, host_config = _resolve_backend("host")
+                try:
+                    code = _semantic_attempt(args, host_config, host_backend,
+                                             tracer)
+                finally:
+                    host_backend.close()
+                board.record_success("host")
+                return code
+            except MergeFault as fault:
+                board.record_failure("host")
+                _record_degradation("host", "text", fault, tracer)
     try:
         return _textual_rung(args, tracer)
     except MergeFault as fault:
@@ -681,6 +722,11 @@ def cmd_serve(args: argparse.Namespace) -> int:
             return 1
         print(json.dumps(status, indent=2, default=str))
         return 0
+    if getattr(args, "supervise", False):
+        # The supervisor process stays import-light (no jax, no engine):
+        # nothing in it can fail the way the daemon child does.
+        from .service.supervisor import Supervisor, serve_argv
+        return Supervisor(serve_argv(args)).run()
     from .service.daemon import Daemon
     daemon = Daemon(socket_path=args.socket, workers=args.workers,
                     queue_size=args.queue, idle_exit=args.idle_exit,

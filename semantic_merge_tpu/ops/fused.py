@@ -702,7 +702,16 @@ def _fused_diff_kernel(b_cols, s_cols, hash_tab, dig,
     ])
 
 
-@lru_cache(maxsize=None)
+#: Bound on each jitted-program cache (``SEMMERGE_PROG_CACHE``). The
+#: bucket ladders keep the key space O(log) so a warm daemon never
+#: nears it; the cap is the OOM backstop for adversarial shape mixes.
+_PROG_CACHE_CAP = max(4, int(os.environ.get("SEMMERGE_PROG_CACHE", "")
+                             or 32))
+
+_EVICTIONS_HELP = "Jitted-program cache evictions, by cache"
+
+
+@lru_cache(maxsize=_PROG_CACHE_CAP)
 def _sharded_fn(mesh, nb: int, nl: int, nr: int,
                 C: int, k: int, split: bool = False):
     from jax.sharding import PartitionSpec as P
@@ -729,22 +738,27 @@ def _sharded_fn(mesh, nb: int, nl: int, nr: int,
 # O(log), so a warm daemon compiles a handful of variants ever.
 
 _batch_prog_lock = threading.Lock()
-_batch_progs: Dict[Tuple[int, int, int, int], object] = {}
+_batch_progs: "OrderedDict[Tuple[int, int, int, int, int], object]" = \
+    OrderedDict()
 _batch_prog_hits = 0
 _batch_prog_misses = 0
+_batch_prog_evictions = 0
 
 
 def batched_fused_program(B: int, nb: int, nl: int, nr: int, C: int):
     """The jitted batched fused-merge program for one bucket shape:
     maps ``(b[B,4,nb], l[B,4,nl], r[B,4,nr], hash_tab[B,cap,10],
     dig_l[B,16], dig_r[B,16])`` to the ``[B, 8 + 24C]`` stack of
-    one-buffer packed rows (``split=False`` layout)."""
-    global _batch_prog_hits, _batch_prog_misses
+    one-buffer packed rows (``split=False`` layout). The cache is an
+    LRU bounded at ``SEMMERGE_PROG_CACHE`` entries with evictions
+    counted (``program_cache_evictions_total{cache="batched"}``)."""
+    global _batch_prog_hits, _batch_prog_misses, _batch_prog_evictions
     key = (B, nb, nl, nr, C)
     with _batch_prog_lock:
         prog = _batch_progs.get(key)
         if prog is not None:
             _batch_prog_hits += 1
+            _batch_progs.move_to_end(key)
             return prog
         _batch_prog_misses += 1
 
@@ -754,8 +768,19 @@ def batched_fused_program(B: int, nb: int, nl: int, nr: int, C: int):
                                    C=C, split=False)
 
     prog = jax.jit(jax.vmap(one))
+    evicted = 0
     with _batch_prog_lock:
-        return _batch_progs.setdefault(key, prog)
+        prog = _batch_progs.setdefault(key, prog)
+        _batch_progs.move_to_end(key)
+        while len(_batch_progs) > _PROG_CACHE_CAP:
+            _batch_progs.popitem(last=False)
+            _batch_prog_evictions += 1
+            evicted += 1
+    if evicted:
+        obs_metrics.REGISTRY.counter(
+            "program_cache_evictions_total", _EVICTIONS_HELP).inc(
+                evicted, cache="batched")
+    return prog
 
 
 def batched_program_cache_stats() -> Dict[str, object]:
@@ -763,8 +788,10 @@ def batched_program_cache_stats() -> Dict[str, object]:
     with _batch_prog_lock:
         programs = len(_batch_progs)
         hits, misses = _batch_prog_hits, _batch_prog_misses
+        evictions = _batch_prog_evictions
     total = hits + misses
-    return {"programs": programs, "hits": hits, "misses": misses,
+    return {"programs": programs, "cap": _PROG_CACHE_CAP, "hits": hits,
+            "misses": misses, "evictions": evictions,
             "hit_rate": (hits / total) if total else 0.0}
 
 

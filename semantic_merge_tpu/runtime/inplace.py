@@ -64,6 +64,49 @@ LOCKFILE = ".semmerge-inplace.lock"
 STALE_LOCK_SECONDS = 3600.0
 
 
+def _break_stale_lock(path: pathlib.Path) -> bool:
+    """Break a stale lock **exactly once** across concurrent
+    contenders. A bare ``unlink`` races: two contenders can both judge
+    the lock stale, and between their unlinks a third contender's fresh
+    ``O_EXCL`` create can land — the second unlink then destroys the
+    *fresh* lock and two processes hold the mutex. Breakers therefore
+    serialize on a guard file (``<lock>.breaker``, itself ``O_EXCL``):
+    only the guard holder may unlink a lock it did not create, and its
+    staleness recheck under the guard is authoritative — a live owner
+    only ever unlinks its *own* lock, so a lock still stale inside the
+    guarded section cannot have been replaced by a live one. Returns
+    ``True`` when this call broke the lock."""
+    guard = path.with_name(path.name + ".breaker")
+    try:
+        fd = os.open(guard, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    except FileExistsError:
+        # Another breaker is in its guarded section — let it win. A
+        # guard abandoned by a killed breaker is itself reclaimed by
+        # the same staleness test; the next loop iteration retries.
+        if _lock_is_stale(guard):
+            with contextlib.suppress(OSError):
+                guard.unlink()
+        return False
+    except OSError:
+        return False
+    try:
+        os.write(fd, f"{os.getpid()} {int(time.time())}\n".encode("ascii"))
+    finally:
+        os.close(fd)
+    try:
+        if not _lock_is_stale(path):
+            return False  # released (or re-acquired live) since the probe
+        path.unlink(missing_ok=True)
+        logger.warning("reclaiming stale in-place lock %s", path)
+        obs_metrics.REGISTRY.counter(
+            "semmerge_inplace_lock_stale_total",
+            "Stale repo-level in-place locks reclaimed").inc(1)
+        return True
+    finally:
+        with contextlib.suppress(OSError):
+            guard.unlink()
+
+
 def _lock_is_stale(path: pathlib.Path) -> bool:
     """A lock left by a dead or long-gone process: old mtime (the
     driver-latch heuristic), or a recorded pid that no longer exists."""
@@ -111,11 +154,7 @@ def repo_lock(root: pathlib.Path | None = None,
             break
         except FileExistsError:
             if _lock_is_stale(path):
-                logger.warning("reclaiming stale in-place lock %s", path)
-                obs_metrics.REGISTRY.counter(
-                    "semmerge_inplace_lock_stale_total",
-                    "Stale repo-level in-place locks reclaimed").inc(1)
-                path.unlink(missing_ok=True)
+                _break_stale_lock(path)
                 continue
             if deadline is not None and time.monotonic() > deadline:
                 from ..errors import ApplyFault

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import random
 import socket
 import subprocess
 import sys
@@ -46,6 +47,19 @@ _Conn = Tuple[socket.socket, Any, Any]  # (sock, rfile, wfile)
 
 class DaemonUnavailable(Exception):
     """No daemon could be reached/spawned, or the transport broke."""
+
+
+class _RetryableRejection(Exception):
+    """A typed admission rejection carrying a ``retry_after_ms`` hint —
+    transient overload, not a final answer: the client may retry the
+    daemon after the hinted delay."""
+
+    def __init__(self, exit_code: int, message: str,
+                 retry_after_ms: int) -> None:
+        super().__init__(message)
+        self.exit_code = exit_code
+        self.message = message
+        self.retry_after_ms = retry_after_ms
 
 
 def mode() -> str:
@@ -76,13 +90,57 @@ def delegate(argv: Sequence[str]) -> Optional[int]:
 
 
 def _run_on_daemon(verb: str, rest: List[str]) -> int:
+    """Delegate with bounded retries. Two retry-worthy outcomes exist:
+
+    - a **transient admission rejection** (``retry_after_ms`` on the
+      wire error — queue full, load shed): sleep the hinted delay
+      (jittered, so a herd of rejected clients does not re-arrive in
+      lockstep) and retry; exhausted retries fall back in-process in
+      ``auto`` (the merge still happens, never worse than one-shot)
+      and exit with the typed code in ``require``;
+    - a **transport failure** (daemon died mid-request, spawn lost a
+      race): retry against a fresh connection with short backoff. The
+      idempotency key makes the resend safe — a daemon that already
+      completed the first execution replays the recorded response
+      instead of executing twice.
+
+    Typed errors without ``retry_after_ms`` stay FINAL answers."""
     deadline = _env_float("SEMMERGE_SERVICE_DEADLINE", 0.0)
+    retries = max(0, int(_env_float("SEMMERGE_SERVICE_RETRIES", 2)))
+    idem_key = f"{os.getpid():x}-{os.urandom(8).hex()}"
+    attempt = 0
+    while True:
+        try:
+            return _attempt_on_daemon(verb, rest, deadline, idem_key)
+        except _RetryableRejection as rej:
+            if attempt >= retries:
+                if mode() == "require":
+                    if rej.message:
+                        sys.stderr.write(f"semmerge: {rej.message} "
+                                         f"(exit {rej.exit_code})\n")
+                    return rej.exit_code
+                raise DaemonUnavailable(
+                    f"daemon still shedding after {attempt + 1} "
+                    f"attempts: {rej.message}")
+            time.sleep(min((rej.retry_after_ms / 1000.0)
+                           * random.uniform(0.5, 1.5), 5.0))
+        except DaemonUnavailable:
+            if attempt >= retries:
+                raise
+            time.sleep(min(0.05 * (2 ** attempt)
+                           * random.uniform(0.5, 1.5), 2.0))
+        attempt += 1
+
+
+def _attempt_on_daemon(verb: str, rest: List[str], deadline: float,
+                       idem_key: str) -> int:
     sock, rfile, wfile = _connect_or_spawn()
     try:
         params: Dict[str, Any] = {
             "argv": rest,
             "cwd": os.getcwd(),
             "env": protocol.request_env(),
+            "idempotency_key": idem_key,
         }
         if deadline > 0:
             params["deadline_s"] = deadline
@@ -104,6 +162,11 @@ def _run_on_daemon(verb: str, rest: List[str]) -> int:
         error = resp.get("error")
         if error is not None:
             exit_code = error.get("exit_code")
+            retry_after = error.get("retry_after_ms")
+            if isinstance(exit_code, int) and isinstance(retry_after, int):
+                raise _RetryableRejection(exit_code,
+                                          error.get("message", ""),
+                                          retry_after)
             if isinstance(exit_code, int):
                 # Typed fault: a FINAL answer (see module docstring).
                 message = error.get("message", "")

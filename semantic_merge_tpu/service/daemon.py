@@ -43,6 +43,7 @@ import socket
 import sys
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 from ..errors import DeadlineFault, MergeFault, WorkerFault, fault_boundary
@@ -51,12 +52,18 @@ from ..obs import spans as obs_spans
 from ..utils import faults, reqenv, workdir
 from ..utils.loggingx import logger
 from ..utils.procs import env_seconds
-from . import protocol
+from . import protocol, resilience
 
 _OUTCOME_BY_EXIT = {0: "ok", 1: "conflicts", 2: "typecheck", 3: "git-error"}
 
 _REQUESTS_HELP = "Service requests, by verb and outcome"
 _QUEUE_DEPTH_HELP = "Requests currently waiting in the admission queue"
+_SHED_HELP = "Requests shed by admission control, by reason"
+_RSS_HELP = "Daemon resident set size (MiB), sampled by the pressure monitor"
+_IDEM_HELP = "Requests answered from the idempotency cache"
+
+#: Pressure levels the RSS monitor publishes (watermark crossings).
+_PRESSURE_NONE, _PRESSURE_SOFT, _PRESSURE_HARD = 0, 1, 2
 
 
 def _env_int(name: str, default: int) -> int:
@@ -78,6 +85,14 @@ def _rss_mb() -> float:
     except (OSError, ValueError, IndexError):
         pass
     return 0.0
+
+
+def _request_batches() -> bool:
+    """Will the current request's fused dispatches join the batch
+    scheduler? Evaluated under the request's env overlay, so a
+    client-shipped ``SEMMERGE_BATCH=off`` reads as non-batched."""
+    from .. import batch
+    return batch.posture() != "off" and batch.current() is not None
 
 
 class _ThreadTee(io.TextIOBase):
@@ -119,7 +134,7 @@ class _ThreadTee(io.TextIOBase):
 
 class _Request:
     __slots__ = ("id", "verb", "argv", "cwd", "env", "deadline_s",
-                 "t_accept", "done", "response")
+                 "idem_key", "t_accept", "done", "response")
 
     def __init__(self, req_id, verb: str, params: Dict[str, Any]) -> None:
         self.id = req_id
@@ -130,6 +145,8 @@ class _Request:
         self.env = {str(k): str(v) for k, v in env.items()}
         raw_deadline = params.get("deadline_s")
         self.deadline_s = float(raw_deadline) if raw_deadline else 0.0
+        raw_idem = params.get("idempotency_key")
+        self.idem_key = str(raw_idem) if raw_idem else None
         self.t_accept = time.monotonic()
         self.done = threading.Event()
         self.response: Optional[Dict[str, Any]] = None
@@ -165,6 +182,14 @@ class Daemon:
         self._served = 0
         self._last_activity = time.monotonic()
         self._t0 = time.time()
+        # Admission control / load shedding state (see runbook,
+        # "Overload & self-healing").
+        self._exec_ewma = 0.0  # EWMA of one request's execute seconds
+        self._soft_mb, self._hard_mb = resilience.rss_watermarks()
+        self._pressure = _PRESSURE_NONE
+        self._idem_cap = max(0, _env_int("SEMMERGE_SERVICE_IDEM_CACHE", 256))
+        self._idem_lock = threading.Lock()
+        self._idem: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -194,6 +219,9 @@ class Daemon:
             threading.Thread(target=self._executor, daemon=True).start()
         if self._repo_ttl > 0:
             threading.Thread(target=self._reaper, daemon=True).start()
+        if self._soft_mb > 0 or self._hard_mb > 0:
+            threading.Thread(target=self._pressure_monitor,
+                             daemon=True).start()
         logger.info("merge service listening on %s (%d workers, queue %d)",
                     self._socket_path, self._workers_n, self._queue.maxsize)
         try:
@@ -281,6 +309,15 @@ class Daemon:
             self._stop.set()
 
     def _teardown(self, sock: socket.socket) -> None:
+        # Socket handoff: close + unlink FIRST, then drain — a
+        # supervisor's replacement daemon can bind the path while this
+        # process finishes its in-flight work, so new requests land on
+        # the replacement instead of racing the shutdown. Clients
+        # already connected keep their established connections.
+        with contextlib.suppress(OSError):
+            sock.close()
+        with contextlib.suppress(OSError):
+            os.unlink(self._socket_path)
         drain = env_seconds("SEMMERGE_SERVICE_DRAIN_TIMEOUT", 30.0)
         deadline = time.monotonic() + drain if drain > 0 else None
         while True:
@@ -292,10 +329,6 @@ class Daemon:
                 logger.warning("drain timeout: abandoning in-flight work")
                 break
             time.sleep(0.05)
-        with contextlib.suppress(OSError):
-            sock.close()
-        with contextlib.suppress(OSError):
-            os.unlink(self._socket_path)
         from .. import batch
         batch.deactivate()
         from ..backends.subproc import shutdown_shared
@@ -355,28 +388,126 @@ class Daemon:
                        wfile) -> None:
         req = _Request(req_id, verb, params)
         with reqenv.overlay(req.env):
+            cached = self._idem_lookup(req)
+            if cached is not None:
+                # A retried request whose first execution completed:
+                # answer from the idempotency cache — never re-execute.
+                self._count_request(verb, "replayed")
+                protocol.write_message(wfile, cached)
+                return
             try:
                 with obs_spans.span("service.accept", layer="service",
                                     verb=verb), \
                         fault_boundary("service:accept"):
                     faults.check("service:accept")
-                    try:
-                        self._queue.put_nowait(req)
-                    except queue.Full:
-                        raise WorkerFault(
-                            f"admission queue full "
-                            f"({self._queue.maxsize} waiting)",
-                            stage="service:accept", cause="queue-full")
+                    self._admit(req)
             except MergeFault as fault:
                 self._count_request(verb, "rejected")
                 protocol.write_message(wfile, {
-                    "id": req.id, "error": protocol.fault_error(fault)})
+                    "id": req.id,
+                    "error": protocol.fault_error(
+                        fault,
+                        retry_after_ms=self._retry_after_for(fault))})
                 return
         self._publish_queue_depth()
         req.done.wait()
         self._last_activity = time.monotonic()
         if req.response is not None:
             protocol.write_message(wfile, req.response)
+
+    #: Rejection causes a client may retry against this daemon after
+    #: ``retry_after_ms`` — transient overload, not request-shaped
+    #: failures.
+    _RETRYABLE_CAUSES = frozenset(
+        {"queue-full", "overload", "projected-deadline"})
+
+    def _admit(self, req: _Request) -> None:
+        """Admission control, cheapest checks first: hard-watermark
+        pressure sheds everything, soft pressure sheds work that will
+        not batch (batched work amortizes device cost; inline work
+        pays full price at the worst time), a projected queue wait
+        past the request deadline is rejected up front instead of
+        timing out in the queue, and finally the bounded queue itself."""
+        if self._pressure >= _PRESSURE_HARD:
+            self._shed("rss-hard")
+            raise WorkerFault(
+                f"shedding load: RSS above the {self._hard_mb:g} MiB "
+                f"hard watermark", stage="service:accept",
+                cause="overload")
+        if self._pressure >= _PRESSURE_SOFT and not _request_batches():
+            self._shed("rss-soft")
+            raise WorkerFault(
+                f"shedding non-batched work: RSS above the "
+                f"{self._soft_mb:g} MiB soft watermark",
+                stage="service:accept", cause="overload")
+        projected = self._projected_wait()
+        if req.deadline_s and projected > req.deadline_s:
+            self._shed("projected-deadline")
+            raise DeadlineFault(
+                f"projected queue wait {projected:.2f}s exceeds the "
+                f"{req.deadline_s:g}s deadline",
+                stage="service:accept", cause="projected-deadline")
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            raise WorkerFault(
+                f"admission queue full "
+                f"({self._queue.maxsize} waiting)",
+                stage="service:accept", cause="queue-full")
+
+    def _projected_wait(self) -> float:
+        """Expected queue wait for a request admitted now: queue depth
+        × the EWMA of execute time, spread over the worker pool."""
+        with self._state_lock:
+            ewma = self._exec_ewma
+        if ewma <= 0:
+            return 0.0
+        return self._queue.qsize() * ewma / max(1, self._workers_n)
+
+    def _retry_after_ms(self) -> int:
+        """How long a rejected client should wait before retrying:
+        the projected drain time of the current queue, clamped to
+        [100 ms, 5 s]."""
+        with self._state_lock:
+            ewma = self._exec_ewma
+        per_slot = ewma if ewma > 0 else 0.25
+        projected = ((self._queue.qsize() + 1) * per_slot
+                     / max(1, self._workers_n))
+        return int(min(max(projected * 1000.0, 100.0), 5000.0))
+
+    def _retry_after_for(self, fault: MergeFault) -> Optional[int]:
+        if getattr(fault, "cause", None) not in self._RETRYABLE_CAUSES:
+            return None
+        return self._retry_after_ms()
+
+    def _shed(self, reason: str) -> None:
+        obs_metrics.REGISTRY.counter(
+            "service_shed_total", _SHED_HELP).inc(1, reason=reason)
+
+    # -- idempotency cache -------------------------------------------------
+
+    def _idem_lookup(self, req: _Request) -> Optional[Dict[str, Any]]:
+        if not req.idem_key or not self._idem_cap:
+            return None
+        with self._idem_lock:
+            cached = self._idem.get(req.idem_key)
+            if cached is None:
+                return None
+            self._idem.move_to_end(req.idem_key)
+        obs_metrics.REGISTRY.counter(
+            "service_idempotent_replays_total", _IDEM_HELP).inc(1)
+        resp = dict(cached)
+        resp["id"] = req.id
+        return resp
+
+    def _idem_store(self, req: _Request) -> None:
+        if not req.idem_key or not self._idem_cap or req.response is None:
+            return
+        with self._idem_lock:
+            self._idem[req.idem_key] = req.response
+            self._idem.move_to_end(req.idem_key)
+            while len(self._idem) > self._idem_cap:
+                self._idem.popitem(last=False)
 
     # ------------------------------------------------------------------
     # execution (executor thread pool)
@@ -395,6 +526,7 @@ class Daemon:
             try:
                 self._execute(req)
             finally:
+                self._idem_store(req)
                 with self._state_lock:
                     self._in_flight -= 1
                     self._served += 1
@@ -418,6 +550,11 @@ class Daemon:
                     faults.check("service:dispatch")
                 with self._repo_lock_for(req):
                     code, out, err, t_start, t_end = self._run_cli(req)
+                duration = t_end - t_start
+                with self._state_lock:
+                    self._exec_ewma = (
+                        duration if self._exec_ewma <= 0
+                        else 0.3 * duration + 0.7 * self._exec_ewma)
                 outcome = _OUTCOME_BY_EXIT.get(code, f"exit-{code}")
                 req.response = {
                     "id": req.id,
@@ -487,6 +624,40 @@ class Daemon:
             entry["last"] = time.time()
         return entry["lock"]
 
+    def _pressure_monitor(self) -> None:
+        """Sample RSS against the watermarks (1 Hz): publish the
+        ``service_rss_mb`` gauge, raise/lower the pressure level, and
+        apply the mitigations — shrink the batch in-flight bound while
+        under pressure (running batches finish; new ones serialize),
+        and clear the decl cache at the hard watermark. Admission-side
+        shedding reads ``self._pressure`` (see :meth:`_admit`)."""
+        from .. import batch
+        from ..frontend.declcache import global_cache
+        while not self._stop.wait(1.0):
+            rss = _rss_mb()
+            obs_metrics.REGISTRY.gauge("service_rss_mb", _RSS_HELP).set(
+                round(rss, 3))
+            level = _PRESSURE_NONE
+            if self._hard_mb > 0 and rss >= self._hard_mb:
+                level = _PRESSURE_HARD
+            elif self._soft_mb > 0 and rss >= self._soft_mb:
+                level = _PRESSURE_SOFT
+            if level == self._pressure:
+                continue
+            prev, self._pressure = self._pressure, level
+            logger.warning(
+                "memory pressure %d -> %d (rss %.0f MiB, "
+                "soft %.0f, hard %.0f)", prev, level, rss,
+                self._soft_mb, self._hard_mb)
+            sched = batch.current()
+            if sched is not None:
+                sched.set_inflight_cap(
+                    1 if level > _PRESSURE_NONE else sched.max_inflight)
+            if level >= _PRESSURE_HARD:
+                cache = global_cache()
+                if cache is not None:
+                    cache.clear()
+
     def _reaper(self) -> None:
         """Evict per-repo state idle past the TTL."""
         interval = max(1.0, min(self._repo_ttl / 2.0, 60.0))
@@ -544,6 +715,15 @@ class Daemon:
             "declcache": decl,
             "declcache_hit_rate": (hits / lookups) if lookups else 0.0,
             "batch": scheduler.stats() if scheduler is not None else None,
+            "resilience": {
+                "pressure": self._pressure,
+                "rss_soft_mb": self._soft_mb,
+                "rss_hard_mb": self._hard_mb,
+                "exec_ewma_s": round(self._exec_ewma, 6),
+                "projected_wait_s": round(self._projected_wait(), 6),
+                "idempotency_cached": len(self._idem),
+                "breakers": resilience.breakers().snapshot(),
+            },
             "metrics": obs_metrics.REGISTRY.to_dict(),
         }
 

@@ -104,13 +104,20 @@ def read_message(rfile) -> Optional[Dict[str, Any]]:
         return msg
 
 
-def fault_error(fault) -> Dict[str, Any]:
+def fault_error(fault,
+                retry_after_ms: Optional[int] = None) -> Dict[str, Any]:
     """The wire form of a typed :class:`~semantic_merge_tpu.errors.
     MergeFault`: everything the client needs to reproduce the one-shot
-    behavior (stderr line + documented exit code)."""
-    return {
+    behavior (stderr line + documented exit code). ``retry_after_ms``
+    rides on *transient* admission rejections (queue-full, overload)
+    and invites the client to retry against the daemon after that
+    delay instead of treating the rejection as final."""
+    err = {
         "message": fault.describe(),
         "fault": type(fault).__name__,
         "stage": fault.stage,
         "exit_code": fault.exit_code,
     }
+    if retry_after_ms is not None:
+        err["retry_after_ms"] = int(retry_after_ms)
+    return err

@@ -1,0 +1,541 @@
+"""Overload-hardened self-healing (ISSUE 9): circuit breakers on the
+degradation ladder, daemon admission control and load shedding with
+``retry_after_ms``, idempotent replay, bounded program caches, worker
+respawn accounting, supervised restart, and the repo-lock stale-break
+race.
+
+The bar:
+
+- A rung whose breaker is open is skipped *without* paying a failed
+  attempt, and a half-open probe restores it when the fault clears.
+- An overloaded daemon rejects with a typed fault carrying
+  ``retry_after_ms``; ``require`` clients surface the documented exit
+  code, ``auto`` clients fall back in-process and still merge.
+- A SIGKILLed daemon under ``serve --supervise`` comes back on the
+  same socket; supervision ends cleanly on SIGTERM.
+- A dead-PID ``--inplace`` lock is broken **exactly once** across
+  concurrent contenders, and mutual exclusion holds throughout.
+"""
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from semantic_merge_tpu.cli import main
+from semantic_merge_tpu.obs import metrics as obs_metrics
+from semantic_merge_tpu.runtime import inplace
+from semantic_merge_tpu.service import protocol, resilience
+from semantic_merge_tpu.service.resilience import CircuitBreaker, breakers
+from semantic_merge_tpu.utils import faults
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def counter_series(name: str, **labels) -> float:
+    """Sum of a counter's series whose labels include ``labels``."""
+    data = obs_metrics.REGISTRY.to_dict()
+    metric = data.get("counters", {}).get(name, {})
+    total = 0.0
+    for s in metric.get("series", []):
+        got = s.get("labels") or {}
+        if all(got.get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+def gauge_value(name: str, **labels):
+    data = obs_metrics.REGISTRY.to_dict()
+    metric = data.get("gauges", {}).get(name, {})
+    for s in metric.get("series", []):
+        if (s.get("labels") or {}) == labels:
+            return s["value"]
+    return None
+
+
+def git(args, cwd):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def commit_all(root, msg):
+    git(["add", "-A"], root)
+    env = {"GIT_AUTHOR_DATE": "2024-01-01T00:00:00Z",
+           "GIT_COMMITTER_DATE": "2024-01-01T00:00:00Z"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        git(["commit", "-q", "-m", msg], root)
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+
+
+def build_repo(root: pathlib.Path) -> pathlib.Path:
+    """The test_faults repo shape: semantic result == textual result,
+    so every rung converges on the same bytes."""
+    root.mkdir(parents=True, exist_ok=True)
+    git(["init", "-q", "-b", "main"], root)
+    git(["config", "user.email", "t@example.com"], root)
+    git(["config", "user.name", "t"], root)
+    (root / "src").mkdir()
+    (root / "src/util.ts").write_text(
+        "export function foo(n: number): number {\n  return n;\n}\n")
+    (root / "notes.txt").write_text("hello\n")
+    commit_all(root, "base")
+    git(["branch", "basebr"], root)
+    git(["checkout", "-qb", "brA"], root)
+    (root / "src/util.ts").write_text(
+        "export function bar(n: number): number {\n  return n;\n}\n")
+    commit_all(root, "rename foo->bar")
+    git(["checkout", "-q", "main"], root)
+    git(["checkout", "-qb", "brB"], root)
+    (root / "extra.ts").write_text(
+        "export function extra(s: string): string { return s; }\n")
+    (root / "notes.txt").write_text("hello\nworld\n")
+    commit_all(root, "add extra + edit notes")
+    git(["checkout", "-q", "main"], root)
+    return root
+
+
+def raw_conn(sock_path: str, timeout: float = 60.0):
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout)
+    s.connect(sock_path)
+    return (s, s.makefile("r", encoding="utf-8"),
+            s.makefile("w", encoding="utf-8"))
+
+
+def raw_close(conn) -> None:
+    s, rfile, wfile = conn
+    for h in (rfile, wfile, s):
+        try:
+            h.close()
+        except OSError:
+            pass
+
+
+def send_merge(conn, cwd: str, env=None, req_id=1, argv=None,
+               idem_key=None) -> None:
+    params = {"argv": argv or ["basebr", "brA", "brB", "--backend", "host"],
+              "cwd": cwd, "env": env or {}}
+    if idem_key:
+        params["idempotency_key"] = idem_key
+    protocol.write_message(conn[2], {"id": req_id, "method": "semmerge",
+                                     "params": params})
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker unit behavior
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_after_threshold_and_recovers():
+    br = CircuitBreaker("x", window_s=30.0, threshold=3, cooldown_s=0.05)
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    time.sleep(0.06)
+    assert br.allow()                      # the half-open probe
+    assert br.state == "half-open"
+    assert not br.allow()                  # one probe at a time
+    br.record_failure()                    # probe failed: re-open
+    assert br.state == "open" and not br.allow()
+    time.sleep(0.06)
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_window_prunes_old_failures():
+    br = CircuitBreaker("y", window_s=0.05, threshold=3, cooldown_s=1.0)
+    br.record_failure()
+    br.record_failure()
+    time.sleep(0.08)
+    br.record_failure()                    # the first two aged out
+    assert br.state == "closed"
+
+
+def test_breaker_board_noop_outside_daemon(monkeypatch):
+    monkeypatch.delenv("SEMMERGE_BREAKER", raising=False)
+    monkeypatch.delenv("_SEMMERGE_IN_DAEMON", raising=False)
+    board = resilience.BreakerBoard()
+    for _ in range(10):
+        board.record_failure("fused")
+    assert board.allow("fused")
+    assert board.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# Breaker on the degradation ladder (end to end, in process)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def repo(tmp_path, monkeypatch):
+    root = build_repo(tmp_path / "repo")
+    monkeypatch.chdir(root)
+    faults.reset()
+    yield root
+    faults.reset()
+
+
+def test_ladder_skips_open_rung_and_half_open_restores(repo, monkeypatch):
+    """Two faulted merges trip the host rung's breaker; the third merge
+    skips the rung *without an attempt* (degradation fault is the
+    breaker's WorkerFault, not the injected ParseFault); after the
+    cooldown with the fault cleared, the half-open probe restores the
+    rung."""
+    monkeypatch.setenv("SEMMERGE_BREAKER", "on")
+    monkeypatch.setenv("SEMMERGE_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("SEMMERGE_BREAKER_COOLDOWN", "0.2")
+    breakers().reset()
+    monkeypatch.setenv("SEMMERGE_FAULT", "scan:raise")
+    try:
+        skip0 = counter_series("merge_degradations_total",
+                               fault="WorkerFault", to="text")
+        for _ in range(2):
+            faults.reset()
+            assert main(["semmerge", "basebr", "brA", "brB", "--inplace",
+                         "--backend", "host"]) == 0
+        assert breakers().snapshot().get("host") == "open"
+        assert gauge_value("breaker_state", rung="host") == 1
+        # Breaker open: the rung is skipped without an attempt.
+        faults.reset()
+        assert main(["semmerge", "basebr", "brA", "brB", "--inplace",
+                     "--backend", "host"]) == 0
+        assert counter_series("merge_degradations_total",
+                              fault="WorkerFault", to="text") == skip0 + 1
+        assert breakers().snapshot().get("host") == "open"
+        # Fault clears; the cooled-down breaker admits one probe,
+        # which succeeds and closes it.
+        monkeypatch.delenv("SEMMERGE_FAULT")
+        faults.reset()
+        time.sleep(0.25)
+        assert main(["semmerge", "basebr", "brA", "brB", "--inplace",
+                     "--backend", "host"]) == 0
+        assert breakers().snapshot().get("host") == "closed"
+        assert gauge_value("breaker_state", rung="host") == 0
+        assert counter_series("merge_degradations_total",
+                              fault="WorkerFault", to="text") == skip0 + 1
+    finally:
+        breakers().reset()
+
+
+def test_strict_mode_breaker_open_is_typed_exit(repo, monkeypatch):
+    """``--no-degrade`` + open breaker: the skip is a fail-fast typed
+    WorkerFault (exit 12), tree untouched — not a silent degrade."""
+    monkeypatch.setenv("SEMMERGE_BREAKER", "on")
+    monkeypatch.setenv("SEMMERGE_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("SEMMERGE_BREAKER_COOLDOWN", "60")
+    breakers().reset()
+    try:
+        monkeypatch.setenv("SEMMERGE_FAULT", "scan:raise")
+        assert main(["semmerge", "basebr", "brA", "brB", "--inplace",
+                     "--backend", "host"]) == 0
+        assert breakers().snapshot().get("host") == "open"
+        monkeypatch.delenv("SEMMERGE_FAULT")
+        faults.reset()
+        rc = main(["semmerge", "basebr", "brA", "brB", "--inplace",
+                   "--backend", "host", "--no-degrade"])
+        assert rc == 12
+    finally:
+        breakers().reset()
+
+
+# ---------------------------------------------------------------------------
+# Daemon admission control and load shedding
+# ---------------------------------------------------------------------------
+
+def test_queue_full_rejection_carries_retry_after(tmp_path, daemon_factory):
+    sock = str(tmp_path / "q.sock")
+    daemon_factory(sock, extra_env={"SEMMERGE_SERVICE_WORKERS": "1",
+                                    "SEMMERGE_SERVICE_QUEUE": "1"})
+    hang = raw_conn(sock)
+    queued = raw_conn(sock)
+    rejected = raw_conn(sock)
+    try:
+        # Wedge the single executor, then fill the queue of one.
+        send_merge(hang, "/", env={"SEMMERGE_FAULT":
+                                   "service:execute:hang=60"})
+        time.sleep(0.5)
+        send_merge(queued, "/")
+        time.sleep(0.3)
+        send_merge(rejected, "/")
+        resp = protocol.read_message(rejected[1])
+        err = resp.get("error")
+        assert err, f"expected a typed rejection, got {resp}"
+        assert err["fault"] == "WorkerFault" and err["exit_code"] == 12
+        assert "queue full" in err["message"]
+        assert isinstance(err.get("retry_after_ms"), int)
+        assert 100 <= err["retry_after_ms"] <= 5000
+    finally:
+        for c in (hang, queued, rejected):
+            raw_close(c)
+
+
+def test_hard_watermark_sheds_and_auto_client_falls_back(tmp_path,
+                                                         daemon_factory):
+    """A daemon whose RSS exceeds the hard watermark sheds everything:
+    raw requests get a typed overload rejection with ``retry_after_ms``,
+    ``require`` clients exit 12 after their bounded retries, ``auto``
+    clients fall back in-process and still complete the merge."""
+    sock = str(tmp_path / "rss.sock")
+    daemon_factory(sock, extra_env={"SEMMERGE_RSS_HARD_MB": "1"})
+    from semantic_merge_tpu.service import client as service_client
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        status = service_client.call_control("status", path=sock)
+        if status["resilience"]["pressure"] == 2:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("pressure monitor never reached the hard watermark")
+
+    conn = raw_conn(sock)
+    try:
+        send_merge(conn, "/")
+        err = protocol.read_message(conn[1]).get("error")
+        assert err and err["exit_code"] == 12
+        assert "hard watermark" in err["message"]
+        assert isinstance(err.get("retry_after_ms"), int)
+    finally:
+        raw_close(conn)
+
+    repo = build_repo(tmp_path / "repo")
+
+    def run_client(posture):
+        env = dict(os.environ)
+        env.update({"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+                    "SEMMERGE_DAEMON": posture,
+                    "SEMMERGE_SERVICE_SOCKET": sock,
+                    "SEMMERGE_SERVICE_RETRIES": "1"})
+        env.pop("SEMMERGE_FAULT", None)
+        return subprocess.run(
+            [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+             "basebr", "brA", "brB", "--inplace", "--backend", "host"],
+            cwd=repo, capture_output=True, text=True, env=env, timeout=300)
+
+    strict = run_client("require")
+    assert strict.returncode == 12, strict.stderr
+    fallback = run_client("auto")
+    assert fallback.returncode == 0, fallback.stderr
+    assert (repo / "extra.ts").exists()  # the merge really landed
+
+    status = service_client.call_control("status", path=sock)
+    shed = status["metrics"]["counters"]["service_shed_total"]["series"]
+    assert sum(s["value"] for s in shed
+               if s["labels"].get("reason") == "rss-hard") >= 3
+
+
+def test_idempotent_replay_returns_cached_response(tmp_path,
+                                                   service_daemon):
+    """Same idempotency key twice: the second answer is served from the
+    daemon's replay cache (counted), byte-identical modulo the id."""
+    from semantic_merge_tpu.service import client as service_client
+    repo = build_repo(tmp_path / "repo")
+    key = "test-idem-0001"
+    c1 = raw_conn(service_daemon)
+    try:
+        send_merge(c1, str(repo), req_id=7, idem_key=key)
+        first = protocol.read_message(c1[1])
+    finally:
+        raw_close(c1)
+    assert first.get("result", {}).get("exit_code") == 0, first
+    before = service_client.call_control("status", path=service_daemon)
+    n0 = _replay_total(before)
+    c2 = raw_conn(service_daemon)
+    try:
+        send_merge(c2, str(repo), req_id=9, idem_key=key)
+        second = protocol.read_message(c2[1])
+    finally:
+        raw_close(c2)
+    assert second["id"] == 9
+    scrub = lambda r: {k: v for k, v in r.items() if k != "id"}  # noqa: E731
+    assert scrub(second) == scrub(first)
+    after = service_client.call_control("status", path=service_daemon)
+    assert _replay_total(after) == n0 + 1
+
+
+def _replay_total(status: dict) -> float:
+    metric = status["metrics"]["counters"].get(
+        "service_idempotent_replays_total", {})
+    return sum(s["value"] for s in metric.get("series", []))
+
+
+# ---------------------------------------------------------------------------
+# Supervised restart
+# ---------------------------------------------------------------------------
+
+def test_supervisor_respawns_sigkilled_daemon(tmp_path):
+    from semantic_merge_tpu.service import client as service_client
+    sock = str(tmp_path / "sup.sock")
+    dump = tmp_path / "sup-metrics.json"
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+                "SEMMERGE_DAEMON": "off", "SEMMERGE_METRICS": str(dump),
+                "SEMMERGE_SUPERVISE_BACKOFF": "0.1"})
+    env.pop("SEMMERGE_FAULT", None)
+    log = open(sock + ".log", "ab")
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "semantic_merge_tpu", "serve",
+         "--supervise", "--socket", sock],
+        stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+        cwd="/", env=env, start_new_session=True)
+    log.close()
+    try:
+        pid1 = _wait_daemon_pid(service_client, sock, sup)
+        os.kill(pid1, signal.SIGKILL)
+        deadline = time.monotonic() + 120
+        pid2 = None
+        while time.monotonic() < deadline:
+            try:
+                status = service_client.call_control("status", path=sock)
+                if status["pid"] != pid1:
+                    pid2 = status["pid"]
+                    break
+            except service_client.DaemonUnavailable:
+                pass
+            time.sleep(0.2)
+        assert pid2 is not None, \
+            f"supervisor never respawned the daemon (log: {sock}.log)"
+        sup.send_signal(signal.SIGTERM)
+        assert sup.wait(timeout=60) == 0
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+            sup.wait(timeout=10)
+    metrics = json.loads(dump.read_text())
+    series = metrics["counters"]["supervisor_restarts_total"]["series"]
+    assert sum(s["value"] for s in series
+               if s["labels"].get("reason") == "signal") >= 1
+
+
+def _wait_daemon_pid(service_client, sock, sup, timeout=120.0) -> int:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if sup.poll() is not None:
+            raise RuntimeError(f"supervisor exited rc={sup.returncode} "
+                               f"during startup (log: {sock}.log)")
+        try:
+            return service_client.call_control("status", path=sock)["pid"]
+        except service_client.DaemonUnavailable:
+            time.sleep(0.2)
+    raise RuntimeError(f"daemon did not come up (log: {sock}.log)")
+
+
+# ---------------------------------------------------------------------------
+# Worker respawn accounting + capped backoff
+# ---------------------------------------------------------------------------
+
+def test_worker_respawn_counted_and_backoff_capped(monkeypatch):
+    from semantic_merge_tpu.backends.subproc import SubprocessBackend
+    monkeypatch.delenv("SEMMERGE_WORKER_KEEPALIVE", raising=False)
+    monkeypatch.setenv("SEMMERGE_WORKER_BACKOFF_CAP", "0.5")
+    be = SubprocessBackend()
+    assert be._retry_backoff_cap == 0.5
+    # The cap really clamps the exponential schedule.
+    assert min(be._retry_backoff * (2 ** 10), be._retry_backoff_cap) == 0.5
+    try:
+        assert be._call("ping", {}).get("pong")
+        before = counter_series("subprocess_respawns_total",
+                                reason="worker-exit")
+        proc = be._proc
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        assert be._call("ping", {}).get("pong")
+        assert counter_series("subprocess_respawns_total",
+                              reason="worker-exit") == before + 1
+    finally:
+        be.close()
+
+
+# ---------------------------------------------------------------------------
+# Bounded batched-program cache
+# ---------------------------------------------------------------------------
+
+def test_batched_program_cache_bounded_lru(monkeypatch):
+    fused = pytest.importorskip("semantic_merge_tpu.ops.fused")
+    monkeypatch.setattr(fused, "_PROG_CACHE_CAP", 2)
+    with fused._batch_prog_lock:
+        fused._batch_progs.clear()
+    ev0 = fused.batched_program_cache_stats()["evictions"]
+    mev0 = counter_series("program_cache_evictions_total", cache="batched")
+    fused.batched_fused_program(1, 1, 1, 1, 1)
+    fused.batched_fused_program(1, 1, 1, 1, 2)
+    fused.batched_fused_program(1, 1, 1, 1, 1)   # refresh key 1
+    fused.batched_fused_program(1, 1, 1, 1, 3)   # evicts key 2 (LRU)
+    stats = fused.batched_program_cache_stats()
+    assert stats["programs"] == 2
+    assert stats["evictions"] == ev0 + 1
+    with fused._batch_prog_lock:
+        assert (1, 1, 1, 1, 2) not in fused._batch_progs
+        assert (1, 1, 1, 1, 1) in fused._batch_progs
+    assert counter_series("program_cache_evictions_total",
+                          cache="batched") == mev0 + 1
+
+
+# ---------------------------------------------------------------------------
+# Stale --inplace lock: broken exactly once under contention
+# ---------------------------------------------------------------------------
+
+def test_stale_lock_broken_exactly_once_under_contention(tmp_path):
+    root = tmp_path / "wt"
+    root.mkdir()
+    lock = root / inplace.LOCKFILE
+    ghost = subprocess.Popen([sys.executable, "-c", "pass"])
+    ghost.wait(timeout=30)
+    with pytest.raises(ProcessLookupError):
+        os.kill(ghost.pid, 0)             # the recorded owner is dead
+    lock.write_text(f"{ghost.pid} {int(time.time())}\n")
+    breaks0 = counter_series("semmerge_inplace_lock_stale_total")
+    state = {"active": 0, "max_active": 0, "errors": []}
+    guard = threading.Lock()
+
+    def contend():
+        try:
+            with inplace.repo_lock(root, timeout=30):
+                with guard:
+                    state["active"] += 1
+                    state["max_active"] = max(state["max_active"],
+                                              state["active"])
+                time.sleep(0.01)
+                with guard:
+                    state["active"] -= 1
+        except BaseException as exc:  # noqa: BLE001 - surfaced below
+            state["errors"].append(exc)
+
+    threads = [threading.Thread(target=contend) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not state["errors"], state["errors"]
+    assert state["max_active"] == 1, "two contenders held the lock at once"
+    assert counter_series("semmerge_inplace_lock_stale_total") \
+        == breaks0 + 1, "the stale lock must be broken exactly once"
+    assert not lock.exists()
+    assert not (root / (inplace.LOCKFILE + ".breaker")).exists(), \
+        "no breaker-guard debris may survive"
+
+
+def test_live_lock_is_not_broken(tmp_path):
+    """A fresh lock owned by a live pid survives a breaker's guarded
+    recheck — the lock stays, nothing is counted."""
+    root = tmp_path / "wt"
+    root.mkdir()
+    lock = root / inplace.LOCKFILE
+    lock.write_text(f"{os.getpid()} {int(time.time())}\n")
+    before = counter_series("semmerge_inplace_lock_stale_total")
+    assert not inplace._lock_is_stale(lock)
+    assert not inplace._break_stale_lock(lock)
+    assert lock.exists()
+    assert not (root / (inplace.LOCKFILE + ".breaker")).exists()
+    assert counter_series("semmerge_inplace_lock_stale_total") == before
+    lock.unlink()

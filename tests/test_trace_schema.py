@@ -188,6 +188,80 @@ def test_batch_records_validate(schema, tmp_path, monkeypatch):
     assert any("batch_size" in e for e in schema.validate_batch(broken))
 
 
+def test_resilience_records_validate(schema, tmp_path, monkeypatch):
+    """REAL resilience primitives — a circuit breaker tripping open and
+    recovering, a load-shed counter, the supervisor restart span —
+    produce an artifact that passes ``validate_resilience``; drifted
+    shapes (mislabeled shed counter, undocumented shed reason, breaker
+    gauge without its ``rung`` label or with an out-of-range state,
+    restart span without meta) are rejected."""
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    from semantic_merge_tpu.service import resilience
+
+    monkeypatch.setenv("SEMMERGE_BREAKER", "on")
+    monkeypatch.setenv("SEMMERGE_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("SEMMERGE_BREAKER_COOLDOWN", "0.01")
+    board = resilience.BreakerBoard()
+    tracer = trace_mod.Tracer(enabled=True)
+    with tracer.phase("merge", backend="host"):
+        assert board.allow("fused")
+        board.record_failure("fused")
+        board.record_failure("fused")   # trips open
+        assert not board.allow("fused")
+        import time
+        time.sleep(0.02)
+        assert board.allow("fused")     # half-open probe
+        board.record_success("fused")   # closes
+        obs_spans.record("supervisor.restart", 0.2, layer="service",
+                         reason="crash", attempt=1, rc=12)
+    obs_metrics.REGISTRY.counter("service_shed_total", "t").inc(
+        1, reason="rss-soft")
+    obs_metrics.REGISTRY.counter("service_idempotent_replays_total",
+                                 "t").inc(1)
+    obs_metrics.REGISTRY.gauge("service_rss_mb", "t").set(123.0)
+    trace = tmp_path / ".semmerge-trace.json"
+    tracer.write(trace)
+    data = json.loads(trace.read_text())
+    data["metrics"] = obs_metrics.REGISTRY.to_dict()
+    assert schema.validate_trace(data) == []
+    assert schema.validate_resilience(data) == []
+    counters = data["metrics"]["counters"]
+    tos = {s["labels"]["to"]
+           for s in counters["breaker_transitions_total"]["series"]}
+    assert {"open", "half-open", "closed"} <= tos
+
+    broken = json.loads(json.dumps(data))
+    shed = broken["metrics"]["counters"]["service_shed_total"]
+    shed["series"][0]["labels"] = {"cause": "rss-soft"}
+    assert any("service_shed_total" in e
+               for e in schema.validate_resilience(broken))
+
+    broken = json.loads(json.dumps(data))
+    shed = broken["metrics"]["counters"]["service_shed_total"]
+    shed["series"][0]["labels"] = {"reason": "because"}
+    assert any("'because'" in e for e in schema.validate_resilience(broken))
+
+    broken = json.loads(json.dumps(data))
+    gauge = broken["metrics"]["gauges"]["breaker_state"]
+    gauge["series"][0]["labels"] = {}
+    assert any("breaker_state" in e
+               for e in schema.validate_resilience(broken))
+
+    broken = json.loads(json.dumps(data))
+    gauge = broken["metrics"]["gauges"]["breaker_state"]
+    gauge["series"][0]["value"] = 7
+    assert any("not in (0, 1, 2)" in e
+               for e in schema.validate_resilience(broken))
+
+    broken = json.loads(json.dumps(data))
+    for s in broken["spans"]:
+        if s["name"] == "supervisor.restart":
+            s["meta"] = {}
+    assert any("supervisor.restart" in e
+               for e in schema.validate_resilience(broken))
+
+
 def test_script_cli_exit_codes(artifacts):
     trace, events = artifacts
     ok = subprocess.run([sys.executable, str(_SCRIPT), str(trace),

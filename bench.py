@@ -435,6 +435,59 @@ def run_strict_bench(record: dict, args, json_only: bool = False) -> int:
     return 0 if parity else 1
 
 
+def run_tracecost_bench(record: dict, args, backend, base, left, right,
+                        json_only: bool = False) -> int:
+    """The ``tracecost`` preset: what always-on observability costs a
+    rung-5 merge. Dark = flight ring disabled, no recorder (the
+    pre-request-tracing fast path). On = the daemon's per-request
+    posture: a request scope carrying a trace id and a (non-detailed)
+    SpanRecorder, plus the flight ring at its default capacity. Asserts
+    the overhead stays under 2% of dark wall time and emits the
+    additive ``trace_overhead_pct`` field."""
+    from semantic_merge_tpu.obs import flight as obs_flight
+
+    repeats = 5
+    # Warm compiles and caches so both arms measure steady state.
+    run_merge_to_payload(backend, base, left, right)
+
+    os.environ[obs_flight.ENV_RING] = "0"
+    obs_flight.reset()
+    dark_s = time_merge(backend, base, left, right, repeats=repeats)
+
+    os.environ[obs_flight.ENV_RING] = str(obs_flight.DEFAULT_RING)
+    obs_flight.reset()
+    on_s = float("inf")
+    for i in range(repeats):
+        recorder = obs_spans.SpanRecorder(detailed=False)
+        t0 = time.perf_counter()
+        with obs_spans.request_scope(f"tracecost-{i}", recorder):
+            run_merge_to_payload(backend, base, left, right)
+        on_s = min(on_s, time.perf_counter() - t0)
+    os.environ.pop(obs_flight.ENV_RING, None)
+    obs_flight.reset()
+
+    overhead_pct = (on_s - dark_s) / dark_s * 100.0 if dark_s > 0 else 0.0
+    ok = overhead_pct < 2.0
+    record["metric"] = (
+        f"request-tracing overhead (rung-5 merge, {args.files} files x "
+        f"{args.decls} decls, flight ring + per-request recorder on vs off)")
+    record["value"] = round(overhead_pct, 3)
+    record["unit"] = "pct"
+    record["vs_baseline"] = round(on_s / dark_s, 4) if dark_s > 0 else 0.0
+    record["trace_overhead_pct"] = round(overhead_pct, 3)
+    record["trace_dark_ms"] = round(dark_s * 1e3, 1)
+    record["trace_on_ms"] = round(on_s * 1e3, 1)
+    if not ok:
+        prior = record.get("error")
+        msg = f"trace overhead {overhead_pct:.2f}% exceeds the 2% budget"
+        record["error"] = f"{prior}; {msg}" if prior else msg
+    if not json_only:
+        print(f"# dark: {dark_s*1e3:8.1f} ms   traced: {on_s*1e3:8.1f} ms   "
+              f"overhead: {overhead_pct:+.2f}%", file=sys.stderr)
+    print(json.dumps(record), flush=True)
+    return 0 if ok else 1
+
+
 # BASELINE.json measurement ladder (rung 1 is the e2e pytest scenario).
 # rung5i is the incremental scenario: repo-scale tree, change-scale work.
 # strict measures the --strict-conflicts premium on a statement-edit
@@ -449,6 +502,7 @@ PRESETS = {
     "warmserve": {"files": 48, "decls": 4, "warmserve": True},
     "batchserve": {"files": 48, "decls": 4, "batchserve": True},
     "overload": {"files": 24, "decls": 4, "overload": True},
+    "tracecost": {"files": 10000, "decls": 4, "tracecost": True},
 }
 
 
@@ -1243,6 +1297,7 @@ def main() -> int:
     conflicts_expected = False
     n_changed = None
     strict_mode = False
+    tracecost_mode = False
     if args.preset is None and args.files is None:
         # The headline number is measured where BASELINE.json defines
         # it: the 10k-file DivergentRename monorepo merge (rung 5).
@@ -1253,6 +1308,7 @@ def main() -> int:
         conflicts_expected = p.get("conflicts", False)
         n_changed = p.get("changed")
         strict_mode = p.get("strict", False)
+        tracecost_mode = p.get("tracecost", False)
     elif args.files is None:
         args.files = 512
 
@@ -1324,6 +1380,9 @@ def main() -> int:
                                      json_only=args.json_only)
     if strict_mode:
         return run_strict_bench(record, args, json_only=args.json_only)
+    if tracecost_mode:
+        return run_tracecost_bench(record, args, tpu, base, left, right,
+                                   json_only=args.json_only)
 
     # Parity gate: the bench number is meaningless if the device path
     # diverges from the oracle. Also warms compiles and the fused

@@ -61,9 +61,12 @@ EXPECTED_TREE = {
     "extra.ts": "export function extra(s: string): string { return s; }\n",
 }
 
-#: Engine artifacts excluded from tree comparison.
+#: Engine artifacts excluded from tree comparison. Postmortem bundles
+#: are expected debris of fault-injected traffic: every degradation and
+#: fault escape dumps one (see "Flight recorder", runbook).
 ARTIFACTS = {".semmerge-conflicts.json", ".semmerge-trace.json",
-             ".semmerge-events.jsonl", ".semmerge-journal.json"}
+             ".semmerge-events.jsonl", ".semmerge-journal.json",
+             ".semmerge-postmortem"}
 
 #: Request shapes: (name, request env overlay, documented exit codes).
 #: Fault-injected non-strict merges must land on the textual rung

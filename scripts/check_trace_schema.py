@@ -9,10 +9,17 @@ JSON record emitted by ``bench.py``. Run standalone::
     python scripts/check_trace_schema.py .semmerge-trace.json \
         [.semmerge-events.jsonl] [--bench BENCH_JSON]
 
+Subcommand modes for the request-tracing artifacts::
+
+    python scripts/check_trace_schema.py validate_postmortem \
+        .semmerge-postmortem/<trace_id>.json [...]
+    python scripts/check_trace_schema.py validate_request_traces \
+        TRACE_JSON TRACE_JSON [...]
+
 Exit 0 when everything conforms, 1 with one line per violation
 otherwise. The tier-1 suite imports :func:`validate_trace` /
 :func:`validate_events` / :func:`validate_bench` / :func:`validate_batch`
-directly
+/ :func:`validate_request_traces` / :func:`validate_postmortem` directly
 (``tests/test_trace_schema.py``), so trace-format drift fails CI before
 it reaches a consumer.
 
@@ -117,6 +124,18 @@ BREAKER_STATES = (0, 1, 2)  # closed / open / half-open
 #: Breaker transition targets (``breaker_transitions_total{to=…}``).
 BREAKER_TARGETS = ("closed", "open", "half-open")
 
+#: Required keys of a postmortem bundle (``obs/flight.py`` dump).
+POSTMORTEM_REQUIRED = ("schema", "trace_id", "reason", "ts", "spans",
+                       "fault", "fault_chain", "breakers", "metrics", "env")
+
+#: Documented postmortem dump reasons (``obs/flight.py`` REASONS).
+POSTMORTEM_REASONS = ("fault-escape", "degradation", "breaker-transition",
+                      "supervisor-restart", "daemon-drain")
+
+#: Required keys of one flight-ring row (``obs/flight.py`` note()).
+FLIGHT_ROW_REQUIRED = ("name", "t", "seconds", "layer", "status", "error",
+                       "trace_id", "thread", "meta")
+
 #: Required keys of a BENCH JSON record (the driver contract).
 BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 
@@ -135,6 +154,7 @@ BENCH_NUMERIC_OPTIONAL = (
     "batch_padding_waste_ratio", "batch_program_cache_hit_rate",
     "overload_shed_rate", "overload_p99_ms", "baseline_p99_ms",
     "breaker_open_latency_ms", "breaker_recovery_s", "steady_rss_mb",
+    "trace_overhead_pct", "trace_dark_ms", "trace_on_ms",
 )
 
 
@@ -488,6 +508,129 @@ def validate_phase_coverage(data: Any, required) -> List[str]:
             for r in required if r not in names]
 
 
+def validate_request_traces(traces: Any) -> List[str]:
+    """Validate a set of per-request trace artifacts for span isolation:
+    each is a conforming trace carrying a non-empty ``trace_id``, no two
+    share an id, and no span inside one trace is stamped with another
+    request's ``trace_id`` — the concurrent-daemon-merges contract."""
+    errors: List[str] = []
+    if not isinstance(traces, list) or not traces:
+        return ["request-traces: need a non-empty array of trace artifacts"]
+    seen: dict = {}
+    for i, data in enumerate(traces):
+        where = f"request-traces[{i}]"
+        if not isinstance(data, dict):
+            errors.append(f"{where}: must be a JSON object")
+            continue
+        errors.extend(f"{where}: {e}" for e in validate_trace(data))
+        tid = data.get("trace_id")
+        if not isinstance(tid, str) or not tid:
+            errors.append(f"{where}: trace_id must be a non-empty string")
+            continue
+        if tid in seen:
+            errors.append(f"{where}: trace_id {tid!r} duplicates "
+                          f"request-traces[{seen[tid]}] — requests must "
+                          f"not share ids")
+        else:
+            seen[tid] = i
+        for j, row in enumerate(data.get("spans", [])):
+            if not isinstance(row, dict):
+                continue
+            meta = row.get("meta")
+            row_tid = meta.get("trace_id") if isinstance(meta, dict) else None
+            if row_tid is not None and row_tid != tid:
+                errors.append(f"{where}.spans[{j}]: span stamped with "
+                              f"foreign trace_id {row_tid!r} (own {tid!r}) "
+                              f"— request traces interleaved")
+    return errors
+
+
+def validate_postmortem(data: Any) -> List[str]:
+    """Validate one postmortem bundle (``.semmerge-postmortem/<id>.json``,
+    written by ``obs/flight.py``): required keys, a documented reason, a
+    non-empty ``trace_id``, conforming flight-ring rows, a string fault
+    chain, breaker states by name, and a conforming metrics block."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["postmortem: top level must be a JSON object"]
+    for key in POSTMORTEM_REQUIRED:
+        if key not in data:
+            errors.append(f"postmortem: missing key {key!r}")
+    if "schema" in data and data["schema"] != 1:
+        errors.append(f"postmortem: unknown schema version "
+                      f"{data['schema']!r}")
+    tid = data.get("trace_id")
+    if not isinstance(tid, str) or not tid:
+        errors.append("postmortem: trace_id must be a non-empty string")
+    if "reason" in data and data["reason"] not in POSTMORTEM_REASONS:
+        errors.append(f"postmortem: reason {data.get('reason')!r} not in "
+                      f"{POSTMORTEM_REASONS}")
+    if "ts" in data and (not _is_num(data["ts"]) or data["ts"] < 0):
+        errors.append("postmortem: ts must be a number >= 0")
+    spans = data.get("spans", [])
+    if not isinstance(spans, list):
+        errors.append("postmortem: spans must be an array")
+        spans = []
+    for i, row in enumerate(spans):
+        where = f"postmortem.spans[{i}]"
+        if not isinstance(row, dict):
+            errors.append(f"{where}: must be an object")
+            continue
+        for key in FLIGHT_ROW_REQUIRED:
+            if key not in row:
+                errors.append(f"{where}: missing key {key!r}")
+        if not isinstance(row.get("name"), str) or not row.get("name"):
+            errors.append(f"{where}: name must be a non-empty string")
+        for key in ("t", "seconds"):
+            if key in row and (not _is_num(row[key]) or row[key] < 0):
+                errors.append(f"{where}: {key} must be a number >= 0")
+        if "status" in row and row["status"] not in SPAN_STATUS:
+            errors.append(f"{where}: status {row['status']!r} not in "
+                          f"{SPAN_STATUS}")
+        for key in ("layer", "error", "trace_id"):
+            v = row.get(key)
+            if v is not None and not isinstance(v, str):
+                errors.append(f"{where}: {key} must be a string or null")
+        if row.get("meta") is not None and not isinstance(row["meta"], dict):
+            errors.append(f"{where}: meta must be an object or null")
+    fault = data.get("fault")
+    if fault is not None:
+        if not isinstance(fault, dict):
+            errors.append("postmortem: fault must be an object or null")
+        else:
+            for key in ("type", "message", "stage", "exit_code"):
+                if key not in fault:
+                    errors.append(f"postmortem: fault missing key {key!r}")
+    chain = data.get("fault_chain")
+    if chain is not None:
+        if not isinstance(chain, list) or any(
+                not isinstance(c, str) for c in chain):
+            errors.append("postmortem: fault_chain must be an array of "
+                          "strings")
+    brk = data.get("breakers")
+    if brk is not None:
+        if not isinstance(brk, dict):
+            errors.append("postmortem: breakers must be an object or null")
+        else:
+            for rung, state in brk.items():
+                if state not in BREAKER_TARGETS:
+                    errors.append(f"postmortem: breakers[{rung!r}] state "
+                                  f"{state!r} not in {BREAKER_TARGETS}")
+    if "metrics" in data:
+        errors.extend(validate_metrics(data["metrics"],
+                                       where="postmortem.metrics"))
+    env = data.get("env")
+    if env is not None:
+        if not isinstance(env, dict):
+            errors.append("postmortem: env must be an object")
+        else:
+            if not isinstance(env.get("pid"), int):
+                errors.append("postmortem: env.pid must be an int")
+            if not isinstance(env.get("env"), dict):
+                errors.append("postmortem: env.env must be an object")
+    return errors
+
+
 def validate_bench(data: Any) -> List[str]:
     """Validate one BENCH JSON record (``bench.py``'s single output
     line). Required driver fields plus the additive extensions:
@@ -564,7 +707,44 @@ def validate_events(lines: List[str]) -> List[str]:
     return errors
 
 
+def _finish(errors: List[str]) -> int:
+    for err in errors:
+        print(err, file=sys.stderr)
+    if not errors:
+        print("ok")
+    return 1 if errors else 0
+
+
 def main(argv: List[str]) -> int:
+    if argv and argv[0] == "validate_postmortem":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_postmortem "
+                  "BUNDLE_JSON [...]", file=sys.stderr)
+            return 2
+        errors: List[str] = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_postmortem(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
+    if argv and argv[0] == "validate_request_traces":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_request_traces "
+                  "TRACE_JSON [...]", file=sys.stderr)
+            return 2
+        traces: List[Any] = []
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    traces.append(json.load(fh))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        errors.extend(validate_request_traces(traces))
+        return _finish(errors)
     bench_path = None
     if "--bench" in argv:
         i = argv.index("--bench")
@@ -602,11 +782,7 @@ def main(argv: List[str]) -> int:
                 errors.extend(validate_bench(json.load(fh)))
         except (OSError, json.JSONDecodeError) as exc:
             errors.append(f"bench: unreadable ({exc})")
-    for err in errors:
-        print(err, file=sys.stderr)
-    if not errors:
-        print("ok")
-    return 1 if errors else 0
+    return _finish(errors)
 
 
 if __name__ == "__main__":

@@ -217,6 +217,10 @@ class SubprocessBackend:
                    params: Dict) -> Dict:
         self._next_id += 1
         request = {"id": self._next_id, "method": method, "params": params}
+        tid = obs_spans.trace_id()
+        if tid:
+            request["trace_id"] = tid
+        t_sent = time.perf_counter()
         try:
             proc.stdin.write(json.dumps(request) + "\n")
             proc.stdin.flush()
@@ -246,7 +250,32 @@ class SubprocessBackend:
             # The worker survived — only this request failed.
             raise WorkerError(str(response["error"].get("message", "unknown")),
                               cause="request-error")
-        return response.get("result", {})
+        result = response.get("result", {})
+        self._graft_worker_spans(result, method, t_sent)
+        return result
+
+    @staticmethod
+    def _graft_worker_spans(result: Dict, method: str,
+                            t_sent: float) -> None:
+        """Pull the worker-side ``_worker`` timing block out of the
+        result and record it as ``worker.*`` spans in the caller's
+        trace — the only window the client has into time spent on the
+        far side of the pipe. Start times are approximated by the
+        client-side send instant (wire latency shifts them slightly
+        but preserves ordering)."""
+        block = result.pop("_worker", None)
+        if not isinstance(block, dict):
+            return
+        seconds = block.get("seconds")
+        if isinstance(seconds, (int, float)):
+            obs_spans.record(f"worker.{method}", float(seconds),
+                             layer="worker", t_start=t_sent)
+        phases = block.get("phases")
+        if isinstance(phases, dict):
+            for name, secs in phases.items():
+                if isinstance(secs, (int, float)):
+                    obs_spans.record(f"worker.{name}", float(secs),
+                                     layer="worker", t_start=t_sent)
 
     def _read_response_line(self, proc: subprocess.Popen, method: str) -> str:
         """One response line, bounded by the per-request deadline.

@@ -22,6 +22,9 @@ unbatched dispatch, require → typed ``BatchFault``).
 """
 from __future__ import annotations
 
+import os
+import time
+
 import numpy as np
 
 from ..errors import fault_boundary
@@ -46,9 +49,15 @@ def submit_request(scheduler, dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
     from ..utils import faults
     with fault_boundary("batch:pack"):
         faults.check("batch:pack")
-        return scheduler.submit(BatchRequest(
+        request = BatchRequest(
             dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
-            nb=nb, nl=nl, nr=nr, C=C))
+            nb=nb, nl=nl, nr=nr, C=C)
+        # Capture the submitting request's tracing scope: the leader
+        # thread has no scope of its own, so batch spans reach each
+        # member's trace only through these handles.
+        request.recorder = obs_spans.current()
+        request.trace_id = obs_spans.trace_id()
+        return scheduler.submit(request)
 
 
 def collect_request(future) -> np.ndarray:
@@ -67,13 +76,36 @@ def collect_request(future) -> np.ndarray:
     return flat
 
 
+def _graft(members, batch_id: str, name: str, seconds: float,
+           t_start: float, **meta) -> None:
+    """Record one leader-side batch span into every member's captured
+    request recorder, stamped with the shared ``batch_id`` and the
+    member's own ``trace_id``. Artifact-only (``record_into``): the
+    leader's own span already fed the histogram and flight ring."""
+    for req, _fut in members:
+        rec = getattr(req, "recorder", None)
+        if rec is not None:
+            obs_spans.record_into(
+                rec, name, seconds, t_start=t_start, layer="batch",
+                batch_id=batch_id, trace_id=getattr(req, "trace_id", None),
+                **meta)
+
+
 def dispatch_group(scheduler, members) -> None:
     """Leader side: pack → one batched program → scatter. ``members``
-    is a same-bucket-key list of ``(BatchRequest, Future)`` pairs."""
+    is a same-bucket-key list of ``(BatchRequest, Future)`` pairs.
+    Every phase span is grafted into each member's request trace under
+    one shared ``batch_id``, so a co-batched request's artifact shows
+    the fused dispatch it rode without absorbing its neighbors' ids."""
     reqs = [req for req, _fut in members]
     valid = len(reqs)
-    with obs_spans.span("batch.pack", layer="batch", requests=valid):
+    batch_id = os.urandom(4).hex()
+    t0 = time.perf_counter()
+    with obs_spans.span("batch.pack", layer="batch", requests=valid,
+                        batch_id=batch_id):
         arrays, padded = pack_group(reqs)
+    _graft(members, batch_id, "batch.pack", time.perf_counter() - t0, t0,
+           requests=valid)
     reg = obs_metrics.REGISTRY
     reg.histogram("batch_size",
                   "Valid merges per batched fused dispatch",
@@ -82,15 +114,22 @@ def dispatch_group(scheduler, members) -> None:
               "Merge-axis padding fraction of the last batched dispatch"
               ).set((padded - valid) / padded)
     geom = reqs[0]
+    t0 = time.perf_counter()
     with obs_spans.span("batch.dispatch", layer="batch", requests=valid,
-                        padded=padded, C=geom.C):
+                        padded=padded, C=geom.C, batch_id=batch_id):
         from ..ops.fused import batched_fused_program
         program = batched_fused_program(padded, geom.nb, geom.nl,
                                         geom.nr, geom.C)
         flat = np.asarray(program(*arrays))
         obs_device.record_transfer("d2h", flat.nbytes)
-    with obs_spans.span("batch.scatter", layer="batch", requests=valid):
+    _graft(members, batch_id, "batch.dispatch", time.perf_counter() - t0, t0,
+           requests=valid, padded=padded)
+    t0 = time.perf_counter()
+    with obs_spans.span("batch.scatter", layer="batch", requests=valid,
+                        batch_id=batch_id):
         for i, (_req, fut) in enumerate(members):
             if not fut.done():
                 fut.set_result(flat[i])
+    _graft(members, batch_id, "batch.scatter", time.perf_counter() - t0, t0,
+           requests=valid)
     scheduler.note_batch(valid, padded)

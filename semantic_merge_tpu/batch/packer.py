@@ -25,7 +25,7 @@ class BatchRequest:
     static geometry the jitted program is specialized on."""
 
     __slots__ = ("dev_b", "dev_l", "dev_r", "hash_tab", "dig_l", "dig_r",
-                 "nb", "nl", "nr", "C")
+                 "nb", "nl", "nr", "C", "recorder", "trace_id")
 
     def __init__(self, dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
                  *, nb: int, nl: int, nr: int, C: int) -> None:
@@ -39,6 +39,11 @@ class BatchRequest:
         self.nl = nl
         self.nr = nr
         self.C = C
+        # Captured by the submitting request thread (``submit_request``)
+        # so the leader can graft its batch spans into each member's
+        # request-scoped trace; the bucket key ignores both.
+        self.recorder = None
+        self.trace_id = None
 
     @property
     def key(self) -> Tuple[int, int, int, int, int]:

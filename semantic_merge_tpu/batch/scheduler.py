@@ -169,9 +169,19 @@ class BatchScheduler:
                     self._stopping.set()
                     break
                 group.append(nxt)
-            obs_spans.record("batch.window",
-                             time.perf_counter() - opened, layer="batch",
-                             requests=len(group))
+            window_s = time.perf_counter() - opened
+            obs_spans.record("batch.window", window_s, layer="batch",
+                             t_start=opened, requests=len(group))
+            # Graft the window wait into every member's request trace —
+            # the leader thread has no request scope, so the members'
+            # captured recorders are the only route in.
+            for request, _fut in group:
+                rec = getattr(request, "recorder", None)
+                if rec is not None:
+                    obs_spans.record_into(
+                        rec, "batch.window", window_s, t_start=opened,
+                        layer="batch", requests=len(group),
+                        trace_id=getattr(request, "trace_id", None))
             by_key: Dict[tuple, list] = {}
             for request, fut in group:
                 by_key.setdefault(request.key, []).append((request, fut))

@@ -182,6 +182,22 @@ def build_parser() -> argparse.ArgumentParser:
                          help="Query the live merge service daemon instead "
                               "of reading an artifact file")
 
+    p_trace = sub.add_parser("trace",
+                             help="Trace-artifact tooling (see runbook: "
+                                  "Observability)")
+    trace_sub = p_trace.add_subparsers(dest="trace_command", required=True)
+    p_analyze = trace_sub.add_parser(
+        "analyze",
+        help="Per-request critical-path latency attribution: queue wait / "
+             "batch window / pack / kernel / host tail / apply, from a "
+             ".semmerge-trace.json or postmortem bundle (p50/p99 over a "
+             "directory of them)")
+    p_analyze.add_argument("artifact",
+                           help="Trace or postmortem artifact, or a "
+                                "directory of them")
+    p_analyze.add_argument("--json", action="store_true",
+                           help="Emit the breakdown as JSON")
+
     p_train = sub.add_parser("train-matcher",
                              help="Train the decl-similarity matcher (orbax "
                                   "checkpoints; resumes from the latest)")
@@ -223,6 +239,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             return cmd_train_matcher(args)
         if args.command == "stats":
             return cmd_stats(args)
+        if args.command == "trace":
+            return cmd_trace(args)
         if args.command == "serve":
             return cmd_serve(args)
     except subprocess.CalledProcessError as exc:
@@ -340,13 +358,23 @@ def _strict_mode(args: argparse.Namespace) -> bool:
 
 
 def _fail_fast(fault: MergeFault) -> int:
+    from .obs import flight as obs_flight
     from .obs import metrics as obs_metrics
+    from .obs import spans as obs_spans
+    from .service.resilience import breakers
+    from .utils import workdir
     obs_metrics.REGISTRY.counter(
         "merge_faults_total",
         "Merge runs failed on a contained fault, by fault and stage",
     ).inc(1, fault=type(fault).__name__, stage=fault.stage)
-    print(f"semmerge: {fault.describe()} (exit {fault.exit_code})",
-          file=sys.stderr)
+    # The fault escapes the ladder: leave a postmortem bundle (flight
+    # ring + fault chain + breaker states) next to the repo, keyed by
+    # the trace id the client sees in its error line.
+    tid = obs_spans.trace_id() or obs_flight.default_trace_id()
+    obs_flight.dump(tid, "fault-escape", fault=fault,
+                    breakers=breakers().snapshot(), root=workdir.root())
+    print(f"semmerge: {fault.describe()} (exit {fault.exit_code}) "
+          f"[trace {tid}]", file=sys.stderr)
     return fault.exit_code
 
 
@@ -365,6 +393,12 @@ def _record_degradation(frm: str, to: str, fault: MergeFault,
     obs_spans.record("degradation", 0.0, layer="cli",
                      **{"from": frm, "to": to, "fault": name,
                         "stage": fault.stage})
+    from .obs import flight as obs_flight
+    from .service.resilience import breakers
+    from .utils import workdir
+    obs_flight.dump(obs_spans.trace_id(), "degradation", fault=fault,
+                    breakers=breakers().snapshot(), root=workdir.root(),
+                    extra={"degradation": {"from": frm, "to": to}})
     tracer.count("degradations", tracer.counters.get("degradations", 0) + 1)
 
 
@@ -886,6 +920,147 @@ def _render_stats(data: dict) -> List[str]:
 
     out.append("unrecognized artifact shape; try --json")
     return out
+
+
+#: Critical-path buckets of ``semmerge trace analyze`` — where one
+#: request's wall time went, in pipeline order.
+CRITICAL_PATH_BUCKETS = ("queue_wait", "batch_window", "pack", "kernel",
+                         "host_tail", "apply")
+
+
+def _bucket_span(name: str, layer) -> str | None:
+    """Map one span to its critical-path bucket (None = unattributed).
+    Nested double counting is avoided by bucketing only the leaf phase
+    splits (fused-engine records, batch spans, the CLI apply phase),
+    never the wrapper spans that contain them."""
+    if name == "service.queue_wait":
+        return "queue_wait"
+    if name == "batch.window":
+        return "batch_window"
+    if name == "batch.pack":
+        return "pack"
+    if name in ("kernel", "batch.dispatch", "h2d"):
+        return "kernel"
+    if name in ("fetch", "compose_decode", "chain_decode",
+                "materialize_overlap", "batch.scatter") or \
+            (name == "materialize" and layer != "cli"):
+        return "host_tail"
+    if name == "materialize" and layer == "cli":
+        return "apply"
+    return None
+
+
+def _analyze_artifact(path: pathlib.Path) -> dict | None:
+    """One artifact's critical-path breakdown, or None when the file is
+    not span-shaped (trace artifact or postmortem bundle)."""
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    if not isinstance(data, dict) or not isinstance(data.get("spans"), list):
+        return None
+    buckets = {b: 0.0 for b in CRITICAL_PATH_BUCKETS}
+    cli_total = 0.0
+    for row in data["spans"]:
+        if not isinstance(row, dict):
+            continue
+        name = row.get("name") or ""
+        layer = row.get("layer")
+        try:
+            secs = float(row.get("seconds") or 0.0)
+        except (TypeError, ValueError):
+            continue
+        if layer == "cli":
+            cli_total += secs
+        b = _bucket_span(name, layer)
+        if b is not None:
+            buckets[b] += secs
+    # Wall estimate: the CLI phases cover the merge itself; queue wait
+    # and the batch window happen before/around them. Engine-level
+    # buckets (pack/kernel/host_tail) nest INSIDE the CLI merge phase,
+    # so they attribute rather than extend the total.
+    total = cli_total + buckets["queue_wait"] + buckets["batch_window"]
+    accounted = sum(buckets.values())
+    return {
+        "artifact": str(path),
+        "trace_id": data.get("trace_id"),
+        "reason": data.get("reason"),
+        "total_seconds": round(total, 6),
+        "buckets": {b: round(v, 6) for b, v in buckets.items()},
+        "other_seconds": round(max(total - accounted, 0.0), 6),
+    }
+
+
+def _pctl(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+
+def cmd_trace(args: argparse.Namespace) -> int:
+    if args.trace_command == "analyze":
+        return cmd_trace_analyze(args)
+    return 2
+
+
+def cmd_trace_analyze(args: argparse.Namespace) -> int:
+    """Per-request latency attribution from trace/postmortem artifacts:
+    one file → its critical-path breakdown; a directory → p50/p99 per
+    bucket over every span-shaped artifact in it."""
+    path = pathlib.Path(args.artifact)
+    if path.is_dir():
+        results = [r for r in (_analyze_artifact(p)
+                               for p in sorted(path.glob("*.json")))
+                   if r is not None]
+        if not results:
+            print(f"error: no span-shaped artifacts under {path}",
+                  file=sys.stderr)
+            return 1
+        summary = {
+            "requests": len(results),
+            "p50": {}, "p99": {},
+            "results": results,
+        }
+        for bucket in CRITICAL_PATH_BUCKETS + ("other_seconds",
+                                               "total_seconds"):
+            vals = [r["buckets"].get(bucket, r.get(bucket, 0.0))
+                    if bucket in CRITICAL_PATH_BUCKETS else r.get(bucket, 0.0)
+                    for r in results]
+            summary["p50"][bucket] = round(_pctl(vals, 0.50), 6)
+            summary["p99"][bucket] = round(_pctl(vals, 0.99), 6)
+        if args.json:
+            print(json.dumps(summary, indent=2))
+            return 0
+        print(f"critical path over {len(results)} request artifact(s):")
+        print(f"{'bucket':<14} {'p50 ms':>10} {'p99 ms':>10}")
+        for bucket in CRITICAL_PATH_BUCKETS + ("other_seconds",
+                                               "total_seconds"):
+            label = bucket.replace("_seconds", "")
+            print(f"{label:<14} {summary['p50'][bucket] * 1e3:>10.1f} "
+                  f"{summary['p99'][bucket] * 1e3:>10.1f}")
+        return 0
+    if not path.is_file():
+        print(f"error: no artifact at {path}", file=sys.stderr)
+        return 1
+    result = _analyze_artifact(path)
+    if result is None:
+        print(f"error: {path} is not a span-shaped trace or postmortem "
+              f"artifact", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(result, indent=2))
+        return 0
+    tid = result.get("trace_id") or "-"
+    print(f"trace {tid}: total {result['total_seconds'] * 1e3:.1f} ms")
+    print(f"{'bucket':<14} {'ms':>10} {'share':>7}")
+    total = result["total_seconds"] or 1.0
+    for bucket in CRITICAL_PATH_BUCKETS:
+        v = result["buckets"][bucket]
+        print(f"{bucket:<14} {v * 1e3:>10.1f} {v / total:>6.1%}")
+    v = result["other_seconds"]
+    print(f"{'other':<14} {v * 1e3:>10.1f} {v / total:>6.1%}")
+    return 0
 
 
 def cmd_train_matcher(args: argparse.Namespace) -> int:

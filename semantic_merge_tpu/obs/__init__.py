@@ -16,12 +16,17 @@ One instrumentation spine for the whole merge pipeline, three pieces:
 - :mod:`~semantic_merge_tpu.obs.device` — JAX backend/platform capture,
   compile-cache counters, host↔device transfer accounting, live-buffer
   high-water marks; attached to the trace artifact.
+- :mod:`~semantic_merge_tpu.obs.flight` — always-on bounded ring of
+  recent span observations (``SEMMERGE_FLIGHT_SPANS``), dumped as
+  ``.semmerge-postmortem/<trace_id>.json`` bundles on fault escape,
+  breaker transition, supervisor respawn, or daemon drain.
 
 Import cost is intentionally trivial (stdlib only — no JAX, no numpy),
 so every layer can import ``obs`` at module top without touching the
 host path's cold-start budget.
 """
-from . import device, metrics, spans  # noqa: F401
+from . import device, flight, metrics, spans  # noqa: F401
 from .metrics import REGISTRY, registry  # noqa: F401
 from .spans import (SpanRecorder, activate, activated, active,  # noqa: F401
-                    current, deactivate, event, record, span)
+                    current, deactivate, event, record, record_into,
+                    request_scope, span, trace_id)

@@ -103,7 +103,8 @@ class Histogram(_Metric):
         if not self.buckets:
             raise ValueError(f"histogram {name!r} needs at least one bucket")
 
-    def observe(self, value: float, **labels: object) -> None:
+    def observe(self, value: float, exemplar: object = None,
+                **labels: object) -> None:
         key = _label_key(labels)
         with self._lock:
             series = self._series.get(key)
@@ -118,6 +119,12 @@ class Histogram(_Metric):
             series["counts"][bisect_left(self.buckets, value)] += 1
             series["sum"] += value
             series["count"] += 1
+            if exemplar is not None:
+                # Last-write-wins exemplar: one representative trace_id
+                # per series, so a latency histogram stays joinable to
+                # an actual request trace.
+                series["exemplar"] = {"trace_id": str(exemplar),
+                                      "value": float(value)}
 
     def sum(self, **labels: object) -> float:
         with self._lock:
@@ -182,8 +189,11 @@ class Registry:
                     "help": m.help,
                     "buckets": list(m.buckets),
                     "series": [
-                        {"labels": dict(key), "counts": list(s["counts"]),
-                         "sum": s["sum"], "count": s["count"]}
+                        dict({"labels": dict(key),
+                              "counts": list(s["counts"]),
+                              "sum": s["sum"], "count": s["count"]},
+                             **({"exemplar": dict(s["exemplar"])}
+                                if "exemplar" in s else {}))
                         for key, s in m._labelled()
                     ],
                 }

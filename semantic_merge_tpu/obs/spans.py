@@ -3,24 +3,39 @@
 
 A :class:`SpanRecorder` collects nestable, thread-safe span records
 (monotonic wall-time, depth/parent links, ok/error status, free-form
-meta) and point events. One recorder can be *activated* process-wide;
-the module-level :func:`span` context manager then records into it from
-any layer without plumbing a handle through every call signature — the
-CLI ``Tracer`` activates one for ``--trace``/``--profile`` runs, and
-``bench.py`` activates one around its instrumented merge.
+meta) and point events. Recorders resolve in two scopes:
 
-Two always-on guarantees keep instrumentation writable in hot paths:
+- **Request scope** (:func:`request_scope`): a
+  :class:`contextvars.ContextVar` the merge service daemon sets around
+  each request, carrying that request's recorder *and* its
+  ``trace_id``. Concurrent daemon requests record into disjoint
+  recorders; :func:`trace_id` exposes the id to any layer (worker
+  frames, postmortem bundles, client-visible errors).
+- **Global activation** (:func:`activate`): the pre-daemon
+  compatibility layer — the CLI ``Tracer`` activates one recorder for
+  ``--trace``/``--profile`` runs, ``bench.py`` activates one around
+  its instrumented merge, the daemon's ``--events`` recorder catches
+  everything outside request scopes. Inside a request scope,
+  ``activate`` rebinds the *scope's* recorder instead (so a ``--trace``
+  run executed by the daemon stays request-local).
+
+Three always-on guarantees keep instrumentation writable in hot paths:
 
 - :func:`span` and :func:`record` feed the phase histogram of
   :mod:`semantic_merge_tpu.obs.metrics` unconditionally (a dict update),
   so cumulative per-phase timing exists even without a recorder;
+- the same call sites feed the bounded flight-recorder ring of
+  :mod:`semantic_merge_tpu.obs.flight` (one dict append), so a fault in
+  an uninstrumented run still leaves span-level evidence;
 - full span records (nesting, meta, JSONL emission) are built only
   while a recorder is active, so dark runs pay two ``perf_counter``
-  calls per span and nothing else.
+  calls per span, a histogram update, and a ring append — nothing else.
 
 Code that needs *expensive* timing fences (``block_until_ready`` on
-device buffers) gates them on :func:`active` — detailed device phase
-splits exist exactly when someone asked for them.
+device buffers) gates them on :func:`detailed_active` — detailed device
+phase splits exist exactly when someone asked for them (``--trace``,
+bench instrumentation), never for the daemon's always-on per-request
+recorders.
 
 Artifacts: the recorder serializes to JSONL rows (``.semmerge-events.jsonl``,
 written by ``Tracer.write``) and to the ``spans`` array summarized into
@@ -34,10 +49,11 @@ import json
 import pathlib
 import threading
 import time
+from contextvars import ContextVar
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
-from . import metrics
+from . import flight, metrics
 
 #: Default events artifact name (next to ``.semmerge-trace.json``).
 EVENTS_ARTIFACT = ".semmerge-events.jsonl"
@@ -45,6 +61,22 @@ EVENTS_ARTIFACT = ".semmerge-events.jsonl"
 _state_lock = threading.Lock()
 _active: "Optional[SpanRecorder]" = None
 _tls = threading.local()
+
+
+class _Scope:
+    """One request's tracing scope: its recorder (may be rebound by a
+    request-local ``Tracer``) and its wire-visible ``trace_id``."""
+
+    __slots__ = ("recorder", "trace_id")
+
+    def __init__(self, recorder: "Optional[SpanRecorder]",
+                 trace_id: Optional[str]) -> None:
+        self.recorder = recorder
+        self.trace_id = trace_id
+
+
+_SCOPE: "ContextVar[Optional[_Scope]]" = ContextVar(
+    "semmerge_span_scope", default=None)
 
 
 @dataclass(slots=True)
@@ -81,12 +113,19 @@ class SpanRecord:
 
 
 class SpanRecorder:
-    """Thread-safe sink for spans and events of one observed run."""
+    """Thread-safe sink for spans and events of one observed run.
 
-    def __init__(self) -> None:
+    ``detailed`` opts the run into *expensive* timing splits (device
+    sync fences in the fused engine). Explicitly requested recorders
+    (``--trace``, bench instrumentation) default to detailed; the
+    daemon's always-on per-request recorders pass ``detailed=False`` so
+    request tracing never serializes the dispatch/fetch overlap."""
+
+    def __init__(self, detailed: bool = True) -> None:
         self._lock = threading.Lock()
         self._next_id = 0
         self.epoch = time.perf_counter()
+        self.detailed = detailed
         self.spans: List[SpanRecord] = []
         self.events: List[dict] = []
 
@@ -104,6 +143,32 @@ class SpanRecorder:
                "thread": threading.current_thread().name, "fields": fields}
         with self._lock:
             self.events.append(row)
+
+    def absorb(self, other: "SpanRecorder", **extra_meta: Any) -> None:
+        """Graft another recorder's rows into this one: span starts are
+        re-based onto this recorder's epoch, ids are remapped (parent
+        links preserved within the absorbed set), and ``extra_meta``
+        (typically ``trace_id=...``) is stamped on every span. The
+        daemon's ``--events`` recorder absorbs each finished request's
+        scoped recorder so the daemon-lifetime artifact still covers
+        every request."""
+        shift = other.epoch - self.epoch
+        with other._lock:
+            spans = list(other.spans)
+            events = list(other.events)
+        id_map = {s.span_id: self._new_id() for s in spans}
+        with self._lock:
+            for s in spans:
+                self.spans.append(SpanRecord(
+                    name=s.name, layer=s.layer,
+                    t_start=s.t_start + shift, seconds=s.seconds,
+                    depth=s.depth, span_id=id_map[s.span_id],
+                    parent_id=id_map.get(s.parent_id, -1),
+                    thread=s.thread, status=s.status, error=s.error,
+                    meta=dict(s.meta, **extra_meta)))
+            for e in events:
+                self.events.append(
+                    dict(e, t_start=round(e["t_start"] + shift, 6)))
 
     # -- views ------------------------------------------------------------
 
@@ -139,19 +204,58 @@ class SpanRecorder:
 
 
 # ---------------------------------------------------------------------------
-# Global activation
+# Scope resolution: request-scoped recorder first, then the global one.
 
 def current() -> Optional[SpanRecorder]:
+    scope = _SCOPE.get()
+    if scope is not None and scope.recorder is not None:
+        return scope.recorder
     return _active
 
 
 def active() -> bool:
-    """True when a recorder is collecting — the gate for timing work
-    with side effects (device sync fences, ``jax.live_arrays`` walks)."""
-    return _active is not None
+    """True when a recorder is collecting full span records."""
+    return current() is not None
+
+
+def detailed_active() -> bool:
+    """True when a *detailed* recorder is collecting — the gate for
+    timing work with side effects (device sync fences,
+    ``jax.live_arrays`` walks). The daemon's always-on per-request
+    recorders are not detailed; ``--trace``/bench recorders are."""
+    rec = current()
+    return rec is not None and rec.detailed
+
+
+def trace_id() -> Optional[str]:
+    """The current request's ``trace_id``, or ``None`` outside any
+    request scope (one-shot CLI runs, daemon-internal threads)."""
+    scope = _SCOPE.get()
+    return scope.trace_id if scope is not None else None
+
+
+@contextlib.contextmanager
+def request_scope(trace_id: Optional[str],
+                  recorder: "Optional[SpanRecorder]" = None
+                  ) -> Iterator[_Scope]:
+    """Scope a per-request recorder + trace id over the current
+    thread/context (the daemon sets one around each request; contextvar
+    semantics follow ``utils.reqenv.overlay``). While a scope is set,
+    :func:`activate`/:func:`deactivate` rebind the scope's recorder
+    instead of the process-global one."""
+    scope = _Scope(recorder, trace_id)
+    token = _SCOPE.set(scope)
+    try:
+        yield scope
+    finally:
+        _SCOPE.reset(token)
 
 
 def activate(recorder: SpanRecorder) -> None:
+    scope = _SCOPE.get()
+    if scope is not None:
+        scope.recorder = recorder
+        return
     global _active
     with _state_lock:
         _active = recorder
@@ -161,6 +265,11 @@ def deactivate(recorder: Optional[SpanRecorder] = None) -> None:
     """Deactivate ``recorder`` (or whatever is active). A stale handle —
     some other recorder has since been activated — is a no-op, so
     overlapping Tracer lifetimes cannot clobber each other."""
+    scope = _SCOPE.get()
+    if scope is not None:
+        if recorder is None or scope.recorder is recorder:
+            scope.recorder = None
+        return
     global _active
     with _state_lock:
         if recorder is None or _active is recorder:
@@ -188,10 +297,11 @@ def _stack() -> list:
 
 @contextlib.contextmanager
 def span(name: str, layer: Optional[str] = None, **meta: Any):
-    """Time a block. Always feeds the phase histogram; records a full
-    :class:`SpanRecord` (with nesting links) when a recorder is active.
-    Exceptions propagate and mark the span ``status="error"``."""
-    rec = _active
+    """Time a block. Always feeds the phase histogram and the flight
+    ring; records a full :class:`SpanRecord` (with nesting links) when
+    a recorder is active. Exceptions propagate and mark the span
+    ``status="error"``."""
+    rec = current()
     frame = None
     if rec is not None:
         stack = _stack()
@@ -209,6 +319,8 @@ def span(name: str, layer: Optional[str] = None, **meta: Any):
     finally:
         dt = time.perf_counter() - t0
         metrics.observe_phase(name, dt)
+        flight.note(name, dt, layer=layer, status=status, error=error,
+                    trace_id=trace_id(), meta=meta or None)
         if frame is not None:
             stack = _stack()
             if frame in stack:
@@ -221,29 +333,58 @@ def span(name: str, layer: Optional[str] = None, **meta: Any):
                 status=status, error=error, meta=dict(meta)))
 
 
-def record(name: str, seconds: float, layer: Optional[str] = None,
-           **meta: Any) -> None:
+def record(name: str, seconds: float, layer: Optional[str] = None, *,
+           t_start: Optional[float] = None, **meta: Any) -> None:
     """Record an already-measured duration as a span — for call sites
     whose timing interleaves with retries or deferred work and cannot
-    be a ``with`` block (the fused engine's phase splits)."""
+    be a ``with`` block (the fused engine's phase splits).
+
+    ``t_start`` is the span's real start as a ``time.perf_counter()``
+    value (the ``t0`` the caller already holds). Without it the start
+    is back-dated ``now - seconds``, which misorders spans whose work
+    was deferred or retried between start and record — pass ``t_start``
+    anywhere a true start exists."""
     metrics.observe_phase(name, seconds)
-    rec = _active
+    flight.note(name, seconds, layer=layer, trace_id=trace_id(),
+                meta=meta or None)
+    rec = current()
     if rec is None:
         return
     stack = _stack()
     parent_id = stack[-1][1] if stack and stack[-1][0] is rec else -1
     depth = sum(1 for r, _ in stack if r is rec)
+    rel = max(t_start - rec.epoch, 0.0) if t_start is not None else \
+        max(time.perf_counter() - rec.epoch - seconds, 0.0)
     rec._add_span(SpanRecord(
-        name=name, layer=layer,
-        t_start=max(time.perf_counter() - rec.epoch - seconds, 0.0),
+        name=name, layer=layer, t_start=rel,
         seconds=seconds, depth=depth, span_id=rec._new_id(),
         parent_id=parent_id, thread=threading.current_thread().name,
+        status="ok", error=None, meta=dict(meta)))
+
+
+def record_into(recorder: SpanRecorder, name: str, seconds: float, *,
+                t_start: Optional[float] = None,
+                layer: Optional[str] = None, **meta: Any) -> None:
+    """Record a span directly into ``recorder``, bypassing scope
+    resolution — the batch leader thread uses this to graft its fused
+    pack/dispatch/scatter spans into every co-batched member's
+    request recorder (with a shared ``batch_id`` in ``meta``).
+
+    Artifact-only: the phase histogram and flight ring are *not* fed
+    here (the leader's own :func:`span`/:func:`record` call already
+    counted the work once)."""
+    rel = max(t_start - recorder.epoch, 0.0) if t_start is not None else \
+        max(time.perf_counter() - recorder.epoch - seconds, 0.0)
+    recorder._add_span(SpanRecord(
+        name=name, layer=layer, t_start=rel, seconds=seconds,
+        depth=0, span_id=recorder._new_id(), parent_id=-1,
+        thread=threading.current_thread().name,
         status="ok", error=None, meta=dict(meta)))
 
 
 def event(name: str, **fields: Any) -> None:
     """Point event (no duration) — recorded only while a recorder is
     active; use a metrics counter for always-on occurrence counts."""
-    rec = _active
+    rec = current()
     if rec is not None:
         rec.add_event(name, dict(fields))

@@ -239,7 +239,8 @@ class TailPlan:
         t0 = time.perf_counter()
         out = self._decode_fn(lo, hi)
         obs_spans.record("materialize_overlap", time.perf_counter() - t0,
-                         layer="ops", stage="decode", rows=hi - lo)
+                         layer="ops", t_start=t0, stage="decode",
+                         rows=hi - lo)
         return out
 
     def shard_overrides(self, lo: int, hi: int):
@@ -279,7 +280,7 @@ class TailPlan:
             ops = build_fn(lo, hi, overrides)
             obs_spans.record("materialize_overlap",
                              time.perf_counter() - t0, layer="ops",
-                             stage="materialize", rows=hi - lo)
+                             t_start=t0, stage="materialize", rows=hi - lo)
             return ops
         if not self.pipeline.eager_overlap:
             return _Immediate(run)
@@ -947,7 +948,7 @@ class FusedMergeEngine:
         from ..core.ids import op_id_prefix_digest
         from ..utils import faults
         faults.check("kernel")
-        detailed = obs_spans.active()
+        detailed = obs_spans.detailed_active()
         t0 = time.perf_counter()
         hash_tab = self.strings.sync()
         dig_l = np.frombuffer(op_id_prefix_digest(seed + "/L", base_rev),
@@ -958,7 +959,8 @@ class FusedMergeEngine:
         dev_l, nl = self._device_decl(left_t, left_key)
         dev_r, nr = self._device_decl(right_t, right_key)
         if detailed:
-            obs_spans.record("h2d", time.perf_counter() - t0, layer="ops")
+            obs_spans.record("h2d", time.perf_counter() - t0, layer="ops",
+                             t_start=t0)
 
         # Split-fetch mode: the kernel returns (head, mid, chains) so
         # the host can materialize the op streams from head — and
@@ -1042,12 +1044,12 @@ class FusedMergeEngine:
                     continue  # retry this capacity on the inline path
                 if detailed:
                     obs_spans.record("kernel", time.perf_counter() - t0,
-                                     layer="ops")
+                                     layer="ops", t_start=t0)
             else:
                 if detailed:
                     head_dev.block_until_ready()
                     obs_spans.record("kernel", time.perf_counter() - t0,
-                                     layer="ops")
+                                     layer="ops", t_start=t0)
                     t0 = time.perf_counter()
                 if split:
                     for d in (head_dev, mid_dev, chains_dev):
@@ -1059,7 +1061,7 @@ class FusedMergeEngine:
                 obs_device.record_transfer("d2h", flat.nbytes)
                 if detailed:
                     obs_spans.record("fetch", time.perf_counter() - t0,
-                                     layer="ops")
+                                     layer="ops", t_start=t0)
             n_l, n_r = int(flat[0]), int(flat[1])
             if not flat[4]:  # no overflow
                 break
@@ -1096,7 +1098,7 @@ class FusedMergeEngine:
                              pipeline=self._tail)
         if detailed:
             obs_spans.record("materialize", time.perf_counter() - t0,
-                             layer="ops")
+                             layer="ops", t_start=t0)
             t0 = time.perf_counter()
 
         if split:
@@ -1109,7 +1111,7 @@ class FusedMergeEngine:
             obs_device.record_transfer("d2h", fm.nbytes)
             if detailed:
                 obs_spans.record("fetch", time.perf_counter() - t0,
-                                 layer="ops")
+                                 layer="ops", t_start=t0)
                 t0 = time.perf_counter()
             permL, permR = fm[:C], fm[C:2 * C]
             ref = fm[2 * C:]
@@ -1240,7 +1242,7 @@ class FusedMergeEngine:
                 # the compose_decode window; a separate key would
                 # double-count it.
                 obs_spans.record("chain_decode", time.perf_counter() - t1,
-                                 layer="ops")
+                                 layer="ops", t_start=t1)
             return (c_addr[:n_pre], c_file[:n_pre], c_name[:n_pre], tbl)
 
         chains_cell = _OnceCell(fetch_chains)
@@ -1272,7 +1274,7 @@ class FusedMergeEngine:
             plan.prefetch()
         if detailed:
             obs_spans.record("compose_decode", time.perf_counter() - t0,
-                             layer="ops")
+                             layer="ops", t_start=t0)
             obs_device.update_live_buffer_hwm()
         reg = obs_metrics.REGISTRY
         reg.counter("semmerge_composed_ops_total",

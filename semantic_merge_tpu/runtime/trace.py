@@ -93,6 +93,7 @@ class Tracer:
     def to_dict(self) -> Dict[str, Any]:
         out = {
             "schema": TRACE_SCHEMA_VERSION,
+            "trace_id": obs_spans.trace_id(),
             "phases": [
                 {"name": p.name, "seconds": round(p.seconds, 6), **({"meta": p.meta} if p.meta else {})}
                 for p in self.phases

@@ -27,12 +27,20 @@ Wire protocol (mirrors reference ``workers/ts/src/protocol.ts``):
 
 Errors return ``{"id": n, "error": {"message": "…"}}``; the process
 exits on EOF or a ``shutdown`` request.
+
+Tracing: requests may carry a ``trace_id`` (ignored by external worker
+implementations). Successful responses gain a ``_worker`` block —
+``{"seconds": …, "phases": {name: seconds}, "trace_id": …}`` — holding
+the worker-side wall time and the per-phase histogram delta for that
+one request; the client grafts these as ``worker.<phase>`` child spans
+into the request's trace, closing the cross-process timing gap.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+import time
 from typing import Any, Dict
 
 
@@ -118,7 +126,17 @@ def serve(backend_name: str = "host",
                     stdout.write(json.dumps({"id": req_id, "result": {}}) + "\n")
                     stdout.flush()
                     return 0
+                from ..obs import metrics as obs_metrics
+                before = obs_metrics.phase_totals()
+                t0 = time.perf_counter()
                 result = _handle(backend, method, request.get("params", {}))
+                elapsed = time.perf_counter() - t0
+                result["_worker"] = {
+                    "seconds": round(elapsed, 6),
+                    "phases": {name: round(secs, 6) for name, secs in
+                               obs_metrics.phase_totals_since(before).items()},
+                    "trace_id": request.get("trace_id"),
+                }
                 response = {"id": req_id, "result": result}
             except Exception as exc:  # noqa: BLE001 — becomes the error reply
                 response = {"id": req_id,

@@ -108,10 +108,14 @@ def _run_on_daemon(verb: str, rest: List[str]) -> int:
     deadline = _env_float("SEMMERGE_SERVICE_DEADLINE", 0.0)
     retries = max(0, int(_env_float("SEMMERGE_SERVICE_RETRIES", 2)))
     idem_key = f"{os.getpid():x}-{os.urandom(8).hex()}"
+    # One trace id per REQUEST (not per retry attempt): a replayed
+    # idempotent response and the original execution share one trace.
+    trace_id = os.urandom(8).hex()
     attempt = 0
     while True:
         try:
-            return _attempt_on_daemon(verb, rest, deadline, idem_key)
+            return _attempt_on_daemon(verb, rest, deadline, idem_key,
+                                      trace_id)
         except _RetryableRejection as rej:
             if attempt >= retries:
                 if mode() == "require":
@@ -133,7 +137,7 @@ def _run_on_daemon(verb: str, rest: List[str]) -> int:
 
 
 def _attempt_on_daemon(verb: str, rest: List[str], deadline: float,
-                       idem_key: str) -> int:
+                       idem_key: str, trace_id: str) -> int:
     sock, rfile, wfile = _connect_or_spawn()
     try:
         params: Dict[str, Any] = {
@@ -141,6 +145,7 @@ def _attempt_on_daemon(verb: str, rest: List[str], deadline: float,
             "cwd": os.getcwd(),
             "env": protocol.request_env(),
             "idempotency_key": idem_key,
+            "trace_id": trace_id,
         }
         if deadline > 0:
             params["deadline_s"] = deadline
@@ -169,10 +174,14 @@ def _attempt_on_daemon(verb: str, rest: List[str], deadline: float,
                                           retry_after)
             if isinstance(exit_code, int):
                 # Typed fault: a FINAL answer (see module docstring).
+                # The trace id on the stderr line is the postmortem
+                # bundle name (.semmerge-postmortem/<trace_id>.json).
                 message = error.get("message", "")
                 if message:
+                    tid = error.get("trace_id") or trace_id
                     sys.stderr.write(f"semmerge: {message} "
-                                     f"(exit {exit_code})\n")
+                                     f"(exit {exit_code}) "
+                                     f"[trace {tid}]\n")
                 return exit_code
             raise DaemonUnavailable(
                 f"protocol error: {error.get('message', 'unknown')}")

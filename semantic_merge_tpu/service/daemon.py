@@ -49,14 +49,16 @@ from typing import Any, Dict, Optional
 from ..errors import DeadlineFault, MergeFault, WorkerFault, fault_boundary
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
+from ..obs import flight as obs_flight
 from ..utils import faults, reqenv, workdir
 from ..utils.loggingx import logger
 from ..utils.procs import env_seconds
-from . import protocol, resilience
+from . import protocol, resilience, telemetry
 
 _OUTCOME_BY_EXIT = {0: "ok", 1: "conflicts", 2: "typecheck", 3: "git-error"}
 
 _REQUESTS_HELP = "Service requests, by verb and outcome"
+_LATENCY_HELP = "End-to-end service request seconds, by verb"
 _QUEUE_DEPTH_HELP = "Requests currently waiting in the admission queue"
 _SHED_HELP = "Requests shed by admission control, by reason"
 _RSS_HELP = "Daemon resident set size (MiB), sampled by the pressure monitor"
@@ -134,7 +136,8 @@ class _ThreadTee(io.TextIOBase):
 
 class _Request:
     __slots__ = ("id", "verb", "argv", "cwd", "env", "deadline_s",
-                 "idem_key", "t_accept", "done", "response")
+                 "idem_key", "trace_id", "recorder", "t_accept", "done",
+                 "response")
 
     def __init__(self, req_id, verb: str, params: Dict[str, Any]) -> None:
         self.id = req_id
@@ -147,6 +150,12 @@ class _Request:
         self.deadline_s = float(raw_deadline) if raw_deadline else 0.0
         raw_idem = params.get("idempotency_key")
         self.idem_key = str(raw_idem) if raw_idem else None
+        # Client-minted request trace id (a pre-trace_id client gets one
+        # minted here); every span, artifact, worker frame, and
+        # postmortem bundle of this request carries it.
+        raw_trace = params.get("trace_id")
+        self.trace_id = str(raw_trace) if raw_trace else os.urandom(8).hex()
+        self.recorder = obs_spans.SpanRecorder(detailed=False)
         self.t_accept = time.monotonic()
         self.done = threading.Event()
         self.response: Optional[Dict[str, Any]] = None
@@ -190,6 +199,7 @@ class Daemon:
         self._idem_cap = max(0, _env_int("SEMMERGE_SERVICE_IDEM_CACHE", 256))
         self._idem_lock = threading.Lock()
         self._idem: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._telemetry: Optional[telemetry.TelemetryServer] = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -222,6 +232,10 @@ class Daemon:
         if self._soft_mb > 0 or self._hard_mb > 0:
             threading.Thread(target=self._pressure_monitor,
                              daemon=True).start()
+        self._telemetry = telemetry.maybe_start(self.status)
+        if self._telemetry is not None:
+            logger.info("telemetry listening on 127.0.0.1:%d "
+                        "(/metrics, /healthz)", self._telemetry.port)
         logger.info("merge service listening on %s (%d workers, queue %d)",
                     self._socket_path, self._workers_n, self._queue.maxsize)
         try:
@@ -333,10 +347,25 @@ class Daemon:
         batch.deactivate()
         from ..backends.subproc import shutdown_shared
         shutdown_shared()
+        if self._telemetry is not None:
+            self._telemetry.stop()
         if self._recorder is not None:
             obs_spans.deactivate(self._recorder)
             with contextlib.suppress(OSError):
                 self._recorder.write_jsonl(pathlib.Path(self._events_path))
+        # Flush diagnostics inside the drain handler: the
+        # ``SEMMERGE_METRICS`` atexit hook does not fire reliably on
+        # signal-initiated shutdowns (and never on a supervisor
+        # respawn's SIGTERM), so a drained daemon writes its registry —
+        # and, when a postmortem directory is configured, its flight
+        # ring — here, where the shutdown path is guaranteed to pass.
+        metrics_path = os.environ.get("SEMMERGE_METRICS")
+        if metrics_path:
+            with contextlib.suppress(OSError):
+                obs_metrics.dump(metrics_path)
+        if os.environ.get(obs_flight.ENV_DIR):
+            obs_flight.dump(None, "daemon-drain",
+                            breakers=resilience.breakers().snapshot())
         logger.info("merge service stopped (%d requests served)",
                     self._served)
 
@@ -366,6 +395,18 @@ class Daemon:
                                            {"id": req_id,
                                             "result": self.status()})
                     continue
+                if method == "metrics":
+                    # Live telemetry without waiting for process exit:
+                    # the same payloads the HTTP listener serves.
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": {
+                            "prometheus":
+                                obs_metrics.REGISTRY.render_prometheus(),
+                            "metrics": obs_metrics.REGISTRY.to_dict(),
+                            "health": self.status(),
+                        }})
+                    continue
                 if method == "shutdown":
                     protocol.write_message(wfile,
                                            {"id": req_id,
@@ -387,7 +428,8 @@ class Daemon:
     def _serve_request(self, req_id, verb: str, params: Dict[str, Any],
                        wfile) -> None:
         req = _Request(req_id, verb, params)
-        with reqenv.overlay(req.env):
+        with obs_spans.request_scope(req.trace_id, req.recorder), \
+                reqenv.overlay(req.env):
             cached = self._idem_lookup(req)
             if cached is not None:
                 # A retried request whose first execution completed:
@@ -407,7 +449,8 @@ class Daemon:
                     "id": req.id,
                     "error": protocol.fault_error(
                         fault,
-                        retry_after_ms=self._retry_after_for(fault))})
+                        retry_after_ms=self._retry_after_for(fault),
+                        trace_id=req.trace_id)})
                 return
         self._publish_queue_depth()
         req.done.wait()
@@ -536,10 +579,11 @@ class Daemon:
     def _execute(self, req: _Request) -> None:
         verb = req.verb
         queue_wait = time.monotonic() - req.t_accept
-        obs_spans.record("service.queue_wait", queue_wait, layer="service",
-                         verb=verb)
         outcome = "fault"
-        with reqenv.overlay(req.env):
+        with obs_spans.request_scope(req.trace_id, req.recorder), \
+                reqenv.overlay(req.env):
+            obs_spans.record("service.queue_wait", queue_wait,
+                             layer="service", verb=verb)
             try:
                 if req.deadline_s and queue_wait > req.deadline_s:
                     raise DeadlineFault(
@@ -567,17 +611,28 @@ class Daemon:
                             "queue_wait_s": round(queue_wait, 6),
                             "t_execute_start": t_start,
                             "t_execute_end": t_end,
+                            "trace_id": req.trace_id,
                         },
                     },
                 }
+                obs_metrics.REGISTRY.histogram(
+                    "service_request_seconds", _LATENCY_HELP).observe(
+                        queue_wait + duration, exemplar=req.trace_id,
+                        verb=verb)
             except MergeFault as fault:
                 req.response = {"id": req.id,
-                                "error": protocol.fault_error(fault)}
+                                "error": protocol.fault_error(
+                                    fault, trace_id=req.trace_id)}
             finally:
                 from ..frontend.declcache import publish_metrics
                 publish_metrics()
                 self._count_request(verb, outcome)
-                self._reactivate_recorder()
+                if self._recorder is not None:
+                    # --events: graft the request's scoped spans into
+                    # the daemon-lifetime recorder, tagged by trace_id,
+                    # so the events artifact still covers everything.
+                    self._recorder.absorb(req.recorder,
+                                          trace_id=req.trace_id)
 
     def _run_cli(self, req: _Request):
         """The actual CLI invocation: ``service.execute`` span, request
@@ -669,14 +724,6 @@ class Daemon:
                             and not e["lock"].locked()]:
                     del self._repo_locks[key]
 
-    def _reactivate_recorder(self) -> None:
-        """A request that ran with ``--trace`` activated (and then
-        deactivated) its own recorder; restore the daemon's events
-        recorder so capture continues across requests."""
-        if self._recorder is not None and \
-                obs_spans.current() is not self._recorder:
-            obs_spans.activate(self._recorder)
-
     # ------------------------------------------------------------------
     # introspection
 
@@ -712,6 +759,8 @@ class Daemon:
             "workers": self._workers_n,
             "repos_tracked": len(self._repo_locks),
             "rss_mb": round(_rss_mb(), 3),
+            "metrics_port": (self._telemetry.port
+                             if self._telemetry is not None else None),
             "declcache": decl,
             "declcache_hit_rate": (hits / lookups) if lookups else 0.0,
             "batch": scheduler.stats() if scheduler is not None else None,

@@ -8,17 +8,25 @@ Request/response shapes::
     → {"id": 1, "method": "semmerge",
        "params": {"argv": ["BASE", "A", "B", "--inplace"],
                   "cwd": "/abs/repo", "env": {"SEMMERGE_STRICT": "1"},
-                  "deadline_s": 30.0}}
+                  "deadline_s": 30.0, "trace_id": "9f2ab34cc01d77e6"}}
     ← {"id": 1, "result": {"exit_code": 0, "stdout": "…", "stderr": "…",
-                           "meta": {"queue_wait_s": 0.001, …}}}
+                           "meta": {"queue_wait_s": 0.001,
+                                    "trace_id": "9f2ab34cc01d77e6", …}}}
+
+``trace_id`` is minted by the client (one per request, not per retry
+attempt) and threads through the daemon executor, the batch
+dispatcher, and the subprocess-worker frames, naming that request's
+spans, artifacts, and postmortem bundle
+(``.semmerge-postmortem/<trace_id>.json``).
 
 Verb methods are the three merge-shaped CLI commands; control methods
 are ``hello`` (startup/liveness handshake carrying the protocol
-version), ``status``, and ``shutdown``. Errors come back as
-``{"id": n, "error": {"message", "fault", "stage", "exit_code"}}`` —
-a *typed* error (``exit_code`` present) is a final answer the client
-exits with; an untyped or malformed response is a transport failure
-the client treats as daemon-unavailable.
+version), ``status``, ``metrics`` (live registry: Prometheus text +
+health JSON), and ``shutdown``. Errors come back as
+``{"id": n, "error": {"message", "fault", "stage", "exit_code",
+"trace_id"}}`` — a *typed* error (``exit_code`` present) is a final
+answer the client exits with; an untyped or malformed response is a
+transport failure the client treats as daemon-unavailable.
 """
 from __future__ import annotations
 
@@ -35,7 +43,8 @@ VERBS = ("semdiff", "semmerge", "semrebase")
 #: recurse, SEMMERGE_METRICS is a process-atexit artifact of whichever
 #: process owns it, and the service socket is connection metadata.
 _UNSHIPPED_PREFIXES = ("SEMMERGE_SERVICE_",)
-_UNSHIPPED = frozenset({"SEMMERGE_DAEMON", "SEMMERGE_METRICS"})
+_UNSHIPPED = frozenset({"SEMMERGE_DAEMON", "SEMMERGE_METRICS",
+                        "SEMMERGE_METRICS_PORT"})
 
 
 class ProtocolError(Exception):
@@ -104,14 +113,16 @@ def read_message(rfile) -> Optional[Dict[str, Any]]:
         return msg
 
 
-def fault_error(fault,
-                retry_after_ms: Optional[int] = None) -> Dict[str, Any]:
+def fault_error(fault, retry_after_ms: Optional[int] = None,
+                trace_id: Optional[str] = None) -> Dict[str, Any]:
     """The wire form of a typed :class:`~semantic_merge_tpu.errors.
     MergeFault`: everything the client needs to reproduce the one-shot
     behavior (stderr line + documented exit code). ``retry_after_ms``
     rides on *transient* admission rejections (queue-full, overload)
     and invites the client to retry against the daemon after that
-    delay instead of treating the rejection as final."""
+    delay instead of treating the rejection as final. ``trace_id``
+    echoes the request's id so the client-visible error names the same
+    trace the daemon's spans and postmortem bundle carry."""
     err = {
         "message": fault.describe(),
         "fault": type(fault).__name__,
@@ -120,4 +131,6 @@ def fault_error(fault,
     }
     if retry_after_ms is not None:
         err["retry_after_ms"] = int(retry_after_ms)
+    if trace_id:
+        err["trace_id"] = str(trace_id)
     return err

@@ -33,7 +33,9 @@ import time
 from collections import deque
 from typing import Deque, Dict, Optional
 
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
 
 #: ``breaker_state`` gauge values, by state name.
 STATE_VALUES = {"closed": 0, "open": 1, "half-open": 2}
@@ -88,6 +90,7 @@ class CircuitBreaker:
         self._failures: Deque[float] = deque()
         self._opened_at = 0.0
         self._probing = False
+        self._pending_dumps: list = []
         self._publish_state()
 
     # -- state machine ------------------------------------------------------
@@ -104,32 +107,54 @@ class CircuitBreaker:
         obs_metrics.REGISTRY.counter(
             "breaker_transitions_total", _TRANSITIONS_HELP).inc(
                 1, rung=self.rung, to=to)
+        # Postmortem evidence for every transition — queued here (we
+        # hold self._lock; dumping snapshots every breaker's state,
+        # which re-enters locks) and flushed by the public methods
+        # after the lock is released.
+        self._pending_dumps.append(to)
+
+    def _flush_dumps(self) -> None:
+        """Write queued transition bundles. Called WITHOUT the lock."""
+        while True:
+            with self._lock:
+                if not self._pending_dumps:
+                    return
+                to = self._pending_dumps.pop(0)
+            from ..utils import workdir
+            obs_flight.dump(
+                obs_spans.trace_id(), "breaker-transition",
+                breakers=breakers().snapshot(), root=workdir.root(),
+                extra={"breaker": {"rung": self.rung, "to": to}})
 
     def allow(self) -> bool:
         """May the ladder attempt this rung now? Open breakers refuse;
         a cooled-down open breaker admits exactly one half-open probe
         at a time."""
         now = time.monotonic()
-        with self._lock:
-            if self._state == "closed":
-                return True
-            if self._state == "open":
-                if now - self._opened_at < self.cooldown_s:
+        try:
+            with self._lock:
+                if self._state == "closed":
+                    return True
+                if self._state == "open":
+                    if now - self._opened_at < self.cooldown_s:
+                        return False
+                    self._transition("half-open")
+                    self._probing = True
+                    return True
+                # half-open: one probe in flight at a time.
+                if self._probing:
                     return False
-                self._transition("half-open")
                 self._probing = True
                 return True
-            # half-open: one probe in flight at a time.
-            if self._probing:
-                return False
-            self._probing = True
-            return True
+        finally:
+            self._flush_dumps()
 
     def record_success(self) -> None:
         with self._lock:
             self._failures.clear()
             self._probing = False
             self._transition("closed")
+        self._flush_dumps()
 
     def record_failure(self) -> None:
         now = time.monotonic()
@@ -139,14 +164,15 @@ class CircuitBreaker:
                 self._probing = False
                 self._opened_at = now
                 self._transition("open")
-                return
-            self._failures.append(now)
-            cutoff = now - self.window_s
-            while self._failures and self._failures[0] < cutoff:
-                self._failures.popleft()
-            if len(self._failures) >= self.threshold:
-                self._opened_at = now
-                self._transition("open")
+            else:
+                self._failures.append(now)
+                cutoff = now - self.window_s
+                while self._failures and self._failures[0] < cutoff:
+                    self._failures.popleft()
+                if len(self._failures) >= self.threshold:
+                    self._opened_at = now
+                    self._transition("open")
+        self._flush_dumps()
 
     @property
     def state(self) -> str:

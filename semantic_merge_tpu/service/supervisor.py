@@ -45,6 +45,7 @@ import sys
 import time
 from typing import List, Optional, Sequence
 
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
 from ..utils.loggingx import logger
@@ -177,6 +178,12 @@ class Supervisor:
                 delay = min(self._backoff * (2 ** (attempt - 1)), self._cap)
                 obs_spans.record("supervisor.restart", delay, layer="service",
                                  reason=reason, attempt=attempt, rc=rc)
+                obs_flight.dump(
+                    None, "supervisor-restart",
+                    extra={"restart": {"reason": reason, "rc": rc,
+                                       "attempt": attempt,
+                                       "uptime_s": round(uptime, 3),
+                                       "delay_s": round(delay, 3)}})
                 logger.warning(
                     "daemon died (%s, rc=%d, uptime %.1fs); respawning in "
                     "%.2fs (attempt %d)", reason, rc, uptime, delay, attempt)

@@ -38,7 +38,8 @@ REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 #: Artifacts the engine itself writes next to the work tree — excluded
 #: from tree-state comparisons.
 ARTIFACTS = {".semmerge-conflicts.json", ".semmerge-trace.json",
-             ".semmerge-events.jsonl", ".semmerge-journal.json"}
+             ".semmerge-events.jsonl", ".semmerge-journal.json",
+             ".semmerge-postmortem"}
 
 
 def git(args, cwd):

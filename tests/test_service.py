@@ -37,7 +37,8 @@ from semantic_merge_tpu.utils import faults
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 ARTIFACTS = {".semmerge-conflicts.json", ".semmerge-trace.json",
-             ".semmerge-events.jsonl", ".semmerge-journal.json"}
+             ".semmerge-events.jsonl", ".semmerge-journal.json",
+             ".semmerge-postmortem"}
 
 MERGE_ARGV = ["semmerge", "basebr", "brA", "brB",
               "--inplace", "--backend", "host"]

@@ -1,0 +1,444 @@
+"""End-to-end request tracing (ISSUE 10 tentpole).
+
+The contracts under test:
+
+- **Isolation** — N concurrent daemon merges with ``--trace`` produce N
+  per-request artifacts, each carrying its own non-empty ``trace_id``
+  and no span stamped with another request's id
+  (``check_trace_schema.validate_request_traces``), with the merged
+  trees byte-equivalent to the one-shot path.
+- **Flight recorder** — a fault-injected strict daemon merge with NO
+  ``--trace`` flag still leaves ``.semmerge-postmortem/<trace_id>.json``
+  in the repo, keyed by the same trace id the client's error line
+  shows, validated by ``validate_postmortem`` (in-process and via the
+  script CLI, as tier-1 wires it).
+- **Drain flush** — a SIGTERM'd daemon writes its metrics registry
+  (``SEMMERGE_METRICS``) and a ``daemon-drain`` bundle
+  (``SEMMERGE_POSTMORTEM_DIR``) from the drain handler, not an atexit
+  hook that signal shutdowns skip.
+- **Live telemetry** — the ``metrics`` wire verb and the loopback HTTP
+  listener serve the same registry/health payloads.
+- **Attribution** — ``semmerge trace analyze`` buckets one request's
+  wall time into the documented critical-path splits.
+"""
+import hashlib
+import importlib.util
+import json
+import os
+import pathlib
+import re
+import signal
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from semantic_merge_tpu.errors import ParseFault
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+_SCRIPT = REPO_ROOT / "scripts" / "check_trace_schema.py"
+
+ARTIFACTS = {".semmerge-conflicts.json", ".semmerge-trace.json",
+             ".semmerge-events.jsonl", ".semmerge-journal.json",
+             ".semmerge-postmortem"}
+
+MERGE_ARGV = ["semmerge", "basebr", "brA", "brB",
+              "--inplace", "--backend", "host"]
+
+
+@pytest.fixture(scope="module")
+def schema():
+    spec = importlib.util.spec_from_file_location("check_trace_schema",
+                                                  _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def git(args, cwd):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def commit_all(root, msg):
+    git(["add", "-A"], root)
+    env = {"GIT_AUTHOR_DATE": "2024-01-01T00:00:00Z",
+           "GIT_COMMITTER_DATE": "2024-01-01T00:00:00Z"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        git(["commit", "-q", "-m", msg], root)
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+
+
+def build_repo(root: pathlib.Path) -> pathlib.Path:
+    """The test_service repo shape (pinned dates: bit-identical repos
+    at any path, so cross-repo tree comparisons are meaningful)."""
+    root.mkdir(parents=True)
+    git(["init", "-q", "-b", "main"], root)
+    git(["config", "user.email", "t@example.com"], root)
+    git(["config", "user.name", "t"], root)
+    (root / "src").mkdir()
+    (root / "src/util.ts").write_text(
+        "export function foo(n: number): number {\n  return n;\n}\n")
+    (root / "notes.txt").write_text("hello\n")
+    commit_all(root, "base")
+    git(["branch", "basebr"], root)
+    git(["checkout", "-qb", "brA"], root)
+    (root / "src/util.ts").write_text(
+        "export function bar(n: number): number {\n  return n;\n}\n")
+    commit_all(root, "rename foo->bar")
+    git(["checkout", "-q", "main"], root)
+    git(["checkout", "-qb", "brB"], root)
+    (root / "extra.ts").write_text(
+        "export function extra(s: string): string { return s; }\n")
+    (root / "notes.txt").write_text("hello\nworld\n")
+    commit_all(root, "add extra + edit notes")
+    git(["checkout", "-q", "main"], root)
+    return root
+
+
+def tree_state(root: pathlib.Path) -> dict:
+    from semantic_merge_tpu.runtime import inplace
+    out = {}
+    for p in sorted(root.rglob("*")):
+        if not p.is_file():
+            continue
+        rel = p.relative_to(root).as_posix()
+        if rel.startswith(".git/") or rel.split("/")[0] in ARTIFACTS \
+                or rel.startswith(inplace.STAGE_DIR + "/"):
+            continue
+        out[rel] = hashlib.sha256(p.read_bytes()).hexdigest()
+    return out
+
+
+def client_env(sock: str, **extra) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEMMERGE_DAEMON"] = "require"
+    env["SEMMERGE_SERVICE_SOCKET"] = sock
+    env.pop("SEMMERGE_FAULT", None)
+    env.pop("SEMMERGE_STRICT", None)
+    env.update(extra)
+    return env
+
+
+def oneshot_subprocess_env(**extra) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEMMERGE_DAEMON"] = "off"
+    env.pop("SEMMERGE_FAULT", None)
+    env.pop("SEMMERGE_STRICT", None)
+    env.update(extra)
+    return env
+
+
+def run_client(repo: pathlib.Path, env: dict, *argv, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu",
+         *(argv or MERGE_ARGV)],
+        cwd=repo, capture_output=True, text=True, env=env, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# Concurrent per-request span isolation
+# ---------------------------------------------------------------------------
+
+def test_concurrent_daemon_merges_have_isolated_traces(
+        tmp_path, service_daemon, schema):
+    """Three concurrent ``--trace`` merges through one daemon: each repo
+    gets its own ``.semmerge-trace.json`` whose ``trace_id`` is unique
+    and whose spans never carry a foreign id — and every merged tree is
+    byte-equivalent to the one-shot result."""
+    n = 3
+    repos = [build_repo(tmp_path / f"repo{i}") for i in range(n)]
+    results = [None] * n
+
+    def work(i):
+        results[i] = run_client(repos[i], client_env(service_daemon),
+                                *MERGE_ARGV, "--trace")
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    for i, proc in enumerate(results):
+        assert proc is not None and proc.returncode == 0, \
+            f"repo{i}: {proc and proc.stderr}"
+
+    traces = []
+    for repo in repos:
+        artifact = repo / ".semmerge-trace.json"
+        assert artifact.exists(), "--trace through the daemon must leave " \
+                                  "the per-request artifact in the repo"
+        traces.append(json.loads(artifact.read_text()))
+    assert schema.validate_request_traces(traces) == []
+    for trace in traces:
+        assert trace["spans"], "a traced daemon merge must record spans"
+
+    # The script CLI path tier-1 uses is the same validator.
+    ok = subprocess.run(
+        [sys.executable, str(_SCRIPT), "validate_request_traces",
+         *(str(r / ".semmerge-trace.json") for r in repos)],
+        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+
+    # Byte parity vs one-shot: requests traced concurrently must not
+    # change what gets merged.
+    oneshot = build_repo(tmp_path / "oneshot")
+    proc = run_client(oneshot, oneshot_subprocess_env(),
+                      *MERGE_ARGV, "--trace")
+    assert proc.returncode == 0, proc.stderr
+    expected = tree_state(oneshot)
+    for i, repo in enumerate(repos):
+        assert tree_state(repo) == expected, \
+            f"repo{i}: daemon-traced merge diverged from one-shot"
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: postmortem bundle without --trace
+# ---------------------------------------------------------------------------
+
+def test_fault_escape_writes_postmortem_keyed_by_client_trace_id(
+        tmp_path, service_daemon, schema):
+    """A strict fault-injected daemon merge with NO ``--trace`` flag:
+    the client error line carries ``[trace <id>]``, and the repo gains
+    ``.semmerge-postmortem/<id>.json`` — a validated bundle whose fault
+    names the failing stage and whose ring rows carry the same id."""
+    repo = build_repo(tmp_path / "repo")
+    proc = run_client(repo, client_env(service_daemon,
+                                       SEMMERGE_FAULT="scan:fault",
+                                       SEMMERGE_STRICT="1"))
+    assert proc.returncode == ParseFault.exit_code, proc.stderr
+    m = re.search(r"\[trace ([^\]]+)\]", proc.stderr)
+    assert m, f"client error must carry the trace id: {proc.stderr!r}"
+    tid = m.group(1)
+
+    bundle = repo / ".semmerge-postmortem" / f"{tid}.json"
+    assert bundle.exists(), \
+        f"fault escape must dump {bundle}, got " \
+        f"{list((repo / '.semmerge-postmortem').glob('*')) if (repo / '.semmerge-postmortem').is_dir() else 'no dir'}"
+    data = json.loads(bundle.read_text())
+    assert schema.validate_postmortem(data) == []
+    assert data["trace_id"] == tid
+    assert data["reason"] == "fault-escape"
+    assert data["fault"]["type"] == "ParseFault"
+    assert data["fault"]["stage"] == "scan"
+    assert data["fault"]["exit_code"] == ParseFault.exit_code
+    assert data["fault_chain"], "the fault chain must not be empty"
+    own = [row for row in data["spans"] if row["trace_id"] == tid]
+    assert own, "the flight ring must hold spans of the failing request"
+
+    # Tier-1 wires the same check through the script CLI.
+    ok = subprocess.run([sys.executable, str(_SCRIPT),
+                         "validate_postmortem", str(bundle)],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+
+    # The daemon survived the fault and serves the next request.
+    proc2 = run_client(repo, client_env(service_daemon))
+    assert proc2.returncode == 0, proc2.stderr
+    assert "bar" in (repo / "src/util.ts").read_text()
+
+
+# ---------------------------------------------------------------------------
+# Drain flush: SIGTERM'd daemon persists metrics + flight ring
+# ---------------------------------------------------------------------------
+
+def test_sigterm_drain_flushes_metrics_and_flight(tmp_path, daemon_factory,
+                                                  schema):
+    """Metrics used to evaporate when the supervisor (or an operator)
+    SIGTERM'd the daemon: the atexit dump never ran. The drain handler
+    now writes both ``SEMMERGE_METRICS`` and a ``daemon-drain``
+    postmortem bundle before the process exits."""
+    sock = str(tmp_path / "daemon.sock")
+    metrics_path = tmp_path / "daemon-metrics.json"
+    pm_dir = tmp_path / "postmortem"
+    proc = daemon_factory(sock, extra_env={
+        "SEMMERGE_METRICS": str(metrics_path),
+        "SEMMERGE_POSTMORTEM_DIR": str(pm_dir),
+    })
+
+    repo = build_repo(tmp_path / "repo")
+    merged = run_client(repo, client_env(sock))
+    assert merged.returncode == 0, merged.stderr
+
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=60)
+
+    assert metrics_path.exists(), \
+        "a SIGTERM'd daemon must flush its registry from the drain handler"
+    registry = json.loads(metrics_path.read_text())
+    assert schema.validate_metrics(registry) == []
+    assert "service_requests_total" in registry.get("counters", {}), \
+        "the flushed registry must contain the served request"
+
+    bundles = sorted(pm_dir.glob("*.json"))
+    assert bundles, "the drain handler must dump the flight ring when a " \
+                    "postmortem dir is configured"
+    drained = [json.loads(b.read_text()) for b in bundles]
+    drain = [d for d in drained if d.get("reason") == "daemon-drain"]
+    assert drain, f"expected a daemon-drain bundle, got " \
+                  f"{[d.get('reason') for d in drained]}"
+    assert schema.validate_postmortem(drain[0]) == []
+    assert drain[0]["spans"], \
+        "the drained ring must hold the served request's spans"
+
+
+# ---------------------------------------------------------------------------
+# Live telemetry: wire verb + loopback HTTP listener
+# ---------------------------------------------------------------------------
+
+def test_metrics_wire_verb(service_daemon, schema):
+    """``metrics`` control verb: live Prometheus text + registry dict +
+    health payload without waiting for process exit."""
+    from semantic_merge_tpu.service import client as service_client
+    res = service_client.call_control("metrics", path=service_daemon)
+    assert isinstance(res.get("prometheus"), str)
+    assert schema.validate_metrics(res["metrics"]) == []
+    health = res["health"]
+    assert "queue_depth" in health
+    assert "metrics_port" in health
+
+
+def test_http_telemetry_listener_serves_metrics_and_healthz(tmp_path):
+    """The loopback listener (``SEMMERGE_METRICS_PORT``): ``/metrics``
+    answers Prometheus text, ``/healthz`` the health JSON, unknown
+    paths 404. Ephemeral-port binding (port 0) is what daemons under
+    test use, so exercise exactly that."""
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    from semantic_merge_tpu.service.telemetry import TelemetryServer
+    obs_metrics.REGISTRY.counter("telemetry_probe_total", "t").inc(1)
+    server = TelemetryServer(0, lambda: {"queue_depth": 0, "ok": True})
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            body = resp.read().decode("utf-8")
+        assert "telemetry_probe_total" in body
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+            assert resp.status == 200
+            health = json.loads(resp.read().decode("utf-8"))
+        assert health["queue_depth"] == 0
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/nope", timeout=10)
+        assert err.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_daemon_reports_bound_metrics_port(tmp_path, daemon_factory):
+    """A daemon started with ``SEMMERGE_METRICS_PORT=0`` binds an
+    ephemeral loopback port and reports it through ``status`` so
+    operators can discover the scrape endpoint."""
+    from semantic_merge_tpu.service import client as service_client
+    sock = str(tmp_path / "daemon.sock")
+    daemon_factory(sock, extra_env={"SEMMERGE_METRICS_PORT": "0"})
+    status = service_client.call_control("status", path=sock)
+    port = status.get("metrics_port")
+    assert isinstance(port, int) and port > 0
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                timeout=10) as resp:
+        assert resp.status == 200
+        health = json.loads(resp.read().decode("utf-8"))
+    assert health.get("metrics_port") == port
+
+
+# ---------------------------------------------------------------------------
+# Latency attribution: semmerge trace analyze
+# ---------------------------------------------------------------------------
+
+def _span(name, layer, seconds, span_id, **meta):
+    return {"name": name, "layer": layer, "t_start": 0.0,
+            "seconds": seconds, "depth": 0, "span_id": span_id,
+            "parent_id": -1, "thread": "t", "status": "ok",
+            "error": None, "meta": meta}
+
+
+def _synthetic_trace(tid: str, scale: float = 1.0) -> dict:
+    return {
+        "schema": 1, "trace_id": tid, "total_seconds": 0.05 * scale,
+        "phases": [], "counters": {}, "device": None,
+        "spans": [
+            _span("service.queue_wait", "service", 0.010 * scale, 1,
+                  verb="semmerge"),
+            _span("merge", "cli", 0.030 * scale, 2),
+            _span("kernel", "ops", 0.020 * scale, 3),
+            _span("fetch", "ops", 0.005 * scale, 4),
+            _span("materialize", "cli", 0.004 * scale, 5),
+        ],
+    }
+
+
+def test_trace_analyze_buckets_one_request(tmp_path, capsys):
+    from semantic_merge_tpu.cli import main
+    artifact = tmp_path / "trace.json"
+    artifact.write_text(json.dumps(_synthetic_trace("req-1")))
+    rc = main(["trace", "analyze", str(artifact), "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["trace_id"] == "req-1"
+    buckets = result["buckets"]
+    assert buckets["queue_wait"] == pytest.approx(0.010)
+    assert buckets["kernel"] == pytest.approx(0.020)
+    assert buckets["host_tail"] == pytest.approx(0.005)
+    assert buckets["apply"] == pytest.approx(0.004)
+    # total = cli wall + queue wait; "merge" (0.030) wraps kernel+fetch
+    # and must not be double-counted as its own bucket.
+    assert result["total_seconds"] == pytest.approx(0.044)
+    assert result["other_seconds"] == pytest.approx(0.005)
+
+
+def test_trace_analyze_directory_percentiles(tmp_path, capsys):
+    from semantic_merge_tpu.cli import main
+    outdir = tmp_path / "bundles"
+    outdir.mkdir()
+    for i, scale in enumerate((1.0, 2.0, 3.0)):
+        (outdir / f"req-{i}.json").write_text(
+            json.dumps(_synthetic_trace(f"req-{i}", scale)))
+    (outdir / "not-a-trace.json").write_text(json.dumps({"schema": 1}))
+    rc = main(["trace", "analyze", str(outdir), "--json"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["requests"] == 3
+    assert summary["p50"]["queue_wait"] == pytest.approx(0.020)
+    assert summary["p99"]["queue_wait"] == pytest.approx(0.030)
+    assert summary["p99"]["total_seconds"] == pytest.approx(0.132)
+
+
+def test_trace_analyze_rejects_non_artifacts(tmp_path, capsys):
+    from semantic_merge_tpu.cli import main
+    bogus = tmp_path / "bogus.json"
+    bogus.write_text("not json")
+    assert main(["trace", "analyze", str(bogus)]) == 1
+    assert main(["trace", "analyze", str(tmp_path / "missing.json")]) == 1
+    capsys.readouterr()
+
+
+def test_trace_analyze_reads_real_daemon_artifact(tmp_path, service_daemon,
+                                                  capsys):
+    """End to end: a real traced daemon merge's artifact feeds the
+    analyzer — queue wait is attributed and the totals are positive."""
+    from semantic_merge_tpu.cli import main
+    repo = build_repo(tmp_path / "repo")
+    proc = run_client(repo, client_env(service_daemon),
+                      *MERGE_ARGV, "--trace")
+    assert proc.returncode == 0, proc.stderr
+    rc = main(["trace", "analyze", str(repo / ".semmerge-trace.json"),
+               "--json"])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["trace_id"], "daemon trace artifact must carry its id"
+    assert result["total_seconds"] > 0
+    assert set(result["buckets"]) == set(
+        ("queue_wait", "batch_window", "pack", "kernel", "host_tail",
+         "apply"))

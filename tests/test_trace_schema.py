@@ -336,6 +336,116 @@ def test_apply_phase_spans_in_real_trace(schema, tmp_path):
     assert schema.validate_phase_coverage(data, ("apply_ops_v2",))
 
 
+def test_postmortem_bundle_validates(schema, tmp_path, monkeypatch):
+    """A REAL flight-recorder bundle — ring rows from actual spans, a
+    typed fault, breaker states — passes ``validate_postmortem``;
+    drifted shapes (undocumented reason, bad breaker state, ring row
+    missing a key, wrong schema version) are rejected."""
+    from semantic_merge_tpu.errors import ParseFault
+    from semantic_merge_tpu.obs import flight as obs_flight
+    monkeypatch.delenv(obs_flight.ENV_DIR, raising=False)
+    monkeypatch.setenv(obs_flight.ENV_RING, "64")
+    obs_flight.reset()
+    try:
+        with obs_spans.request_scope("req-abc123"):
+            obs_spans.record("scan", 0.01, layer="frontend", files=2)
+            fault = None
+            try:
+                with obs_spans.span("apply", layer="cli"):
+                    raise ParseFault("injected", stage="scan",
+                                     cause="injected")
+            except ParseFault as exc:
+                fault = exc
+            path = obs_flight.dump("req-abc123", "fault-escape",
+                                   fault=fault,
+                                   breakers={"fused": "open"},
+                                   root=tmp_path)
+    finally:
+        obs_flight.reset()
+    assert path is not None and path.parent.name == ".semmerge-postmortem"
+    data = json.loads(path.read_text())
+    assert schema.validate_postmortem(data) == []
+    assert data["trace_id"] == "req-abc123"
+    assert data["fault"]["type"] == "ParseFault"
+    rows = {r["name"]: r for r in data["spans"]}
+    assert rows["scan"]["trace_id"] == "req-abc123"
+    assert rows["apply"]["status"] == "error"
+
+    broken = json.loads(json.dumps(data))
+    broken["reason"] = "bad-day"
+    assert any("reason" in e for e in schema.validate_postmortem(broken))
+
+    broken = json.loads(json.dumps(data))
+    broken["breakers"] = {"fused": "exploded"}
+    assert any("breakers" in e for e in schema.validate_postmortem(broken))
+
+    broken = json.loads(json.dumps(data))
+    broken["spans"][0].pop("thread")
+    assert any("thread" in e for e in schema.validate_postmortem(broken))
+
+    broken = json.loads(json.dumps(data))
+    broken["schema"] = 2
+    assert any("schema" in e for e in schema.validate_postmortem(broken))
+
+    assert any("trace_id" in e for e in schema.validate_postmortem(
+        {**data, "trace_id": ""}))
+
+
+def test_postmortem_cli_subcommand(schema, tmp_path, monkeypatch):
+    from semantic_merge_tpu.obs import flight as obs_flight
+    monkeypatch.delenv(obs_flight.ENV_DIR, raising=False)
+    path = obs_flight.dump("cli-check", "degradation", root=tmp_path)
+    ok = subprocess.run([sys.executable, str(_SCRIPT),
+                         "validate_postmortem", str(path)],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    fail = subprocess.run([sys.executable, str(_SCRIPT),
+                           "validate_postmortem", str(bad)],
+                          capture_output=True, text=True, timeout=60)
+    assert fail.returncode == 1
+    assert "missing key" in fail.stderr
+
+
+def test_request_traces_validator(schema, tmp_path):
+    """Two traces written under distinct request scopes validate as an
+    isolated set; shared ids, missing ids, and foreign-id-stamped spans
+    are rejected — the concurrent-daemon contract."""
+    import semantic_merge_tpu.runtime.trace as trace_mod
+
+    def one_trace(tid):
+        with obs_spans.request_scope(tid):
+            tracer = trace_mod.Tracer(enabled=True)
+            with tracer.phase("merge", backend="host"):
+                obs_spans.record("service.queue_wait", 0.001,
+                                 layer="service", verb="semmerge")
+            path = tmp_path / f"{tid}.json"
+            tracer.write(path)
+        return json.loads(path.read_text())
+
+    traces = [one_trace("req-a"), one_trace("req-b")]
+    assert schema.validate_request_traces(traces) == []
+    assert [t["trace_id"] for t in traces] == ["req-a", "req-b"]
+
+    assert any("non-empty" in e for e in schema.validate_request_traces([]))
+
+    broken = json.loads(json.dumps(traces))
+    broken[1]["trace_id"] = "req-a"
+    assert any("duplicates" in e
+               for e in schema.validate_request_traces(broken))
+
+    broken = json.loads(json.dumps(traces))
+    broken[0]["trace_id"] = None
+    assert any("trace_id" in e
+               for e in schema.validate_request_traces(broken))
+
+    broken = json.loads(json.dumps(traces))
+    broken[0]["spans"][0]["meta"]["trace_id"] = "req-b"
+    assert any("interleaved" in e
+               for e in schema.validate_request_traces(broken))
+
+
 def test_bench_record_validates(schema):
     """A representative BENCH record — with the additive host-tail,
     apply-phase, and strict-preset fields — validates; broken shapes

@@ -431,7 +431,7 @@ def run_strict_bench(record: dict, args, json_only: bool = False) -> int:
         print("# phases: " + "  ".join(f"{k}={v*1e3:.1f}ms"
                                        for k, v in phases.items()),
               file=sys.stderr)
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return 0 if parity else 1
 
 
@@ -484,7 +484,57 @@ def run_tracecost_bench(record: dict, args, backend, base, left, right,
     if not json_only:
         print(f"# dark: {dark_s*1e3:8.1f} ms   traced: {on_s*1e3:8.1f} ms   "
               f"overhead: {overhead_pct:+.2f}%", file=sys.stderr)
-    print(json.dumps(record), flush=True)
+    emit_record(record)
+    return 0 if ok else 1
+
+
+def run_slocost_bench(record: dict, args, backend, base, left, right,
+                      json_only: bool = False) -> int:
+    """The ``slocost`` preset: what the SLO engine costs a rung-5
+    merge. Dark = no engine (the pre-SLO fast path). On = the daemon's
+    steady-state posture: a live SloEngine with the default merge
+    objective, one ``observe()`` per merge plus a full ``evaluate()``
+    per repeat — an upper bound, since the daemon's monitor thread
+    evaluates every 5 s, not per request. Asserts the overhead stays
+    under 2% of dark wall time and emits the additive
+    ``slo_overhead_pct`` field."""
+    from semantic_merge_tpu.obs import slo as obs_slo
+
+    repeats = 5
+    # Warm compiles and caches so both arms measure steady state.
+    run_merge_to_payload(backend, base, left, right)
+
+    dark_s = time_merge(backend, base, left, right, repeats=repeats)
+
+    engine = obs_slo.SloEngine(
+        obs_slo.parse_objectives("merge:p99<800ms,err<1%"))
+    on_s = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run_merge_to_payload(backend, base, left, right)
+        engine.observe("semmerge", time.perf_counter() - t0)
+        engine.evaluate()
+        on_s = min(on_s, time.perf_counter() - t0)
+
+    overhead_pct = (on_s - dark_s) / dark_s * 100.0 if dark_s > 0 else 0.0
+    ok = overhead_pct < 2.0
+    record["metric"] = (
+        f"SLO-engine overhead (rung-5 merge, {args.files} files x "
+        f"{args.decls} decls, observe+evaluate per merge vs no engine)")
+    record["value"] = round(overhead_pct, 3)
+    record["unit"] = "pct"
+    record["vs_baseline"] = round(on_s / dark_s, 4) if dark_s > 0 else 0.0
+    record["slo_overhead_pct"] = round(overhead_pct, 3)
+    record["slo_dark_ms"] = round(dark_s * 1e3, 1)
+    record["slo_on_ms"] = round(on_s * 1e3, 1)
+    if not ok:
+        prior = record.get("error")
+        msg = f"SLO overhead {overhead_pct:.2f}% exceeds the 2% budget"
+        record["error"] = f"{prior}; {msg}" if prior else msg
+    if not json_only:
+        print(f"# dark: {dark_s*1e3:8.1f} ms   slo-on: {on_s*1e3:8.1f} ms   "
+              f"overhead: {overhead_pct:+.2f}%", file=sys.stderr)
+    emit_record(record)
     return 0 if ok else 1
 
 
@@ -503,7 +553,27 @@ PRESETS = {
     "batchserve": {"files": 48, "decls": 4, "batchserve": True},
     "overload": {"files": 24, "decls": 4, "overload": True},
     "tracecost": {"files": 10000, "decls": 4, "tracecost": True},
+    "slocost": {"files": 10000, "decls": 4, "slocost": True},
 }
+
+# Set by main() once the preset is resolved; emit_record stamps it into
+# the trajectory row so BENCH_trajectory.jsonl is self-describing.
+_EMIT_PRESET = None
+
+
+def emit_record(record: dict) -> None:
+    """The driver contract: exactly one JSON record line on stdout —
+    plus a best-effort append to BENCH_trajectory.jsonl (see
+    ``semantic_merge_tpu/obs/perf.py``), so every bench run leaves a
+    machine-readable point on the perf trajectory."""
+    print(json.dumps(record), flush=True)
+    try:
+        from semantic_merge_tpu.obs import perf as obs_perf
+        obs_perf.append_trajectory(
+            record, preset=_EMIT_PRESET,
+            root=os.path.dirname(os.path.abspath(__file__)))
+    except Exception:
+        pass  # the trajectory is a courtesy, never a bench failure
 
 
 def _emit_and_exit_on_watchdog(record: dict, seconds: float):
@@ -518,7 +588,7 @@ def _emit_and_exit_on_watchdog(record: dict, seconds: float):
         msg = f"watchdog: bench exceeded {seconds:.0f}s"
         prior = record.get("error")
         record["error"] = f"{prior}; {msg}" if prior else msg
-        print(json.dumps(record), flush=True)
+        emit_record(record)
         os._exit(1)
 
     t = threading.Timer(seconds, fire)
@@ -586,7 +656,7 @@ def run_cold_bench(record: dict, args, conflicts_expected: bool,
         record["metric"] = "cold-start merge wall (fresh process/run)"
         record["unit"] = "seconds"
         record["error"] = "; ".join(errors) or "no cold run succeeded"
-        print(json.dumps(record), flush=True)
+        emit_record(record)
         return 1
     if errors:
         record["error"] = "; ".join(errors)
@@ -607,7 +677,7 @@ def run_cold_bench(record: dict, args, conflicts_expected: bool,
             print(f"# cold run {i}: import={run['import_s']}s "
                   f"init={run['backend_init_s']}s merge={run['merge_s']}s "
                   f"process_total={w:.1f}s", file=sys.stderr)
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return 0
 
 
@@ -688,7 +758,7 @@ def run_warmserve_bench(record: dict, args, json_only: bool = False) -> int:
             if proc.returncode != 0:
                 record["error"] = (f"cold one-shot merge exit "
                                    f"{proc.returncode}: {proc.stderr[-500:]}")
-                print(json.dumps(record), flush=True)
+                emit_record(record)
                 return 1
         cold_s = min(cold_walls)
 
@@ -708,12 +778,12 @@ def run_warmserve_bench(record: dict, args, json_only: bool = False) -> int:
             if daemon.poll() is not None:
                 record["error"] = (f"daemon exited rc={daemon.returncode} "
                                    f"during startup (log: {sock}.log)")
-                print(json.dumps(record), flush=True)
+                emit_record(record)
                 return 1
             time.sleep(0.1)
         else:
             record["error"] = "daemon did not come up within 120s"
-            print(json.dumps(record), flush=True)
+            emit_record(record)
             return 1
 
         params = {"argv": merge_argv[1:], "cwd": str(repo), "env": {}}
@@ -726,7 +796,7 @@ def run_warmserve_bench(record: dict, args, json_only: bool = False) -> int:
             result = frame.get("result") or {}
             if result.get("exit_code") != 0:
                 record["error"] = f"warm request failed: {frame}"
-                print(json.dumps(record), flush=True)
+                emit_record(record)
                 return 1
             if i > 0:  # request 0 is the daemon's residual warm-up
                 warm_walls.append(wall)
@@ -751,7 +821,7 @@ def run_warmserve_bench(record: dict, args, json_only: bool = False) -> int:
             print(f"# declcache hit rate: "
                   f"{record['declcache_hit_rate']:.3f}  "
                   f"rss: {record['daemon_rss_mb']} MiB", file=sys.stderr)
-        print(json.dumps(record), flush=True)
+        emit_record(record)
         return 0
     finally:
         if daemon is not None:
@@ -875,12 +945,12 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
             if daemon.poll() is not None:
                 record["error"] = (f"daemon exited rc={daemon.returncode} "
                                    f"during startup (log: {sock}.log)")
-                print(json.dumps(record), flush=True)
+                emit_record(record)
                 return 1
             time.sleep(0.1)
         else:
             record["error"] = "daemon did not come up within 120s"
-            print(json.dumps(record), flush=True)
+            emit_record(record)
             return 1
 
         # Parity gate (doubles as warm-up of the B=1 batched program):
@@ -889,13 +959,13 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
             code, _, frame = request(posture)
             if code != 0:
                 record["error"] = f"batched warm-up failed: {frame}"
-                print(json.dumps(record), flush=True)
+                emit_record(record)
                 return 1
         batched_notes = notes_blobs()
         code, _, frame = request("off")
         if code != 0:
             record["error"] = f"unbatched parity run failed: {frame}"
-            print(json.dumps(record), flush=True)
+            emit_record(record)
             return 1
         parity = (notes_blobs() == batched_notes)
         record["parity"] = bool(parity)
@@ -905,7 +975,7 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
         _, _, errs = drive(16, 1)
         if errs:
             record["error"] = f"warm burst failed: {errs[0]}"
-            print(json.dumps(record), flush=True)
+            emit_record(record)
             return 1
 
         walls1, total1, errs1 = drive(1, 6)
@@ -914,7 +984,7 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
         for errs in (errs1, errs4, errs16):
             if errs:
                 record["error"] = errs[0]
-                print(json.dumps(record), flush=True)
+                emit_record(record)
                 return 1
         serial_rate = len(walls1) / total1
         rate4 = len(walls4) / total4
@@ -960,7 +1030,7 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
                   f"program cache hit rate: "
                   f"{record['batch_program_cache_hit_rate']}",
                   file=sys.stderr)
-        print(json.dumps(record), flush=True)
+        emit_record(record)
         return 0 if parity else 1
     finally:
         if daemon is not None:
@@ -1047,12 +1117,12 @@ def run_overload_bench(record: dict, args, json_only: bool = False) -> int:
             if daemon.poll() is not None:
                 record["error"] = (f"daemon exited rc={daemon.returncode} "
                                    f"during startup (log: {sock}.log)")
-                print(json.dumps(record), flush=True)
+                emit_record(record)
                 return 1
             time.sleep(0.1)
         else:
             record["error"] = "daemon did not come up within 120s"
-            print(json.dumps(record), flush=True)
+            emit_record(record)
             return 1
 
         # Phase 1 — sequential baseline (first request is the warm-up).
@@ -1061,7 +1131,7 @@ def run_overload_bench(record: dict, args, json_only: bool = False) -> int:
             frame, wall = request()
             if (frame.get("result") or {}).get("exit_code") != 0:
                 record["error"] = f"baseline merge failed: {frame}"
-                print(json.dumps(record), flush=True)
+                emit_record(record)
                 return 1
             if i > 0:
                 baseline_walls.append(wall)
@@ -1106,7 +1176,7 @@ def run_overload_bench(record: dict, args, json_only: bool = False) -> int:
         if other_errors:
             record["error"] = ("burst produced undocumented failures: "
                                + "; ".join(other_errors[:3]))
-            print(json.dumps(record), flush=True)
+            emit_record(record)
             return 1
         total_burst = len(accepted_walls) + len(rejected)
         accepted_walls.sort()
@@ -1129,7 +1199,7 @@ def run_overload_bench(record: dict, args, json_only: bool = False) -> int:
         if not opened:
             record["error"] = ("host-rung breaker did not open after 10 "
                                "consecutive injected failures")
-            print(json.dumps(record), flush=True)
+            emit_record(record)
             return 1
         open_walls = []
         for _ in range(6):
@@ -1157,7 +1227,7 @@ def run_overload_bench(record: dict, args, json_only: bool = False) -> int:
         if recovery_s is None:
             record["error"] = ("breaker did not close within 30s of the "
                                "fault clearing")
-            print(json.dumps(record), flush=True)
+            emit_record(record)
             return 1
 
         status = svc_client.call_control("status", path=sock, timeout=30)
@@ -1186,7 +1256,7 @@ def run_overload_bench(record: dict, args, json_only: bool = False) -> int:
                   f"{record['breaker_open_latency_ms']:.1f} ms  "
                   f"recovery: {record['breaker_recovery_s']:.2f} s  "
                   f"rss: {record['steady_rss_mb']} MiB", file=sys.stderr)
-        print(json.dumps(record), flush=True)
+        emit_record(record)
         return 0
     finally:
         if daemon is not None:
@@ -1273,7 +1343,7 @@ def run_incremental_bench(record: dict, args, n_changed: int,
         print("# phases: " + "  ".join(f"{k}={v*1e3:.1f}ms"
                                        for k, v in phases.items()),
               file=sys.stderr)
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return 0 if parity else 1
 
 
@@ -1298,6 +1368,7 @@ def main() -> int:
     n_changed = None
     strict_mode = False
     tracecost_mode = False
+    slocost_mode = False
     if args.preset is None and args.files is None:
         # The headline number is measured where BASELINE.json defines
         # it: the 10k-file DivergentRename monorepo merge (rung 5).
@@ -1309,8 +1380,11 @@ def main() -> int:
         n_changed = p.get("changed")
         strict_mode = p.get("strict", False)
         tracecost_mode = p.get("tracecost", False)
+        slocost_mode = p.get("slocost", False)
     elif args.files is None:
         args.files = 512
+    global _EMIT_PRESET
+    _EMIT_PRESET = args.preset
 
     record = {
         "metric": f"files merged/sec/chip (synthetic 3-way TS merge, "
@@ -1383,6 +1457,9 @@ def main() -> int:
     if tracecost_mode:
         return run_tracecost_bench(record, args, tpu, base, left, right,
                                    json_only=args.json_only)
+    if slocost_mode:
+        return run_slocost_bench(record, args, tpu, base, left, right,
+                                 json_only=args.json_only)
 
     # Parity gate: the bench number is meaningless if the device path
     # diverges from the oracle. Also warms compiles and the fused
@@ -1446,7 +1523,7 @@ def main() -> int:
             f"{k}={v*1e3:.1f}ms" for k, v in host_phases.items()), file=sys.stderr)
         if rtt_ms is not None:
             print(f"# device round trip: {rtt_ms} ms", file=sys.stderr)
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return 0 if (parity and conflicts_ok) else 1
 
 
@@ -1459,13 +1536,13 @@ def _safe_main() -> int:
     except BaseException as exc:  # noqa: BLE001 — the record IS the contract
         import traceback
         traceback.print_exc(file=sys.stderr)
-        print(json.dumps({
+        emit_record({
             "metric": "files merged/sec/chip (synthetic 3-way TS merge)",
             "value": 0.0,
             "unit": "files/sec",
             "vs_baseline": 0.0,
             "error": f"{type(exc).__name__}: {exc}",
-        }), flush=True)
+        })
         return 1
 
 

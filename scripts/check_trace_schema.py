@@ -15,13 +15,15 @@ Subcommand modes for the request-tracing artifacts::
         .semmerge-postmortem/<trace_id>.json [...]
     python scripts/check_trace_schema.py validate_request_traces \
         TRACE_JSON TRACE_JSON [...]
+    python scripts/check_trace_schema.py validate_slo \
+        STATUS_OR_TRACE_JSON [...]
 
 Exit 0 when everything conforms, 1 with one line per violation
 otherwise. The tier-1 suite imports :func:`validate_trace` /
 :func:`validate_events` / :func:`validate_bench` / :func:`validate_batch`
-/ :func:`validate_request_traces` / :func:`validate_postmortem` directly
-(``tests/test_trace_schema.py``), so trace-format drift fails CI before
-it reaches a consumer.
+/ :func:`validate_request_traces` / :func:`validate_postmortem` /
+:func:`validate_slo` directly (``tests/test_trace_schema.py``), so
+trace-format drift fails CI before it reaches a consumer.
 
 Dependency-free on purpose: the schema IS this file plus the runbook
 table, not a jsonschema document that could drift separately.
@@ -130,7 +132,7 @@ POSTMORTEM_REQUIRED = ("schema", "trace_id", "reason", "ts", "spans",
 
 #: Documented postmortem dump reasons (``obs/flight.py`` REASONS).
 POSTMORTEM_REASONS = ("fault-escape", "degradation", "breaker-transition",
-                      "supervisor-restart", "daemon-drain")
+                      "supervisor-restart", "daemon-drain", "slo-burn")
 
 #: Required keys of one flight-ring row (``obs/flight.py`` note()).
 FLIGHT_ROW_REQUIRED = ("name", "t", "seconds", "layer", "status", "error",
@@ -155,7 +157,20 @@ BENCH_NUMERIC_OPTIONAL = (
     "overload_shed_rate", "overload_p99_ms", "baseline_p99_ms",
     "breaker_open_latency_ms", "breaker_recovery_s", "steady_rss_mb",
     "trace_overhead_pct", "trace_dark_ms", "trace_on_ms",
+    "slo_overhead_pct", "slo_dark_ms", "slo_on_ms",
 )
+
+#: Label keys of the SLO-engine metric series (``obs/slo.py``). The
+#: burn gauge carries exactly (objective, window) with window in
+#: SLO_WINDOWS; the trip counter exactly (objective,).
+SLO_METRIC_LABELS = {
+    "slo_burn_rate": ("objective", "window"),
+    "slo_burn_trips_total": ("objective",),
+}
+
+#: Documented burn-rate windows (multi-window alerting: fast ~5 min,
+#: slow ~1 h).
+SLO_WINDOWS = ("fast", "slow")
 
 
 def _is_num(v: Any) -> bool:
@@ -494,6 +509,83 @@ def validate_resilience(data: Any) -> List[str]:
     return errors
 
 
+def validate_slo(data: Any) -> List[str]:
+    """Validate the SLO-engine records of a trace/events-shaped artifact
+    (or a daemon status payload's ``metrics`` block): ``slo_burn_rate``
+    series carry exactly the ``objective``/``window`` labels with a
+    documented window and a non-negative value, ``slo_burn_trips_total``
+    series exactly the ``objective`` label, and — when a daemon-status
+    ``slo`` block is present — its objectives carry non-negative burn
+    rates and sample counts."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["slo: top level must be a JSON object"]
+    metrics = data.get("metrics", data)
+    if isinstance(metrics, dict):
+        gauges = metrics.get("gauges", {})
+        burn = gauges.get("slo_burn_rate") if isinstance(gauges, dict) \
+            else None
+        if isinstance(burn, dict):
+            for j, s in enumerate(burn.get("series", [])):
+                labels = s.get("labels") or {}
+                got = tuple(sorted(labels.keys()))
+                if got != tuple(sorted(SLO_METRIC_LABELS["slo_burn_rate"])):
+                    errors.append(f"metrics.gauges.slo_burn_rate[{j}]: "
+                                  f"labels {got} != documented "
+                                  f"('objective', 'window')")
+                elif labels.get("window") not in SLO_WINDOWS:
+                    errors.append(f"metrics.gauges.slo_burn_rate[{j}]: "
+                                  f"window {labels.get('window')!r} not in "
+                                  f"{SLO_WINDOWS}")
+                if not _is_num(s.get("value")) or s.get("value") < 0:
+                    errors.append(f"metrics.gauges.slo_burn_rate[{j}]: "
+                                  f"value must be a number >= 0")
+        counters = metrics.get("counters", {})
+        trips = counters.get("slo_burn_trips_total") \
+            if isinstance(counters, dict) else None
+        if isinstance(trips, dict):
+            for j, s in enumerate(trips.get("series", [])):
+                got = tuple(sorted((s.get("labels") or {}).keys()))
+                if got != ("objective",):
+                    errors.append(
+                        f"metrics.counters.slo_burn_trips_total[{j}]: "
+                        f"labels {got} != ('objective',)")
+                if not _is_num(s.get("value")) or s.get("value") < 0:
+                    errors.append(
+                        f"metrics.counters.slo_burn_trips_total[{j}]: "
+                        f"value must be a number >= 0")
+    slo = data.get("slo")
+    if slo is not None:
+        if not isinstance(slo, dict):
+            errors.append("slo: status block must be an object or null")
+            return errors
+        if not isinstance(slo.get("healthy"), bool):
+            errors.append("slo: healthy must be a boolean")
+        objectives = slo.get("objectives", [])
+        if not isinstance(objectives, list):
+            errors.append("slo: objectives must be an array")
+            objectives = []
+        for i, row in enumerate(objectives):
+            where = f"slo.objectives[{i}]"
+            if not isinstance(row, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            if not isinstance(row.get("objective"), str) \
+                    or not row.get("objective"):
+                errors.append(f"{where}: objective must be a non-empty "
+                              f"string")
+            for key in ("burn_fast", "burn_slow"):
+                if not _is_num(row.get(key)) or row.get(key) < 0:
+                    errors.append(f"{where}: {key} must be a number >= 0")
+            for key in ("samples_fast", "samples_slow"):
+                v = row.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    errors.append(f"{where}: {key} must be an int >= 0")
+            if "tripped" in row and not isinstance(row["tripped"], bool):
+                errors.append(f"{where}: tripped must be a boolean")
+    return errors
+
+
 def validate_phase_coverage(data: Any, required) -> List[str]:
     """Check a trace artifact's span/phase names include ``required`` —
     the drift guard for load-bearing phase names (e.g. the apply-layer
@@ -730,6 +822,20 @@ def main(argv: List[str]) -> int:
             except (OSError, json.JSONDecodeError) as exc:
                 errors.append(f"{path}: unreadable ({exc})")
         return _finish(errors)
+    if argv and argv[0] == "validate_slo":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_slo "
+                  "STATUS_OR_TRACE_JSON [...]", file=sys.stderr)
+            return 2
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_slo(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
     if argv and argv[0] == "validate_request_traces":
         if len(argv) < 2:
             print("usage: check_trace_schema.py validate_request_traces "
@@ -768,6 +874,7 @@ def main(argv: List[str]) -> int:
         errors.extend(validate_service(trace))
         errors.extend(validate_batch(trace))
         errors.extend(validate_resilience(trace))
+        errors.extend(validate_slo(trace))
     except (OSError, json.JSONDecodeError) as exc:
         errors.append(f"trace: unreadable ({exc})")
     if len(argv) == 2:

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Standalone perf-regression gate over the checked-in bench snapshots.
+
+CI face of the sentinel in ``semantic_merge_tpu/obs/perf.py``:
+
+    # compare every checked-in BENCH_*.json against PERF_BASELINE.json
+    python scripts/perf_gate.py
+
+    # compare specific snapshots, custom tolerances
+    python scripts/perf_gate.py BENCH_r05.json --tolerance-pct 5
+
+    # (re)generate the committed baseline from the current snapshots
+    python scripts/perf_gate.py --record
+
+Exit codes: 0 all compared entries within tolerance, 1 at least one
+regression, 2 usage/IO problems (missing baseline, unreadable
+snapshot). New snapshots with no baseline entry are reported but never
+fail the gate — record them first.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO_ROOT))
+
+from semantic_merge_tpu.obs import perf as obs_perf  # noqa: E402
+
+
+def _default_snapshots() -> list[pathlib.Path]:
+    return sorted(p for p in _REPO_ROOT.glob("BENCH_*.json")
+                  if p.name != obs_perf.BASELINE_NAME)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="perf_gate",
+        description="Compare bench snapshots against PERF_BASELINE.json")
+    parser.add_argument("snapshots", nargs="*",
+                        help="BENCH_*.json files (default: every "
+                             "BENCH_*.json at the repo root)")
+    parser.add_argument("--baseline",
+                        default=str(_REPO_ROOT / obs_perf.BASELINE_NAME))
+    parser.add_argument("--tolerance-pct", type=float,
+                        default=obs_perf.DEFAULT_TOLERANCE_PCT)
+    parser.add_argument("--phase-tolerance-pct", type=float,
+                        default=obs_perf.DEFAULT_PHASE_TOLERANCE_PCT)
+    parser.add_argument("--record", action="store_true",
+                        help="Write/refresh the baseline from the "
+                             "snapshots instead of comparing")
+    parser.add_argument("--json", action="store_true",
+                        help="Emit findings as JSON")
+    args = parser.parse_args(argv)
+
+    paths = [pathlib.Path(s) for s in args.snapshots] \
+        or _default_snapshots()
+    if not paths:
+        print("perf_gate: no BENCH_*.json snapshots found",
+              file=sys.stderr)
+        return 2
+    entries = {}
+    for path in paths:
+        try:
+            record = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, ValueError) as exc:
+            print(f"perf_gate: cannot read {path}: {exc}",
+                  file=sys.stderr)
+            return 2
+        entries[obs_perf.record_key(path)] = obs_perf.normalize_record(
+            record, source=path.name)
+
+    baseline_path = pathlib.Path(args.baseline)
+    if args.record:
+        existing = {}
+        if baseline_path.is_file():
+            existing = obs_perf.load_baseline(baseline_path)["entries"]
+        existing.update(entries)
+        obs_perf.save_baseline(baseline_path, existing)
+        print(f"perf_gate: recorded {len(entries)} entries into "
+              f"{baseline_path}")
+        return 0
+
+    if not baseline_path.is_file():
+        print(f"perf_gate: no baseline at {baseline_path} "
+              f"(generate one with --record)", file=sys.stderr)
+        return 2
+    try:
+        baseline = obs_perf.load_baseline(baseline_path)
+    except (OSError, ValueError) as exc:
+        print(f"perf_gate: unreadable baseline: {exc}", file=sys.stderr)
+        return 2
+    ok, findings = obs_perf.compare_many(
+        entries, baseline, tolerance_pct=args.tolerance_pct,
+        phase_tolerance_pct=args.phase_tolerance_pct)
+    if args.json:
+        print(json.dumps({"ok": ok, "findings": findings}, indent=2))
+    else:
+        print(f"perf_gate: {'OK' if ok else 'REGRESSION'} "
+              f"({len(entries)} snapshots vs {baseline_path.name})")
+        print(obs_perf.format_findings(findings))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

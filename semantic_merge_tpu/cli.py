@@ -198,6 +198,67 @@ def build_parser() -> argparse.ArgumentParser:
     p_analyze.add_argument("--json", action="store_true",
                            help="Emit the breakdown as JSON")
 
+    p_profile = sub.add_parser(
+        "profile",
+        help="Capture a bounded JAX profiler trace + metrics delta from "
+             "a RUNNING merge service daemon (see runbook: Performance "
+             "objectives & profiling)")
+    p_profile.add_argument("--daemon", action="store_true", required=True,
+                           help="Required: captures come from the live "
+                                "daemon (one-shot runs use --profile DIR "
+                                "on the merge verbs instead)")
+    p_profile.add_argument("--seconds", type=float, default=1.0,
+                           help="Capture window length (clamped to "
+                                "[0.1, 60]; default 1.0)")
+    p_profile.add_argument("--out", default=None,
+                           help="Bundle parent directory (default: "
+                                "SEMMERGE_PROFILE_DIR, else a "
+                                "semmerge-profiles dir under the system "
+                                "temp dir)")
+    p_profile.add_argument("--socket", default=None,
+                           help="Daemon socket path (default: the serve "
+                                "socket resolution chain)")
+    p_profile.add_argument("--json", action="store_true",
+                           help="Emit the capture result as JSON")
+
+    p_perf = sub.add_parser(
+        "perf",
+        help="Perf-regression sentinel: record bench snapshots into "
+             "PERF_BASELINE.json and compare new runs against it")
+    perf_sub = p_perf.add_subparsers(dest="perf_command", required=True)
+    p_rec = perf_sub.add_parser(
+        "record", help="Normalize bench JSON snapshots (or a live "
+                       "daemon latency window) into the baseline")
+    p_rec.add_argument("snapshots", nargs="*",
+                       help="BENCH_*.json files to record (key = file "
+                            "stem minus the BENCH_ prefix)")
+    p_rec.add_argument("--baseline", default=None,
+                       help="Baseline path (default ./PERF_BASELINE.json)")
+    p_rec.add_argument("--key", default=None,
+                       help="Override the baseline key (single snapshot "
+                            "or --daemon only)")
+    p_rec.add_argument("--daemon", action="store_true",
+                       help="Record the live daemon's request-latency "
+                            "window instead of files (key 'daemon')")
+    p_rec.add_argument("--socket", default=None)
+    p_cmp = perf_sub.add_parser(
+        "compare", help="Compare snapshots against the baseline; exit 1 "
+                        "on regression")
+    p_cmp.add_argument("snapshots", nargs="*",
+                       help="BENCH_*.json files to compare")
+    p_cmp.add_argument("--baseline", default=None,
+                       help="Baseline path (default ./PERF_BASELINE.json)")
+    p_cmp.add_argument("--tolerance-pct", type=float, default=None,
+                       help="Headline-value tolerance (default 10)")
+    p_cmp.add_argument("--phase-tolerance-pct", type=float, default=None,
+                       help="Per-phase wall tolerance (default 25)")
+    p_cmp.add_argument("--daemon", action="store_true",
+                       help="Compare the live daemon's latency window "
+                            "against its recorded baseline entry")
+    p_cmp.add_argument("--socket", default=None)
+    p_cmp.add_argument("--json", action="store_true",
+                       help="Emit findings as JSON")
+
     p_train = sub.add_parser("train-matcher",
                              help="Train the decl-similarity matcher (orbax "
                                   "checkpoints; resumes from the latest)")
@@ -241,6 +302,10 @@ def main(argv: Sequence[str] | None = None) -> int:
             return cmd_stats(args)
         if args.command == "trace":
             return cmd_trace(args)
+        if args.command == "profile":
+            return cmd_profile(args)
+        if args.command == "perf":
+            return cmd_perf(args)
         if args.command == "serve":
             return cmd_serve(args)
     except subprocess.CalledProcessError as exc:
@@ -768,6 +833,128 @@ def cmd_serve(args: argparse.Namespace) -> int:
     return daemon.serve_forever()
 
 
+def cmd_profile(args: argparse.Namespace) -> int:
+    """On-demand profile capture from the live daemon (the ``profile``
+    wire verb). The daemon holds a single-capture lock; a concurrent
+    capture answers ``ok=False`` without disturbing the running one."""
+    from .service import client as service_client
+    try:
+        result = service_client.capture_profile(
+            args.seconds, out_dir=args.out, path=args.socket)
+    except service_client.DaemonUnavailable as exc:
+        print(f"error: no merge service daemon reachable ({exc})",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+        return 0 if result.get("ok") else 1
+    if not result.get("ok"):
+        print(f"error: profile capture failed: "
+              f"{result.get('error', 'unknown')}", file=sys.stderr)
+        return 1
+    print(f"profile bundle: {result.get('dir')}")
+    print(f"  window: {result.get('seconds', 0.0):g}s  "
+          f"profiler_started={result.get('profiler_started')}")
+    for name in result.get("files", ()):
+        print(f"  {name}")
+    return 0
+
+
+def cmd_perf(args: argparse.Namespace) -> int:
+    """Perf-regression sentinel (`perf record|compare`): thin CLI over
+    :mod:`semantic_merge_tpu.obs.perf`; `scripts/perf_gate.py` is the
+    standalone CI face of the same core."""
+    from .obs import perf as obs_perf
+    from .utils import workdir
+    baseline_path = pathlib.Path(args.baseline) if args.baseline \
+        else workdir.root() / obs_perf.BASELINE_NAME
+
+    def _daemon_entry() -> dict | None:
+        from .service import client as service_client
+        try:
+            status = service_client.call_control("status",
+                                                 path=args.socket)
+        except service_client.DaemonUnavailable as exc:
+            print(f"error: no merge service daemon reachable ({exc})",
+                  file=sys.stderr)
+            return None
+        return obs_perf.daemon_entry(status)
+
+    def _load_entries() -> dict | None:
+        entries: dict = {}
+        if args.daemon:
+            entry = _daemon_entry()
+            if entry is None:
+                return None
+            entries[getattr(args, "key", None) or "daemon"] = entry
+        for raw in args.snapshots:
+            path = pathlib.Path(raw)
+            try:
+                record = json.loads(path.read_text(encoding="utf-8"))
+            except (OSError, ValueError) as exc:
+                print(f"error: cannot read snapshot {path}: {exc}",
+                      file=sys.stderr)
+                return None
+            key = args.key if getattr(args, "key", None) \
+                and len(args.snapshots) == 1 and not args.daemon \
+                else obs_perf.record_key(path)
+            entries[key] = obs_perf.normalize_record(record,
+                                                     source=str(path))
+        if not entries:
+            print("error: nothing to process (pass snapshot files or "
+                  "--daemon)", file=sys.stderr)
+            return None
+        return entries
+
+    if args.perf_command == "record":
+        entries = _load_entries()
+        if entries is None:
+            return 2
+        existing: dict = {}
+        if baseline_path.is_file():
+            try:
+                existing = obs_perf.load_baseline(baseline_path)["entries"]
+            except (OSError, ValueError) as exc:
+                print(f"error: unreadable baseline {baseline_path}: {exc}",
+                      file=sys.stderr)
+                return 2
+        existing.update(entries)
+        obs_perf.save_baseline(baseline_path, existing)
+        print(f"recorded {len(entries)} entr"
+              f"{'y' if len(entries) == 1 else 'ies'} into "
+              f"{baseline_path}: {', '.join(sorted(entries))}")
+        return 0
+
+    # compare
+    if not baseline_path.is_file():
+        print(f"error: no baseline at {baseline_path} (record one with "
+              f"'semmerge perf record')", file=sys.stderr)
+        return 2
+    try:
+        baseline = obs_perf.load_baseline(baseline_path)
+    except (OSError, ValueError) as exc:
+        print(f"error: unreadable baseline {baseline_path}: {exc}",
+              file=sys.stderr)
+        return 2
+    entries = _load_entries()
+    if entries is None:
+        return 2
+    tol = args.tolerance_pct if args.tolerance_pct is not None \
+        else obs_perf.DEFAULT_TOLERANCE_PCT
+    ptol = args.phase_tolerance_pct \
+        if args.phase_tolerance_pct is not None \
+        else obs_perf.DEFAULT_PHASE_TOLERANCE_PCT
+    ok, findings = obs_perf.compare_many(
+        entries, baseline, tolerance_pct=tol, phase_tolerance_pct=ptol)
+    if getattr(args, "json", False):
+        print(json.dumps({"ok": ok, "findings": findings}, indent=2))
+    else:
+        print(f"perf compare vs {baseline_path}: "
+              f"{'OK' if ok else 'REGRESSION'}")
+        print(obs_perf.format_findings(findings))
+    return 0 if ok else 1
+
+
 def cmd_stats(args: argparse.Namespace) -> int:
     """Pretty-print an observability artifact: a ``.semmerge-trace.json``
     trace, a ``.semmerge-events.jsonl`` span/event stream, or a metrics
@@ -804,6 +991,25 @@ def cmd_stats(args: argparse.Namespace) -> int:
               f"entries={decl.get('entries', 0)}")
         print(f"memory: rss_mb={status.get('rss_mb', 0.0):.1f} "
               f"repos_tracked={status.get('repos_tracked', 0)}")
+        port = status.get("metrics_port")
+        if port is not None:
+            print(f"telemetry: http://127.0.0.1:{port} "
+                  f"(/metrics, /healthz)")
+        slo = status.get("slo")
+        if slo:
+            print(f"slo: {'healthy' if slo.get('healthy') else 'BURNING'} "
+                  f"(fast {slo.get('windows', {}).get('fast_s', 0):g}s / "
+                  f"slow {slo.get('windows', {}).get('slow_s', 0):g}s)")
+            for row in slo.get("objectives", ()):
+                mark = "TRIPPED" if row.get("tripped") else "ok"
+                print(f"  {mark:8s} {row.get('objective')}: "
+                      f"burn fast={row.get('burn_fast', 0.0):.2f}x "
+                      f"slow={row.get('burn_slow', 0.0):.2f}x "
+                      f"(n={row.get('samples_fast', 0)})")
+            for verb, q in (slo.get("window_quantiles") or {}).items():
+                print(f"  window {verb}: p50={q.get('p50_ms', 0.0):.1f}ms "
+                      f"p99={q.get('p99_ms', 0.0):.1f}ms "
+                      f"n={q.get('count', 0)} errors={q.get('errors', 0)}")
         batch = status.get("batch")
         if batch:
             cache = batch.get("program_cache") or {}

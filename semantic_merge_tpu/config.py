@@ -117,12 +117,23 @@ class CiConfig:
 
 
 @dataclass
+class SloConfig:
+    # Objective spec, same grammar as SEMMERGE_SLO (which overrides it):
+    # e.g. "merge:p99<800ms,err<1%; diff:p99<200ms". A TOML list of
+    # objective strings is also accepted and joined with ";".
+    objectives: str | None = None
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+
+
+@dataclass
 class Config:
     root: pathlib.Path
     core: CoreConfig = field(default_factory=CoreConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     languages: Dict[str, LanguageConfig] = field(default_factory=dict)
     ci: CiConfig = field(default_factory=CiConfig)
+    slo: SloConfig = field(default_factory=SloConfig)
 
 
 def find_config_file(start: pathlib.Path) -> pathlib.Path | None:
@@ -203,6 +214,16 @@ def load_config(start: pathlib.Path | None = None) -> Config:
     config.ci = CiConfig(
         require_typecheck=bool(ci.get("require_typecheck", config.ci.require_typecheck)),
         require_tests=bool(ci.get("require_tests", config.ci.require_tests)),
+    )
+
+    slo = data.get("slo", {})
+    objectives = slo.get("objectives")
+    if isinstance(objectives, (list, tuple)):
+        objectives = ";".join(str(o) for o in objectives if o)
+    config.slo = SloConfig(
+        objectives=str(objectives) if objectives else None,
+        fast_window_s=float(slo.get("fast_window_s", config.slo.fast_window_s)),
+        slow_window_s=float(slo.get("slow_window_s", config.slo.slow_window_s)),
     )
     return config
 

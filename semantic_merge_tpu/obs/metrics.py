@@ -135,6 +135,24 @@ class Histogram(_Metric):
         with self._lock:
             return {k: float(v["sum"]) for k, v in self._series.items()}
 
+    def snapshot(self, **labels: object) -> Dict[str, object]:
+        """Copy of one series (``counts``/``sum``/``count``) — empty
+        zeros when the labelled series was never observed."""
+        with self._lock:
+            series = self._series.get(_label_key(labels))
+            if series is None:
+                return {"counts": [0] * (len(self.buckets) + 1),
+                        "sum": 0.0, "count": 0}
+            return {"counts": list(series["counts"]),
+                    "sum": float(series["sum"]),
+                    "count": int(series["count"])}
+
+    def quantile(self, q: float, **labels: object) -> float:
+        """Estimated ``q``-quantile of one labelled series via
+        :func:`histogram_quantile`."""
+        snap = self.snapshot(**labels)
+        return histogram_quantile(self.buckets, snap["counts"], q)
+
 
 class Registry:
     """Named metric store. ``counter``/``gauge``/``histogram`` are
@@ -267,6 +285,52 @@ REGISTRY = Registry()
 
 def registry() -> Registry:
     return REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# Quantile estimation over fixed-bucket histograms.
+
+def histogram_quantile(buckets: Iterable[float], counts: Iterable[int],
+                       q: float) -> float:
+    """Prometheus-style quantile interpolation over one histogram
+    series.
+
+    ``buckets`` are the finite upper bounds; ``counts`` are the
+    **non-cumulative** per-bucket observation counts with one extra
+    trailing slot for the +Inf overflow bucket (the in-memory
+    :class:`Histogram` layout and the ``to_dict`` wire shape). The
+    estimate linearly interpolates within the bucket that holds the
+    target rank, assuming observations spread uniformly between the
+    bucket's lower and upper bound — the same model Prometheus's
+    ``histogram_quantile()`` uses, so daemon-side SLO math agrees with
+    dashboard math. Values landing in the overflow bucket clamp to the
+    highest finite bound (the estimate cannot exceed what the ladder
+    can resolve). Empty series return ``0.0``.
+    """
+    bounds = tuple(buckets)
+    counts = list(counts)
+    if len(counts) != len(bounds) + 1:
+        raise ValueError(
+            f"counts length {len(counts)} != len(buckets)+1 "
+            f"({len(bounds) + 1})")
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    q = min(1.0, max(0.0, float(q)))
+    rank = q * total
+    cum = 0
+    for i, count in enumerate(counts):
+        prev_cum = cum
+        cum += count
+        if cum >= rank and count > 0:
+            if i >= len(bounds):
+                # Overflow bucket: unbounded above — clamp.
+                return float(bounds[-1])
+            lower = bounds[i - 1] if i > 0 else 0.0
+            upper = bounds[i]
+            frac = (rank - prev_cum) / count
+            return float(lower + (upper - lower) * frac)
+    return float(bounds[-1])
 
 
 # ---------------------------------------------------------------------------

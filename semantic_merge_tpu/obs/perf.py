@@ -1,0 +1,249 @@
+"""Perf-regression sentinel — normalize bench snapshots, keep a
+machine-readable trajectory, compare against a committed baseline.
+
+The repo accumulates ``BENCH_*.json`` one-line records (one per bench
+preset run) but until now nothing *compared* them across commits: a
+10% host-tail regression would land silently. This module is the
+shared core behind ``semmerge perf record|compare`` and the standalone
+``scripts/perf_gate.py`` CI gate:
+
+- :func:`normalize_record` reduces a bench record (or a live daemon
+  window snapshot) to the comparable surface: headline ``value`` +
+  ``unit``, the ``phases_ms`` split, and the metric description;
+- ``PERF_BASELINE.json`` (:func:`load_baseline`/:func:`save_baseline`)
+  maps snapshot keys (``r05``, ``tpu_rung5``, ``daemon`` …) to
+  normalized entries;
+- :func:`compare_entry` applies unit-aware direction (``*/sec`` is
+  higher-better; ``ms``/``s``/``pct`` lower-better; phase walls always
+  lower-better) with separate headline and per-phase tolerance bands;
+- :func:`append_trajectory` appends every bench emission to
+  ``BENCH_trajectory.jsonl`` (override: ``SEMMERGE_BENCH_TRAJECTORY``)
+  so the perf history is a greppable, plottable artifact instead of a
+  pile of mutable snapshot files.
+
+Stdlib-only, like the rest of :mod:`semantic_merge_tpu.obs`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+from typing import Dict, List, Optional, Tuple
+
+BASELINE_SCHEMA = 1
+#: Default artifact names, resolved against the repo root by callers.
+BASELINE_NAME = "PERF_BASELINE.json"
+TRAJECTORY_NAME = "BENCH_trajectory.jsonl"
+ENV_TRAJECTORY = "SEMMERGE_BENCH_TRAJECTORY"
+
+DEFAULT_TOLERANCE_PCT = 10.0
+DEFAULT_PHASE_TOLERANCE_PCT = 25.0
+#: Phases faster than this in the baseline are noise, not signal.
+MIN_PHASE_MS = 5.0
+
+#: Units where a larger number is better.
+_HIGHER_BETTER_SUFFIXES = ("/sec", "/s")
+
+
+def higher_is_better(unit: str) -> bool:
+    return str(unit).endswith(_HIGHER_BETTER_SUFFIXES)
+
+
+def record_key(path: pathlib.Path | str) -> str:
+    """Baseline key for a snapshot file: the stem minus the ``BENCH_``
+    prefix (``BENCH_r05.json`` → ``r05``)."""
+    stem = pathlib.Path(path).stem
+    return stem[6:] if stem.startswith("BENCH_") else stem
+
+
+def normalize_record(record: dict, *, source: Optional[str] = None
+                     ) -> dict:
+    """Reduce one bench record to the comparable entry shape."""
+    entry = {
+        "metric": str(record.get("metric", "")),
+        "value": float(record.get("value", 0.0)),
+        "unit": str(record.get("unit", "")),
+        "recorded_at": round(time.time(), 3),
+    }
+    phases = record.get("phases_ms")
+    if isinstance(phases, dict) and phases:
+        entry["phases_ms"] = {str(k): float(v)
+                              for k, v in sorted(phases.items())}
+    if record.get("error"):
+        entry["error"] = str(record["error"])
+    if source:
+        entry["source"] = str(source)
+    return entry
+
+
+def daemon_entry(status: dict) -> dict:
+    """Normalize a live daemon ``status`` payload into a baseline
+    entry: overall request p99 as the headline (lower-better), per-verb
+    p50/p99 as the phase split. Prefers the SLO engine's sliding-window
+    quantiles when present (current traffic), falling back to the
+    cumulative ``service_request_seconds`` histogram."""
+    phases: Dict[str, float] = {}
+    worst_p99 = 0.0
+    slo = status.get("slo") or {}
+    quantiles = slo.get("window_quantiles") or {}
+    if quantiles:
+        for verb, row in quantiles.items():
+            phases[f"{verb}_p50"] = float(row.get("p50_ms", 0.0))
+            phases[f"{verb}_p99"] = float(row.get("p99_ms", 0.0))
+            worst_p99 = max(worst_p99, float(row.get("p99_ms", 0.0)))
+        source = "slo-window"
+    else:
+        from . import metrics as obs_metrics
+        hists = (status.get("metrics") or {}).get("histograms") or {}
+        hist = hists.get("service_request_seconds") or {}
+        buckets = hist.get("buckets") or list(obs_metrics.PHASE_BUCKETS)
+        for series in hist.get("series", ()):
+            verb = series.get("labels", {}).get("verb", "?")
+            counts = series.get("counts", ())
+            p50 = obs_metrics.histogram_quantile(buckets, counts, 0.50)
+            p99 = obs_metrics.histogram_quantile(buckets, counts, 0.99)
+            phases[f"{verb}_p50"] = round(p50 * 1e3, 3)
+            phases[f"{verb}_p99"] = round(p99 * 1e3, 3)
+            worst_p99 = max(worst_p99, p99 * 1e3)
+        source = "cumulative-histogram"
+    return normalize_record({
+        "metric": "live daemon per-verb request latency (worst p99)",
+        "value": round(worst_p99, 3),
+        "unit": "ms",
+        "phases_ms": phases,
+    }, source=source)
+
+
+# ---------------------------------------------------------------------------
+# Baseline IO
+
+def load_baseline(path: pathlib.Path | str) -> dict:
+    data = json.loads(pathlib.Path(path).read_text(encoding="utf-8"))
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(f"{path}: not a perf baseline (no 'entries')")
+    return data
+
+
+def save_baseline(path: pathlib.Path | str, entries: Dict[str, dict]
+                  ) -> None:
+    payload = {"schema": BASELINE_SCHEMA,
+               "entries": {k: entries[k] for k in sorted(entries)}}
+    pathlib.Path(path).write_text(
+        json.dumps(payload, indent=2, sort_keys=True) + "\n",
+        encoding="utf-8")
+
+
+# ---------------------------------------------------------------------------
+# Comparison
+
+def _delta_pct(current: float, baseline: float) -> float:
+    if baseline == 0.0:
+        return 0.0
+    return (current - baseline) / abs(baseline) * 100.0
+
+
+def compare_entry(key: str, current: dict, baseline: dict, *,
+                  tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
+                  phase_tolerance_pct: float = DEFAULT_PHASE_TOLERANCE_PCT,
+                  min_phase_ms: float = MIN_PHASE_MS) -> List[dict]:
+    """Compare one normalized entry against its baseline entry.
+    Returns one finding per compared field; a finding with
+    ``regression=True`` means the field moved past its tolerance in
+    the bad direction."""
+    findings: List[dict] = []
+    cur_v = float(current.get("value", 0.0))
+    base_v = float(baseline.get("value", 0.0))
+    unit = str(baseline.get("unit", current.get("unit", "")))
+    delta = _delta_pct(cur_v, base_v)
+    bad = -delta if higher_is_better(unit) else delta
+    findings.append({
+        "key": key, "field": "value", "unit": unit,
+        "current": cur_v, "baseline": base_v,
+        "delta_pct": round(delta, 2),
+        "tolerance_pct": tolerance_pct,
+        "regression": bad > tolerance_pct,
+    })
+    base_phases = baseline.get("phases_ms") or {}
+    cur_phases = current.get("phases_ms") or {}
+    for phase in sorted(set(base_phases) & set(cur_phases)):
+        bp, cp = float(base_phases[phase]), float(cur_phases[phase])
+        if bp < min_phase_ms:
+            continue
+        pdelta = _delta_pct(cp, bp)
+        findings.append({
+            "key": key, "field": f"phases_ms.{phase}", "unit": "ms",
+            "current": cp, "baseline": bp,
+            "delta_pct": round(pdelta, 2),
+            "tolerance_pct": phase_tolerance_pct,
+            "regression": pdelta > phase_tolerance_pct,
+        })
+    return findings
+
+
+def compare_many(entries: Dict[str, dict], baseline: dict, *,
+                 tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
+                 phase_tolerance_pct: float = DEFAULT_PHASE_TOLERANCE_PCT
+                 ) -> Tuple[bool, List[dict]]:
+    """Compare every entry that has a baseline counterpart. Returns
+    ``(ok, findings)``; entries missing from the baseline produce a
+    non-regression ``missing-baseline`` finding (new presets must not
+    fail the gate)."""
+    findings: List[dict] = []
+    base_entries = baseline.get("entries", {})
+    for key in sorted(entries):
+        if key not in base_entries:
+            findings.append({"key": key, "field": "value",
+                             "regression": False,
+                             "note": "missing-baseline"})
+            continue
+        findings.extend(compare_entry(
+            key, entries[key], base_entries[key],
+            tolerance_pct=tolerance_pct,
+            phase_tolerance_pct=phase_tolerance_pct))
+    ok = not any(f["regression"] for f in findings)
+    return ok, findings
+
+
+def format_findings(findings: List[dict]) -> str:
+    lines = []
+    for f in findings:
+        if f.get("note") == "missing-baseline":
+            lines.append(f"  new   {f['key']}: no baseline entry "
+                         f"(record one with 'semmerge perf record')")
+            continue
+        mark = "REGRESSION" if f["regression"] else "ok"
+        lines.append(
+            f"  {mark:10s} {f['key']}.{f['field']}: "
+            f"{f['current']:g} vs {f['baseline']:g} {f.get('unit', '')} "
+            f"({f['delta_pct']:+.1f}%, tol {f['tolerance_pct']:g}%)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Trajectory
+
+def trajectory_path(root: pathlib.Path | str = ".") -> pathlib.Path:
+    override = os.environ.get(ENV_TRAJECTORY, "").strip()
+    if override:
+        return pathlib.Path(override)
+    return pathlib.Path(root) / TRAJECTORY_NAME
+
+
+def append_trajectory(record: dict, *, preset: Optional[str] = None,
+                      root: pathlib.Path | str = ".") -> Optional[pathlib.Path]:
+    """Append one bench record to the trajectory file; returns the
+    path, or ``None`` on write failure (the trajectory is a courtesy —
+    it must never fail a bench run)."""
+    row = dict(record)
+    row.setdefault("ts", round(time.time(), 3))
+    if preset:
+        row.setdefault("preset", preset)
+    try:
+        path = trajectory_path(root)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(row, sort_keys=True) + "\n")
+        return path
+    except OSError:
+        return None

@@ -42,6 +42,63 @@ from ..obs import spans as obs_spans
 
 TRACE_SCHEMA_VERSION = 1
 
+#: Counter recording every failed profiler start/stop, by reason — a
+#: capture that silently goes dark is an observability bug in itself.
+PROFILER_FAILURES = "profiler_capture_failures_total"
+
+
+def start_profiler_session(profile_dir: str) -> bool:
+    """Open the process-global JAX profiler session, recovering from a
+    poisoned one.
+
+    ``jax.profiler.start_trace`` raises when a previous session was
+    never stopped (an aborted capture in a warm process — exactly the
+    daemon's shape). Historically that failure was swallowed by a bare
+    ``except``, silently disabling every later ``--profile`` and
+    daemon capture. Instead: on failure, attempt a guarded
+    ``stop_trace`` to clear the stale session and retry **once**;
+    count every failure in ``profiler_capture_failures_total{reason}``
+    so a dark profiler is at least visible in metrics."""
+    failures = obs_metrics.REGISTRY.counter(
+        PROFILER_FAILURES,
+        "JAX profiler session start/stop failures, by reason")
+    try:
+        import jax
+    except Exception:
+        failures.inc(1, reason="jax-import")
+        return False
+    try:
+        jax.profiler.start_trace(profile_dir)
+        return True
+    except Exception:
+        failures.inc(1, reason="start")
+    # Recovery: a stale session from an aborted capture is the common
+    # cause — close it and retry once.
+    try:
+        jax.profiler.stop_trace()
+    except Exception:
+        failures.inc(1, reason="recovery-stop")
+    try:
+        jax.profiler.start_trace(profile_dir)
+        return True
+    except Exception:
+        failures.inc(1, reason="start-retry")
+        return False
+
+
+def stop_profiler_session() -> bool:
+    """Close the process-global profiler session; never raises."""
+    try:
+        import jax
+        jax.profiler.stop_trace()
+        return True
+    except Exception:
+        obs_metrics.REGISTRY.counter(
+            PROFILER_FAILURES,
+            "JAX profiler session start/stop failures, by reason").inc(
+                1, reason="stop")
+        return False
+
 
 @dataclass
 class PhaseRecord:
@@ -64,12 +121,7 @@ class Tracer:
             self._recorder = obs_spans.SpanRecorder()
             obs_spans.activate(self._recorder)
         if self.profile_dir:
-            try:
-                import jax
-                jax.profiler.start_trace(self.profile_dir)
-                self._profiling = True
-            except Exception:
-                self._profiling = False
+            self._profiling = start_profiler_session(self.profile_dir)
 
     @contextlib.contextmanager
     def phase(self, name: str, **meta: Any):
@@ -114,11 +166,7 @@ class Tracer:
         capture and poisons later start_trace calls in the same
         process."""
         if self._profiling:
-            try:
-                import jax
-                jax.profiler.stop_trace()
-            except Exception:
-                pass
+            stop_profiler_session()
             self._profiling = False
         if self._recorder is not None:
             obs_spans.deactivate(self._recorder)

@@ -48,6 +48,7 @@ from typing import Any, Dict, Optional
 
 from ..errors import DeadlineFault, MergeFault, WorkerFault, fault_boundary
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
 from ..obs import spans as obs_spans
 from ..obs import flight as obs_flight
 from ..utils import faults, reqenv, workdir
@@ -200,6 +201,28 @@ class Daemon:
         self._idem_lock = threading.Lock()
         self._idem: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
         self._telemetry: Optional[telemetry.TelemetryServer] = None
+        # SLO engine: SEMMERGE_SLO env wins, then the [slo] config
+        # table found from the daemon's cwd; None = no objectives, no
+        # per-request overhead. A malformed spec raises here — at
+        # startup, visibly — instead of silently serving unmonitored.
+        cfg_objectives = cfg_fast = cfg_slow = None
+        try:
+            from ..config import load_config
+            cfg = load_config()
+            cfg_objectives = cfg.slo.objectives
+            cfg_fast, cfg_slow = cfg.slo.fast_window_s, cfg.slo.slow_window_s
+        except obs_slo.SloParseError:
+            raise
+        except Exception:
+            pass  # unreadable config: env-only SLO setup still applies
+        self._slo = obs_slo.from_env(cfg_objectives,
+                                     config_fast_window=cfg_fast,
+                                     config_slow_window=cfg_slow)
+        # One capture at a time: the JAX profiler session is
+        # process-global (runtime.trace), so concurrent `profile`
+        # requests would corrupt each other.
+        self._profile_lock = threading.Lock()
+        self._autoprofiled = False
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -232,6 +255,11 @@ class Daemon:
         if self._soft_mb > 0 or self._hard_mb > 0:
             threading.Thread(target=self._pressure_monitor,
                              daemon=True).start()
+        if self._slo is not None:
+            threading.Thread(target=self._slo_monitor,
+                             daemon=True).start()
+            logger.info("SLO engine active: %s",
+                        "; ".join(c.text for c in self._slo.clauses))
         self._telemetry = telemetry.maybe_start(self.status)
         if self._telemetry is not None:
             logger.info("telemetry listening on 127.0.0.1:%d "
@@ -407,6 +435,15 @@ class Daemon:
                             "health": self.status(),
                         }})
                     continue
+                if method == "profile":
+                    # Blocks this connection thread for the capture
+                    # window; merge traffic keeps flowing through the
+                    # executor pool meanwhile — that traffic is what
+                    # the capture is *of*.
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": self._capture_profile(params)})
+                    continue
                 if method == "shutdown":
                     protocol.write_message(wfile,
                                            {"id": req_id,
@@ -445,6 +482,10 @@ class Daemon:
                     self._admit(req)
             except MergeFault as fault:
                 self._count_request(verb, "rejected")
+                if self._slo is not None:
+                    # Shed work never ran, but the client still saw an
+                    # error — it burns the error budget at zero latency.
+                    self._slo.observe(verb, 0.0, error=True)
                 protocol.write_message(wfile, {
                     "id": req.id,
                     "error": protocol.fault_error(
@@ -619,10 +660,22 @@ class Daemon:
                     "service_request_seconds", _LATENCY_HELP).observe(
                         queue_wait + duration, exemplar=req.trace_id,
                         verb=verb)
+                if self._slo is not None:
+                    # Conflicts/typecheck exits are request-shaped
+                    # answers, not service errors — only faults and
+                    # unexpected exit codes burn the error budget.
+                    self._slo.observe(
+                        verb, queue_wait + duration,
+                        error=outcome not in ("ok", "conflicts",
+                                              "typecheck"))
             except MergeFault as fault:
                 req.response = {"id": req.id,
                                 "error": protocol.fault_error(
                                     fault, trace_id=req.trace_id)}
+                if self._slo is not None:
+                    self._slo.observe(
+                        verb, time.monotonic() - req.t_accept,
+                        error=True)
             finally:
                 from ..frontend.declcache import publish_metrics
                 publish_metrics()
@@ -713,6 +766,112 @@ class Daemon:
                 if cache is not None:
                     cache.clear()
 
+    def _slo_monitor(self) -> None:
+        """Evaluate the SLO engine on a fixed cadence
+        (``SEMMERGE_SLO_EVAL_INTERVAL``), publishing the burn-rate
+        gauges. On the edge of a trip (both windows at/above the
+        threshold): log it, dump an ``slo-burn`` postmortem bundle with
+        the verdict attached, and — with ``SEMMERGE_SLO_AUTOPROFILE``
+        set — capture one profile bundle for the first trip of the
+        daemon's life (one, not per trip: a burning daemon must spend
+        its cycles serving, not profiling)."""
+        interval = max(0.1, obs_slo._env_float(
+            obs_slo.ENV_EVAL_INTERVAL, obs_slo.DEFAULT_EVAL_INTERVAL))
+        autoprofile = os.environ.get(
+            obs_slo.ENV_AUTOPROFILE, "").strip().lower() \
+            not in ("", "0", "off", "false")
+        while not self._stop.wait(interval):
+            try:
+                verdict = self._slo.evaluate(consume_edges=True)
+            except Exception:
+                continue  # evaluation must never kill the monitor
+            newly = verdict.get("newly_tripped") or []
+            if not newly:
+                continue
+            logger.warning(
+                "SLO burn: %s",
+                "; ".join(f"{r['objective']} (fast {r['burn_fast']}x, "
+                          f"slow {r['burn_slow']}x)" for r in newly))
+            obs_flight.dump(None, "slo-burn",
+                            breakers=resilience.breakers().snapshot(),
+                            extra={"slo": verdict})
+            if autoprofile and not self._autoprofiled:
+                self._autoprofiled = True
+                threading.Thread(
+                    target=self._capture_profile,
+                    args=({"seconds": 1.0},),
+                    name="svc-autoprofile", daemon=True).start()
+
+    def _capture_profile(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """On-demand profile capture from the live daemon: a bounded
+        JAX profiler window over whatever traffic flows during it,
+        plus a metrics before/after delta, the flight-ring span
+        sample, and the SLO verdict, written into a timestamped
+        bundle directory. Serialized by ``_profile_lock`` — the
+        profiler session is process-global, and a second concurrent
+        ``start_trace`` would poison it."""
+        try:
+            seconds = float(params.get("seconds") or 1.0)
+        except (TypeError, ValueError):
+            seconds = 1.0
+        seconds = min(60.0, max(0.1, seconds))
+        out_base = str(params.get("out_dir") or "").strip() \
+            or os.environ.get("SEMMERGE_PROFILE_DIR", "").strip()
+        if not out_base:
+            import tempfile
+            out_base = os.path.join(tempfile.gettempdir(),
+                                    "semmerge-profiles")
+        captures = obs_metrics.REGISTRY.counter(
+            "profile_captures_total",
+            "On-demand daemon profile captures, by result")
+        if not self._profile_lock.acquire(blocking=False):
+            captures.inc(1, result="busy")
+            return {"ok": False,
+                    "error": "a profile capture is already in progress"}
+        try:
+            from ..runtime import trace as rt_trace
+            stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            bundle_dir = pathlib.Path(out_base) / (
+                f"profile-{stamp}-{os.getpid()}-{os.urandom(2).hex()}")
+            bundle_dir.mkdir(parents=True, exist_ok=True)
+            before = obs_metrics.REGISTRY.to_dict()
+            t0 = time.time()
+            started = rt_trace.start_profiler_session(str(bundle_dir))
+            # The capture window: sample whatever the daemon serves
+            # meanwhile (interruptible so shutdown never waits on it).
+            self._stop.wait(seconds)
+            if started:
+                rt_trace.stop_profiler_session()
+            bundle = {
+                "schema": 1,
+                "ok": True,
+                "profiler_started": started,
+                "seconds": seconds,
+                "t_start": round(t0, 3),
+                "t_end": round(time.time(), 3),
+                "pid": os.getpid(),
+                "metrics_before": before,
+                "metrics_after": obs_metrics.REGISTRY.to_dict(),
+                "spans": obs_flight.snapshot(),
+                "slo": (self._slo.status()
+                        if self._slo is not None else None),
+            }
+            (bundle_dir / "bundle.json").write_text(
+                json.dumps(bundle, indent=2, default=str),
+                encoding="utf-8")
+            files = sorted(str(p.relative_to(bundle_dir))
+                           for p in bundle_dir.rglob("*") if p.is_file())
+            captures.inc(1, result="ok")
+            return {"ok": True, "dir": str(bundle_dir),
+                    "profiler_started": started, "seconds": seconds,
+                    "files": files}
+        except Exception as exc:  # capture failure must not kill the conn
+            captures.inc(1, result="error")
+            return {"ok": False,
+                    "error": f"{type(exc).__name__}: {exc}"}
+        finally:
+            self._profile_lock.release()
+
     def _reaper(self) -> None:
         """Evict per-repo state idle past the TTL."""
         interval = max(1.0, min(self._repo_ttl / 2.0, 60.0))
@@ -764,6 +923,7 @@ class Daemon:
             "declcache": decl,
             "declcache_hit_rate": (hits / lookups) if lookups else 0.0,
             "batch": scheduler.stats() if scheduler is not None else None,
+            "slo": self._slo.status() if self._slo is not None else None,
             "resilience": {
                 "pressure": self._pressure,
                 "rss_soft_mb": self._soft_mb,

@@ -22,7 +22,9 @@ spans, artifacts, and postmortem bundle
 Verb methods are the three merge-shaped CLI commands; control methods
 are ``hello`` (startup/liveness handshake carrying the protocol
 version), ``status``, ``metrics`` (live registry: Prometheus text +
-health JSON), and ``shutdown``. Errors come back as
+health JSON), ``profile`` (bounded on-demand JAX profiler capture into
+a timestamped bundle directory, serialized by a daemon-side
+single-capture lock), and ``shutdown``. Errors come back as
 ``{"id": n, "error": {"message", "fault", "stage", "exit_code",
 "trace_id"}}`` — a *typed* error (``exit_code`` present) is a final
 answer the client exits with; an untyped or malformed response is a
@@ -41,8 +43,10 @@ VERBS = ("semdiff", "semmerge", "semrebase")
 
 #: Env vars NOT shipped with a request: daemon-routing knobs would
 #: recurse, SEMMERGE_METRICS is a process-atexit artifact of whichever
-#: process owns it, and the service socket is connection metadata.
-_UNSHIPPED_PREFIXES = ("SEMMERGE_SERVICE_",)
+#: process owns it, the service socket is connection metadata, and the
+#: SLO engine is daemon-lifetime state — a client's objectives must not
+#: reconfigure a shared daemon per request.
+_UNSHIPPED_PREFIXES = ("SEMMERGE_SERVICE_", "SEMMERGE_SLO")
 _UNSHIPPED = frozenset({"SEMMERGE_DAEMON", "SEMMERGE_METRICS",
                         "SEMMERGE_METRICS_PORT"})
 

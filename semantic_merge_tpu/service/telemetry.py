@@ -8,7 +8,11 @@ operator loopback, not an ingress):
   the process registry, scrape-ready;
 - ``GET /healthz`` — one JSON object with the daemon's health surface
   (queue depth, in-flight count, breaker states, RSS, uptime — the
-  same shape ``semmerge serve --status`` prints).
+  same shape ``semmerge serve --status`` prints). When the daemon has
+  SLO objectives configured and the SLO engine reports a tripped
+  burn-rate clause, the endpoint answers **503** with
+  ``"degraded": true`` so plain HTTP health checks (load balancers,
+  systemd watchdogs) see the burn without parsing the body.
 
 ``SEMMERGE_METRICS_PORT=0`` binds an ephemeral port; the bound port is
 reported in the daemon ``status()`` payload (``metrics_port``) so
@@ -48,7 +52,11 @@ class _Handler(BaseHTTPRequestHandler):
                            text.encode("utf-8"))
             elif path in ("/healthz", "/health"):
                 health = self.server.semmerge_health()  # type: ignore[attr-defined]
-                self._send(200, "application/json",
+                slo = health.get("slo") if isinstance(health, dict) else None
+                degraded = bool(slo) and not slo.get("healthy", True)
+                if isinstance(health, dict):
+                    health = dict(health, degraded=degraded)
+                self._send(503 if degraded else 200, "application/json",
                            json.dumps(health, default=str).encode("utf-8"))
             else:
                 self._send(404, "text/plain; charset=utf-8", b"not found\n")

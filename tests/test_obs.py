@@ -180,6 +180,84 @@ def test_metrics_dump_json_and_prom(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# streaming quantiles (histogram_quantile + Histogram.quantile)
+
+
+def test_histogram_quantile_interpolates_within_bucket():
+    # 10 samples in (1, 2]: the interpolated p50 sits mid-bucket.
+    counts = [0, 10, 0, 0]
+    q = obs_metrics.histogram_quantile((1.0, 2.0, 4.0), counts, 0.5)
+    assert 1.0 < q <= 2.0
+    assert q == pytest.approx(1.5)
+    # p100 is the bucket's upper bound; p0+epsilon its lower edge side.
+    assert obs_metrics.histogram_quantile(
+        (1.0, 2.0, 4.0), counts, 1.0) == pytest.approx(2.0)
+
+
+def test_histogram_quantile_empty_and_overflow_clamp():
+    assert obs_metrics.histogram_quantile((1.0, 2.0), [0, 0, 0], 0.99) == 0.0
+    # All mass in +Inf: clamp to the highest finite bound, never inf.
+    q = obs_metrics.histogram_quantile((1.0, 2.0), [0, 0, 7], 0.5)
+    assert q == pytest.approx(2.0)
+
+
+def test_histogram_quantile_matches_exact_percentiles_of_samples():
+    """Property check: the interpolated quantile of bucketed samples
+    must land within one bucket width of the exact percentile."""
+    import random
+
+    rng = random.Random(1234)
+    bounds = tuple(obs_metrics.PHASE_BUCKETS)
+    samples = [rng.uniform(0.001, 30.0) for _ in range(500)]
+    counts = [0] * (len(bounds) + 1)
+    for s in samples:
+        import bisect
+        counts[bisect.bisect_left(bounds, s)] += 1
+    samples.sort()
+    for q in (0.1, 0.5, 0.9, 0.99):
+        exact = samples[min(len(samples) - 1, int(q * len(samples)))]
+        est = obs_metrics.histogram_quantile(bounds, counts, q)
+        # The estimate must land in the same bucket as the exact value
+        # (bucket resolution is the error bound of the method).
+        import bisect
+        assert bisect.bisect_left(bounds, est) in (
+            bisect.bisect_left(bounds, exact) - 1,
+            bisect.bisect_left(bounds, exact),
+            bisect.bisect_left(bounds, exact) + 1)
+
+
+def test_histogram_quantile_is_monotone_in_q():
+    import random
+
+    rng = random.Random(99)
+    bounds = (0.01, 0.1, 1.0, 10.0)
+    counts = [rng.randint(0, 20) for _ in range(len(bounds) + 1)]
+    if sum(counts) == 0:
+        counts[1] = 3
+    qs = [obs_metrics.histogram_quantile(bounds, counts, q / 20)
+          for q in range(21)]
+    assert qs == sorted(qs)
+
+
+def test_histogram_quantile_rejects_mismatched_counts():
+    with pytest.raises(ValueError):
+        obs_metrics.histogram_quantile((1.0, 2.0), [1, 2], 0.5)
+
+
+def test_histogram_quantile_method_and_snapshot():
+    h = obs_metrics.Histogram("q_hist", buckets=(0.1, 1.0, 10.0))
+    assert h.quantile(0.99) == 0.0  # no samples yet
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v, verb="semmerge")
+    snap = h.snapshot(verb="semmerge")
+    assert snap["count"] == 4 and sum(snap["counts"]) == 4
+    q99 = h.quantile(0.99, verb="semmerge")
+    assert 1.0 < q99 <= 10.0
+    # Unlabeled series is independent and empty.
+    assert h.quantile(0.5) == 0.0
+
+
+# ---------------------------------------------------------------------------
 # device telemetry
 
 

@@ -1,0 +1,267 @@
+"""Perf-regression sentinel (ISSUE 11): the obs.perf core, the
+``semmerge perf record|compare`` CLI, and the standalone
+``scripts/perf_gate.py`` CI gate.
+
+Direction rules under test: ``*/sec`` units are higher-better, wall
+units (``ms``/``seconds``/``pct``) lower-better, phase walls always
+lower-better with a noise floor; new snapshots without a baseline
+entry report but never fail the gate; ``--record`` (re)generates the
+committed ``PERF_BASELINE.json``.
+"""
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from semantic_merge_tpu.obs import perf as obs_perf
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+GATE = REPO_ROOT / "scripts" / "perf_gate.py"
+
+
+def snapshot(value=1000.0, unit="files/sec", phases=None, **extra):
+    rec = {"metric": "files merged/sec/chip (synthetic)", "value": value,
+           "unit": unit, "vs_baseline": 1.0}
+    if phases is not None:
+        rec["phases_ms"] = phases
+    rec.update(extra)
+    return rec
+
+
+def write_snapshot(path, **kwargs):
+    path.write_text(json.dumps(snapshot(**kwargs)) + "\n")
+    return path
+
+
+def run_gate(*argv, cwd=None):
+    return subprocess.run(
+        [sys.executable, str(GATE), *map(str, argv)],
+        capture_output=True, text=True, timeout=120, cwd=cwd)
+
+
+# ---------------------------------------------------------------------------
+# Core: normalization + direction-aware comparison
+
+
+def test_record_key_strips_bench_prefix():
+    assert obs_perf.record_key("BENCH_r05.json") == "r05"
+    assert obs_perf.record_key(pathlib.Path("/x/BENCH_tpu_rung5.json")) \
+        == "tpu_rung5"
+    assert obs_perf.record_key("MULTICHIP_r01.json") == "MULTICHIP_r01"
+
+
+def test_higher_is_better_by_unit():
+    assert obs_perf.higher_is_better("files/sec")
+    assert obs_perf.higher_is_better("merges/s")
+    assert not obs_perf.higher_is_better("ms")
+    assert not obs_perf.higher_is_better("seconds")
+    assert not obs_perf.higher_is_better("pct")
+
+
+def test_normalize_record_keeps_comparable_surface():
+    entry = obs_perf.normalize_record(
+        snapshot(phases={"kernel": 12.0, "scan_encode": 3.0},
+                 error="degraded"), source="BENCH_x.json")
+    assert entry["value"] == 1000.0 and entry["unit"] == "files/sec"
+    assert entry["phases_ms"] == {"kernel": 12.0, "scan_encode": 3.0}
+    assert entry["error"] == "degraded"
+    assert entry["source"] == "BENCH_x.json"
+    assert "vs_baseline" not in entry
+
+
+def test_throughput_drop_is_a_regression_gain_is_not():
+    base = obs_perf.normalize_record(snapshot(value=1000.0))
+    findings = obs_perf.compare_entry(
+        "k", obs_perf.normalize_record(snapshot(value=850.0)), base)
+    assert findings[0]["regression"] is True  # -15% throughput
+    findings = obs_perf.compare_entry(
+        "k", obs_perf.normalize_record(snapshot(value=1500.0)), base)
+    assert findings[0]["regression"] is False  # +50% is an improvement
+    findings = obs_perf.compare_entry(
+        "k", obs_perf.normalize_record(snapshot(value=950.0)), base)
+    assert findings[0]["regression"] is False  # -5% within 10% tolerance
+
+
+def test_latency_increase_is_a_regression():
+    base = obs_perf.normalize_record(snapshot(value=100.0, unit="ms"))
+    findings = obs_perf.compare_entry(
+        "k", obs_perf.normalize_record(snapshot(value=120.0, unit="ms")),
+        base)
+    assert findings[0]["regression"] is True
+    findings = obs_perf.compare_entry(
+        "k", obs_perf.normalize_record(snapshot(value=60.0, unit="ms")),
+        base)
+    assert findings[0]["regression"] is False
+
+
+def test_phase_bands_and_noise_floor():
+    base = obs_perf.normalize_record(snapshot(
+        phases={"kernel": 100.0, "tiny": 1.0}))
+    cur = obs_perf.normalize_record(snapshot(
+        phases={"kernel": 140.0, "tiny": 50.0}))
+    findings = obs_perf.compare_entry("k", cur, base)
+    by_field = {f["field"]: f for f in findings}
+    # kernel +40% > 25% phase tolerance -> regression.
+    assert by_field["phases_ms.kernel"]["regression"] is True
+    # tiny is under the 5ms noise floor in the baseline -> not compared.
+    assert "phases_ms.tiny" not in by_field
+
+
+def test_compare_many_missing_baseline_never_fails():
+    baseline = {"schema": 1, "entries": {}}
+    ok, findings = obs_perf.compare_many(
+        {"new": obs_perf.normalize_record(snapshot())}, baseline)
+    assert ok is True
+    assert findings[0]["note"] == "missing-baseline"
+    assert findings[0]["regression"] is False
+
+
+def test_daemon_entry_prefers_slo_window_quantiles():
+    status = {"slo": {"window_quantiles": {
+        "semmerge": {"p50_ms": 120.0, "p99_ms": 450.0, "count": 9,
+                     "errors": 0},
+        "semdiff": {"p50_ms": 10.0, "p99_ms": 30.0, "count": 4,
+                    "errors": 0},
+    }}}
+    entry = obs_perf.daemon_entry(status)
+    assert entry["value"] == pytest.approx(450.0)
+    assert entry["unit"] == "ms"
+    assert entry["source"] == "slo-window"
+    assert entry["phases_ms"]["semmerge_p99"] == pytest.approx(450.0)
+    assert entry["phases_ms"]["semdiff_p50"] == pytest.approx(10.0)
+
+
+def test_daemon_entry_falls_back_to_cumulative_histogram():
+    status = {"metrics": {"histograms": {"service_request_seconds": {
+        "buckets": [0.1, 1.0, 10.0],
+        "series": [{"labels": {"verb": "semmerge"},
+                    "counts": [0, 8, 2, 0], "count": 10, "sum": 6.0}],
+    }}}}
+    entry = obs_perf.daemon_entry(status)
+    assert entry["source"] == "cumulative-histogram"
+    assert entry["phases_ms"]["semmerge_p99"] > \
+        entry["phases_ms"]["semmerge_p50"] > 0
+
+
+def test_append_trajectory_env_override(tmp_path, monkeypatch):
+    traj = tmp_path / "custom" / "traj.jsonl"
+    monkeypatch.setenv(obs_perf.ENV_TRAJECTORY, str(traj))
+    p1 = obs_perf.append_trajectory(snapshot(), preset="rung5")
+    p2 = obs_perf.append_trajectory(snapshot(value=2.0))
+    assert p1 == p2 == traj
+    rows = [json.loads(l) for l in traj.read_text().splitlines()]
+    assert len(rows) == 2
+    assert rows[0]["preset"] == "rung5" and "ts" in rows[0]
+    assert "preset" not in rows[1]
+
+
+# ---------------------------------------------------------------------------
+# scripts/perf_gate.py exit codes
+
+
+def test_gate_passes_on_baseline_and_fails_on_regression(tmp_path):
+    snap = write_snapshot(tmp_path / "BENCH_x.json", value=1000.0)
+    baseline = tmp_path / obs_perf.BASELINE_NAME
+    rec = run_gate(snap, "--baseline", baseline, "--record")
+    assert rec.returncode == 0, rec.stderr
+    assert baseline.is_file()
+
+    ok = run_gate(snap, "--baseline", baseline)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "REGRESSION" not in ok.stdout
+
+    write_snapshot(tmp_path / "BENCH_x.json", value=500.0)
+    bad = run_gate(snap, "--baseline", baseline, "--json")
+    assert bad.returncode == 1
+    out = json.loads(bad.stdout)
+    assert out["ok"] is False
+    assert any(f["regression"] for f in out["findings"])
+
+
+def test_gate_usage_errors_exit_2(tmp_path):
+    snap = write_snapshot(tmp_path / "BENCH_x.json")
+    missing = run_gate(snap, "--baseline", tmp_path / "absent.json")
+    assert missing.returncode == 2
+    assert "no baseline" in missing.stderr
+
+    garbled = tmp_path / "BENCH_bad.json"
+    garbled.write_text("{not json")
+    bad = run_gate(garbled, "--baseline", tmp_path / "absent.json")
+    assert bad.returncode == 2
+
+
+def test_gate_new_snapshot_reports_but_passes(tmp_path):
+    known = write_snapshot(tmp_path / "BENCH_known.json")
+    baseline = tmp_path / obs_perf.BASELINE_NAME
+    assert run_gate(known, "--baseline", baseline,
+                    "--record").returncode == 0
+    fresh = write_snapshot(tmp_path / "BENCH_fresh.json")
+    out = run_gate(known, fresh, "--baseline", baseline)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "no baseline entry" in out.stdout
+
+
+def test_gate_defaults_cover_committed_snapshots():
+    """The committed PERF_BASELINE.json must gate the checked-in
+    BENCH_*.json snapshots cleanly — the exact tier-1/CI invocation."""
+    proc = run_gate()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# semmerge perf record|compare CLI
+
+
+def test_perf_cli_record_then_compare(tmp_path, capsys):
+    from semantic_merge_tpu.cli import main
+
+    snap = write_snapshot(tmp_path / "BENCH_cli.json", value=200.0)
+    baseline = tmp_path / "PERF_BASELINE.json"
+    assert main(["perf", "record", str(snap),
+                 "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+    assert json.loads(baseline.read_text())["entries"]["cli"]["value"] \
+        == 200.0
+
+    assert main(["perf", "compare", str(snap),
+                 "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+
+    write_snapshot(tmp_path / "BENCH_cli.json", value=100.0)
+    assert main(["perf", "compare", str(snap),
+                 "--baseline", str(baseline), "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is False
+
+    # Improvements re-recorded under a custom key.
+    assert main(["perf", "record", str(snap), "--key", "custom",
+                 "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+    entries = json.loads(baseline.read_text())["entries"]
+    assert set(entries) == {"cli", "custom"}
+
+
+def test_perf_cli_compare_missing_baseline_exits_2(tmp_path, capsys):
+    from semantic_merge_tpu.cli import main
+
+    snap = write_snapshot(tmp_path / "BENCH_cli.json")
+    assert main(["perf", "compare", str(snap),
+                 "--baseline", str(tmp_path / "absent.json")]) == 2
+    capsys.readouterr()
+
+
+@pytest.mark.slow
+def test_perf_cli_daemon_record(tmp_path, service_daemon, capsys,
+                                monkeypatch):
+    from semantic_merge_tpu.cli import main
+
+    monkeypatch.setenv("SEMMERGE_SERVICE_SOCKET", service_daemon)
+    baseline = tmp_path / "PERF_BASELINE.json"
+    assert main(["perf", "record", "--daemon",
+                 "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+    entry = json.loads(baseline.read_text())["entries"]["daemon"]
+    assert entry["unit"] == "ms"
+    assert entry["source"] in ("slo-window", "cumulative-histogram")

@@ -1,0 +1,379 @@
+"""SLO engine + on-demand daemon profiling (ISSUE 11 tentpole).
+
+The contracts under test:
+
+- **Grammar** — ``merge:p99<800ms,err<1%`` parses into labelled
+  clauses; verb aliases map to wire verbs; ``*`` expands per verb;
+  malformed specs raise :class:`SloParseError` (loudly at startup).
+- **Windows** — slot-ring accounting under a fake clock: observations
+  age out of the fast window before the slow one; burn rates follow.
+- **Trip edges** — only ``evaluate(consume_edges=True)`` (the daemon's
+  monitor thread) latches an edge; status polls never swallow one.
+- **Daemon integration** — a daemon started with a tight objective and
+  tiny windows goes unhealthy after one slow merge: ``status`` carries
+  the slo block, ``/healthz`` flips to 503 degraded, and the flight
+  recorder dumps an ``slo-burn`` postmortem bundle.
+- **Profiling** — the ``profile`` wire verb captures a non-empty
+  bundle, twice in a row (the profiler session must not poison the
+  process-global state), and concurrent captures are rejected busy.
+"""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from semantic_merge_tpu.obs import metrics as obs_metrics
+from semantic_merge_tpu.obs import slo as obs_slo
+from semantic_merge_tpu.service import client as svc_client
+
+from test_service_tracing import build_repo, client_env, run_client
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Grammar
+
+
+def test_parse_objectives_latency_and_error_clauses():
+    clauses = obs_slo.parse_objectives("merge:p99<800ms,err<1%")
+    assert [c.kind for c in clauses] == ["latency", "error"]
+    lat, err = clauses
+    assert lat.target == "semmerge"  # alias resolved to the wire verb
+    assert lat.quantile == pytest.approx(0.99)
+    assert lat.threshold_s == pytest.approx(0.8)
+    assert lat.budget == pytest.approx(0.01)
+    assert lat.text == "merge:p99<800ms"
+    assert err.budget == pytest.approx(0.01)
+    assert err.text == "merge:err<1%"
+
+
+def test_parse_objectives_star_expands_per_verb_and_units():
+    clauses = obs_slo.parse_objectives("*:p50<2s")
+    assert sorted(c.target for c in clauses) == \
+        sorted(obs_slo._KNOWN_VERBS)
+    assert all(c.threshold_s == pytest.approx(2.0) for c in clauses)
+    # The per-verb expansion labels each clause with its own verb.
+    assert sorted(c.text for c in clauses) == \
+        sorted(f"{v}:p50<2s" for v in obs_slo._KNOWN_VERBS)
+
+
+def test_parse_objectives_multiple_targets():
+    clauses = obs_slo.parse_objectives("merge:p99<1s;diff:err<5%")
+    assert [(c.target, c.kind) for c in clauses] == \
+        [("semmerge", "latency"), ("semdiff", "error")]
+
+
+@pytest.mark.parametrize("spec", [
+    "merge:p99>800ms",      # wrong comparator
+    "merge:p99<800",        # no unit
+    "merge:q50<1ms",        # unknown clause head
+    "merge:err<1",          # error bound without %
+    "merge:err<200%",       # budget out of range
+    "merge:p0<1ms",         # quantile out of (0, 100)
+    "merge:p100<1ms",
+    "merge:",               # no clauses
+    "p99<800ms",            # no target separator... parsed as target
+    "",                     # empty spec
+])
+def test_parse_objectives_rejects_malformed(spec):
+    with pytest.raises(obs_slo.SloParseError):
+        obs_slo.parse_objectives(spec)
+
+
+# ---------------------------------------------------------------------------
+# Windows + burn under a fake clock
+
+
+def _engine(spec, **kwargs):
+    t = [1000.0]
+    kwargs.setdefault("fast_window", 10.0)
+    kwargs.setdefault("slow_window", 60.0)
+    kwargs.setdefault("slot_seconds", 1.0)
+    eng = obs_slo.SloEngine(obs_slo.parse_objectives(spec),
+                            clock=lambda: t[0], **kwargs)
+    return eng, t
+
+
+def _burns(verdict, text):
+    row = next(r for r in verdict["objectives"] if r["objective"] == text)
+    return row["burn_fast"], row["burn_slow"]
+
+
+def test_latency_burn_and_fast_window_aging():
+    eng, t = _engine("merge:p99<100ms")
+    for _ in range(10):
+        eng.observe("semmerge", 0.5)  # all 10 violate the 100ms bound
+    fast, slow = _burns(eng.evaluate(), "merge:p99<100ms")
+    # violation fraction 1.0 over budget 0.01 -> burn 100 in both windows
+    assert fast == pytest.approx(100.0, rel=0.05)
+    assert slow == pytest.approx(100.0, rel=0.05)
+    # Age past the fast window but stay inside the slow one.
+    t[0] += 30.0
+    for _ in range(90):
+        eng.observe("semmerge", 0.001)  # healthy traffic now
+    fast, slow = _burns(eng.evaluate(), "merge:p99<100ms")
+    assert fast == pytest.approx(0.0, abs=1.0)
+    # Slow window still remembers the 10 bad samples out of 100.
+    assert slow > 1.0
+
+
+def test_error_burn_counts_failures():
+    eng, t = _engine("merge:err<10%")
+    for i in range(10):
+        eng.observe("semmerge", 0.01, error=(i < 5))
+    fast, slow = _burns(eng.evaluate(), "merge:err<10%")
+    assert fast == pytest.approx(5.0)  # 50% errors / 10% budget
+    assert slow == pytest.approx(5.0)
+
+
+def test_no_samples_means_zero_burn_and_healthy():
+    eng, _ = _engine("merge:p99<1ms")
+    verdict = eng.evaluate()
+    assert verdict["healthy"] is True
+    assert _burns(verdict, "merge:p99<1ms") == (0.0, 0.0)
+
+
+def test_eviction_drops_slots_past_slow_window():
+    eng, t = _engine("merge:err<1%")
+    eng.observe("semmerge", 0.01, error=True)
+    t[0] += 120.0  # well past the 60s slow window
+    eng.observe("semmerge", 0.01)  # triggers eviction
+    verdict = eng.evaluate()
+    assert verdict["healthy"] is True
+    fast, slow = _burns(verdict, "merge:err<1%")
+    assert fast == 0.0 and slow == 0.0
+
+
+def test_trip_edges_latch_only_when_consumed():
+    eng, t = _engine("merge:p99<1ms")
+    for _ in range(5):
+        eng.observe("semmerge", 1.0)
+    # A status-style poll sees the trip but must not consume the edge.
+    polled = eng.evaluate()
+    assert polled["healthy"] is False
+    assert polled["newly_tripped"] == []
+    # The monitor's consuming evaluate gets the edge exactly once.
+    first = eng.evaluate(consume_edges=True)
+    assert [r["objective"] for r in first["newly_tripped"]] == \
+        ["merge:p99<1ms"]
+    second = eng.evaluate(consume_edges=True)
+    assert second["newly_tripped"] == []
+    # Trip counter incremented once, with the objective label.
+    counter = obs_metrics.REGISTRY.counter(obs_slo.TRIP_COUNTER)
+    assert counter.value(objective="merge:p99<1ms") >= 1
+
+
+def test_burn_gauges_published_with_documented_labels():
+    eng, _ = _engine("merge:p99<1ms")
+    eng.observe("semmerge", 1.0)
+    eng.evaluate()
+    dump = obs_metrics.REGISTRY.to_dict()
+    series = dump["gauges"][obs_slo.BURN_GAUGE]["series"]
+    windows = {s["labels"]["window"] for s in series
+               if s["labels"].get("objective") == "merge:p99<1ms"}
+    assert {"fast", "slow"} <= windows
+    for s in series:
+        assert sorted(s["labels"].keys()) == ["objective", "window"]
+        assert s["value"] >= 0
+
+
+def test_status_carries_window_quantiles():
+    eng, _ = _engine("merge:p99<10s")
+    for v in (0.01, 0.02, 0.03, 0.5):
+        eng.observe("semmerge", v)
+    eng.observe("semmerge", 0.5, error=True)
+    status = eng.status()
+    assert "newly_tripped" not in status
+    wq = status["window_quantiles"]["semmerge"]
+    assert wq["count"] == 5 and wq["errors"] == 1
+    assert 0 < wq["p50_ms"] <= wq["p99_ms"]
+
+
+def test_from_env_precedence_and_absence(monkeypatch):
+    monkeypatch.delenv(obs_slo.ENV_OBJECTIVES, raising=False)
+    assert obs_slo.from_env() is None
+    eng = obs_slo.from_env("merge:p99<1s", config_fast_window=7.0)
+    assert eng is not None and eng.fast_window == pytest.approx(7.0)
+    monkeypatch.setenv(obs_slo.ENV_OBJECTIVES, "diff:err<2%")
+    monkeypatch.setenv(obs_slo.ENV_FAST_WINDOW, "11")
+    eng = obs_slo.from_env("merge:p99<1s")  # env spec wins over config
+    assert [c.target for c in eng.clauses] == ["semdiff"]
+    assert eng.fast_window == pytest.approx(11.0)
+    monkeypatch.setenv(obs_slo.ENV_OBJECTIVES, "merge:bogus<1")
+    with pytest.raises(obs_slo.SloParseError):
+        obs_slo.from_env()
+
+
+# ---------------------------------------------------------------------------
+# Daemon integration: burn -> status/healthz/postmortem
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_daemon_burn_degrades_healthz_and_dumps_postmortem(
+        tmp_path, daemon_factory):
+    """One deliberately-slow merge against a 1ms p99 objective with
+    second-scale windows: the monitor thread trips the objective, the
+    status verb and /healthz report degraded, and an ``slo-burn``
+    bundle lands in SEMMERGE_POSTMORTEM_DIR."""
+    pm_dir = tmp_path / "postmortem"
+    sock = str(tmp_path / "daemon.sock")
+    daemon_factory(sock, extra_env={
+        "SEMMERGE_SLO": "merge:p99<1ms",
+        "SEMMERGE_SLO_FAST_WINDOW": "20",
+        "SEMMERGE_SLO_SLOW_WINDOW": "40",
+        "SEMMERGE_SLO_SLOT": "1",
+        "SEMMERGE_SLO_EVAL_INTERVAL": "0.2",
+        "SEMMERGE_METRICS_PORT": "0",
+        "SEMMERGE_POSTMORTEM_DIR": str(pm_dir),
+    })
+    repo = build_repo(tmp_path / "repo")
+    proc = run_client(repo, client_env(sock))
+    assert proc.returncode == 0, proc.stderr
+
+    deadline = time.monotonic() + 30
+    status = None
+    while time.monotonic() < deadline:
+        status = svc_client.call_control("status", path=sock)
+        slo = status.get("slo")
+        if slo and not slo.get("healthy", True):
+            break
+        time.sleep(0.2)
+    assert status is not None
+    slo = status.get("slo")
+    assert slo and slo["healthy"] is False, f"slo never went unhealthy: {slo}"
+    row = next(r for r in slo["objectives"]
+               if r["objective"] == "merge:p99<1ms")
+    assert row["tripped"] is True
+    assert row["burn_fast"] >= 1.0 and row["burn_slow"] >= 1.0
+    assert slo["window_quantiles"]["semmerge"]["count"] >= 1
+
+    # /healthz flips to 503 with the degraded flag set.
+    port = status.get("metrics_port")
+    assert port, "daemon must report its bound telemetry port"
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10)
+    assert exc_info.value.code == 503
+    body = json.loads(exc_info.value.read())
+    assert body["degraded"] is True
+    assert body["slo"]["healthy"] is False
+
+    # The monitor's consuming evaluate dumped exactly one slo-burn
+    # bundle for the excursion (edge-latched, not one per tick).
+    deadline = time.monotonic() + 15
+    bundles = []
+    while time.monotonic() < deadline:
+        bundles = sorted(pm_dir.glob("*.json")) if pm_dir.is_dir() else []
+        if bundles:
+            break
+        time.sleep(0.2)
+    assert bundles, "slo-burn trip must dump a postmortem bundle"
+    data = json.loads(bundles[0].read_text())
+    assert data["reason"] == "slo-burn"
+    assert data["slo"]["healthy"] is False
+    # The bundle passes the schema validator, including the new reason.
+    script = REPO_ROOT / "scripts" / "check_trace_schema.py"
+    ok = subprocess.run(
+        [sys.executable, str(script), "validate_postmortem",
+         str(bundles[0])], capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    # And the status payload satisfies the slo-block validator.
+    status_path = tmp_path / "status.json"
+    status_path.write_text(json.dumps(status))
+    ok = subprocess.run(
+        [sys.executable, str(script), "validate_slo", str(status_path)],
+        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+
+
+# ---------------------------------------------------------------------------
+# On-demand profiling
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_profile_verb_captures_nonempty_bundle_twice(tmp_path,
+                                                     service_daemon):
+    """Two back-to-back captures: each bundle directory is non-empty
+    and self-describing; the second must not fail because the first
+    left the process-global profiler session poisoned."""
+    for i in range(2):
+        out = svc_client.capture_profile(
+            0.3, out_dir=tmp_path / f"cap{i}", path=service_daemon)
+        assert out.get("ok") is True, out
+        bundle_dir = pathlib.Path(out["dir"])
+        assert bundle_dir.is_dir()
+        assert out["files"], f"capture {i} produced an empty bundle"
+        manifest = json.loads((bundle_dir / "bundle.json").read_text())
+        assert manifest["schema"] == 1 and manifest["ok"] is True
+        assert manifest["seconds"] == pytest.approx(0.3)
+        assert "metrics_before" in manifest and "metrics_after" in manifest
+
+
+@pytest.mark.slow
+def test_profile_cli_command(tmp_path, service_daemon):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEMMERGE_SERVICE_SOCKET"] = service_daemon
+    proc = subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu", "profile", "--daemon",
+         "--seconds", "0.3", "--out", str(tmp_path / "cli-cap"), "--json"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["ok"] is True and out["files"]
+
+
+def test_profile_cli_without_daemon_fails_cleanly(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEMMERGE_SERVICE_SOCKET"] = str(tmp_path / "absent.sock")
+    proc = subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu", "profile", "--daemon",
+         "--seconds", "0.2"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 1
+    assert "daemon" in proc.stderr.lower()
+
+
+# ---------------------------------------------------------------------------
+# Profiler-session recovery (satellite: runtime/trace.py fix)
+# ---------------------------------------------------------------------------
+
+
+def test_start_profiler_session_recovers_from_poisoned_state(tmp_path,
+                                                             monkeypatch):
+    """A crashed --profile run leaves jax's module-global profiler state
+    wedged; the next start must stop the stale session and retry instead
+    of failing every capture until daemon restart."""
+    from semantic_merge_tpu.runtime import trace as rt_trace
+
+    calls = {"start": 0, "stop": 0}
+
+    class FakeProfiler:
+        @staticmethod
+        def start_trace(path):
+            calls["start"] += 1
+            if calls["start"] == 1:
+                raise RuntimeError("profiler session already active")
+
+        @staticmethod
+        def stop_trace():
+            calls["stop"] += 1
+
+    import jax
+    monkeypatch.setattr(jax, "profiler", FakeProfiler)
+    assert rt_trace.start_profiler_session(str(tmp_path)) is True
+    assert calls == {"start": 2, "stop": 1}
+    failures = obs_metrics.REGISTRY.counter(rt_trace.PROFILER_FAILURES)
+    assert failures.value(reason="start") >= 1

@@ -201,7 +201,9 @@ def test_resilience_records_validate(schema, tmp_path, monkeypatch):
 
     monkeypatch.setenv("SEMMERGE_BREAKER", "on")
     monkeypatch.setenv("SEMMERGE_BREAKER_THRESHOLD", "2")
-    monkeypatch.setenv("SEMMERGE_BREAKER_COOLDOWN", "0.01")
+    # Cooldown long enough that a loaded box can't age the breaker into
+    # half-open between record_failure and the open assert (0.01 flaked).
+    monkeypatch.setenv("SEMMERGE_BREAKER_COOLDOWN", "0.25")
     board = resilience.BreakerBoard()
     tracer = trace_mod.Tracer(enabled=True)
     with tracer.phase("merge", backend="host"):
@@ -210,7 +212,7 @@ def test_resilience_records_validate(schema, tmp_path, monkeypatch):
         board.record_failure("fused")   # trips open
         assert not board.allow("fused")
         import time
-        time.sleep(0.02)
+        time.sleep(0.3)
         assert board.allow("fused")     # half-open probe
         board.record_success("fused")   # closes
         obs_spans.record("supervisor.restart", 0.2, layer="service",
@@ -446,6 +448,75 @@ def test_request_traces_validator(schema, tmp_path):
                for e in schema.validate_request_traces(broken))
 
 
+def test_slo_records_validate(schema, tmp_path):
+    """REAL SLO engine output — burn gauges + trip counter published by
+    an evaluating engine, plus a daemon-status-shaped slo block — passes
+    ``validate_slo``; drifted shapes (mislabeled gauge, undocumented
+    window, negative burn, malformed status block) are rejected."""
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    from semantic_merge_tpu.obs import slo as obs_slo
+
+    engine = obs_slo.SloEngine(
+        obs_slo.parse_objectives("merge:p99<1ms,err<1%"))
+    for _ in range(3):
+        engine.observe("semmerge", 0.5)
+    status = engine.evaluate(consume_edges=True)
+    payload = {"metrics": obs_metrics.REGISTRY.to_dict(),
+               "slo": engine.status()}
+    assert schema.validate_slo(payload) == []
+    assert status["newly_tripped"], "engine must have tripped"
+
+    broken = json.loads(json.dumps(payload))
+    gauge = broken["metrics"]["gauges"]["slo_burn_rate"]
+    gauge["series"][0]["labels"] = {"objective": "x"}
+    assert any("slo_burn_rate" in e for e in schema.validate_slo(broken))
+
+    broken = json.loads(json.dumps(payload))
+    gauge = broken["metrics"]["gauges"]["slo_burn_rate"]
+    gauge["series"][0]["labels"]["window"] = "medium"
+    assert any("'medium'" in e for e in schema.validate_slo(broken))
+
+    broken = json.loads(json.dumps(payload))
+    gauge = broken["metrics"]["gauges"]["slo_burn_rate"]
+    gauge["series"][0]["value"] = -1.0
+    assert any(">= 0" in e for e in schema.validate_slo(broken))
+
+    broken = json.loads(json.dumps(payload))
+    trips = broken["metrics"]["counters"]["slo_burn_trips_total"]
+    trips["series"][0]["labels"] = {"objective": "x", "verb": "y"}
+    assert any("slo_burn_trips_total" in e
+               for e in schema.validate_slo(broken))
+
+    broken = json.loads(json.dumps(payload))
+    broken["slo"]["healthy"] = "yes"
+    assert any("healthy" in e for e in schema.validate_slo(broken))
+
+    broken = json.loads(json.dumps(payload))
+    broken["slo"]["objectives"][0]["burn_fast"] = -2.0
+    assert any("burn_fast" in e for e in schema.validate_slo(broken))
+
+    # The CLI subcommand wires the same validator.
+    good = tmp_path / "status.json"
+    good.write_text(json.dumps(payload))
+    ok = subprocess.run([sys.executable, str(_SCRIPT), "validate_slo",
+                         str(good)], capture_output=True, text=True,
+                        timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(broken))
+    fail = subprocess.run([sys.executable, str(_SCRIPT), "validate_slo",
+                           str(bad)], capture_output=True, text=True,
+                          timeout=60)
+    assert fail.returncode == 1
+    assert "burn_fast" in fail.stderr
+
+
+def test_slo_burn_reason_is_documented(schema):
+    from semantic_merge_tpu.obs import flight as obs_flight
+    assert "slo-burn" in schema.POSTMORTEM_REASONS
+    assert tuple(schema.POSTMORTEM_REASONS) == tuple(obs_flight.REASONS)
+
+
 def test_bench_record_validates(schema):
     """A representative BENCH record — with the additive host-tail,
     apply-phase, and strict-preset fields — validates; broken shapes
@@ -462,8 +533,10 @@ def test_bench_record_validates(schema):
                     "hidden_ms": 30.0},
         "strict_ms": 900.0, "nonstrict_ms": 500.0,
         "strict_conflicts": 0, "strict_motion_ops": 2,
+        "slo_overhead_pct": 0.4, "slo_dark_ms": 100.0, "slo_on_ms": 100.4,
     }
     assert schema.validate_bench(record) == []
+    assert schema.validate_bench({**record, "slo_overhead_pct": "low"})
     for name in schema.APPLY_PHASE_SPANS:
         assert schema.validate_bench(
             {**record, "phases_ms": {name: -1.0}})

@@ -554,6 +554,11 @@ PRESETS = {
     "overload": {"files": 24, "decls": 4, "overload": True},
     "tracecost": {"files": 10000, "decls": 4, "tracecost": True},
     "slocost": {"files": 10000, "decls": 4, "slocost": True},
+    # resolve: files = number of independently-resolvable
+    # ConcurrentStmtEdit conflict files; the preset measures the
+    # resolution tier's premium and per-gate cost, so the workload is
+    # conflict-dense, not large.
+    "resolve": {"files": 6, "decls": 1},
 }
 
 # Set by main() once the preset is resolved; emit_record stamps it into
@@ -830,6 +835,180 @@ def run_warmserve_bench(record: dict, args, json_only: bool = False) -> int:
                 daemon.wait(timeout=30)
             except Exception:
                 daemon.kill()
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def _build_resolve_bench_repo(root, n_conflicts: int) -> None:
+    """A git repo whose strict-mode merge yields ``n_conflicts``
+    independent ``ConcurrentStmtEdit`` conflicts, every one resolvable:
+    brA and brB edit *disjoint* lines of each function body, so the
+    resolver's 3-way body merge is the unique winner for all of them —
+    resolve-on exits 0 where resolve-off exits 1 on the identical
+    workload. ConcurrentStmtEdit is the corpus category because its
+    strict-mode detection is deterministic at any count; the parity
+    walk's head-vs-head DivergentRename detection masks concurrent
+    same-category conflicts by design (reference semantics), which
+    would make a multi-conflict rename corpus flaky."""
+    import subprocess
+
+    def git(*argv):
+        subprocess.run(["git", *argv], cwd=root, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def tree(edit_a=False, edit_b=False):
+        files = {}
+        for i in range(n_conflicts):
+            # Signatures are unique per file (i extra string params):
+            # symbolId is a pure function of the type signature, so
+            # same-signature decls across files would collapse into one
+            # symbol and drop all edits but the last file's.
+            pad = "".join(f", x{k}: string" for k in range(i))
+            line1 = f"n = n + {i + 3};" if edit_a else f"n = n + {i + 1};"
+            line2 = "n = n * 4;" if edit_b else "n = n * 2;"
+            files[f"src/calc{i:03d}.ts"] = (
+                f"export function calc{i}(n: number{pad}): number {{\n"
+                f"  {line1}\n"
+                f"  {line2}\n"
+                f"  return n;\n"
+                f"}}\n")
+        return files
+
+    def commit(files, msg):
+        for path, content in files.items():
+            p = root / path
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(content)
+        git("add", "-A")
+        git("commit", "-q", "-m", msg)
+
+    root.mkdir(parents=True)
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "bench@example.com")
+    git("config", "user.name", "bench")
+    commit(tree(), "base")
+    git("branch", "basebr")
+    git("checkout", "-qb", "brA")
+    commit(tree(edit_a=True), "edit first statement")
+    git("checkout", "-q", "main")
+    git("checkout", "-qb", "brB")
+    commit(tree(edit_b=True), "edit second statement")
+    git("checkout", "-q", "main")
+
+
+def run_resolve_bench(record: dict, args, json_only: bool = False) -> int:
+    """The ``resolve`` preset: what the conflict-resolution tier costs
+    and buys on a conflict-dense merge. Baseline = ``--resolve`` off
+    (the merge exits 1, conflict-as-result); measured = ``--resolve``
+    auto on the identical repo (every conflict resolves, exit 0),
+    parity-gated by the audit records themselves — every accepted
+    resolution must show all four verify gates green, the second of
+    which is the untouched-region parity check. Additive BENCH fields:
+    ``resolution_rate``, ``resolve_on_ms`` / ``resolve_off_ms``, and
+    the per-gate totals ``gate_recompose_ms`` / ``gate_parity_ms`` /
+    ``gate_typecheck_ms`` / ``gate_format_ms`` read from the v2
+    conflicts artifact."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    scratch = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-resolve-"))
+    repo = scratch / "repo"
+    _build_resolve_bench_repo(repo, args.files)
+
+    child_env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    prior_pp = child_env.get("PYTHONPATH", "")
+    child_env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{prior_pp}"
+                               if prior_pp else pkg_root)
+    child_env["SEMMERGE_DAEMON"] = "off"
+    for var in ("SEMMERGE_FAULT", "SEMMERGE_METRICS", "SEMMERGE_RESOLVE",
+                "SEMMERGE_STRICT"):
+        child_env.pop(var, None)
+    if os.environ.get("SEMMERGE_BENCH_PLATFORM") == "cpu":
+        child_env["JAX_PLATFORMS"] = "cpu"
+    # Strict conflict detection: deterministic multi-conflict surfacing
+    # (see _build_resolve_bench_repo on why the corpus needs it).
+    merge_argv = ["semmerge", "basebr", "brA", "brB", "--backend", "host",
+                  "--strict-conflicts"]
+    artifact = repo / ".semmerge-conflicts.json"
+
+    def one_shot(extra_argv):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "semantic_merge_tpu",
+             *merge_argv, *extra_argv],
+            cwd=repo, env=child_env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True, timeout=600)
+        return proc, time.perf_counter() - t0
+
+    try:
+        off_walls = []
+        for _ in range(2):
+            proc, wall = one_shot([])
+            if proc.returncode != 1:
+                record["error"] = (
+                    f"resolve-off merge exit {proc.returncode} (want 1: "
+                    f"conflict-as-result): {proc.stderr[-500:]}")
+                emit_record(record)
+                return 1
+            off_walls.append(wall)
+        legacy = json.loads(artifact.read_text())
+        if not isinstance(legacy, list) or not legacy:
+            record["error"] = ("resolve-off artifact is not the legacy "
+                               "non-empty bare array")
+            emit_record(record)
+            return 1
+        n_conflicts = len(legacy)
+
+        on_walls, payload = [], None
+        for _ in range(3):
+            proc, wall = one_shot(["--resolve"])
+            if proc.returncode != 0:
+                record["error"] = (
+                    f"resolve-on merge exit {proc.returncode} (want 0: "
+                    f"verified suggestion): {proc.stderr[-500:]}")
+                emit_record(record)
+                return 1
+            on_walls.append(wall)
+            payload = json.loads(artifact.read_text())
+        resolutions = payload.get("resolutions", [])
+        accepted = sum(r.get("status") == "accepted" for r in resolutions)
+        gate_ms = {g: 0.0 for g in ("recompose", "parity",
+                                    "typecheck", "format")}
+        parity_ok = bool(resolutions)
+        for r in resolutions:
+            for row in r.get("gates", []):
+                if row.get("gate") in gate_ms:
+                    gate_ms[row["gate"]] += float(row.get("ms", 0.0))
+            if r.get("status") == "accepted" and not all(
+                    row.get("ok") for row in r.get("gates", [])):
+                parity_ok = False
+
+        off_s, on_s = min(off_walls), min(on_walls)
+        record["metric"] = (
+            f"conflicts resolved/sec (resolution tier on vs off, "
+            f"{n_conflicts} ConcurrentStmtEdit conflicts, host backend, "
+            f"parity={'ok' if parity_ok else 'FAIL'})")
+        record["value"] = round(n_conflicts / on_s, 2)
+        record["unit"] = "conflicts/sec"
+        record["vs_baseline"] = round(off_s / on_s, 3)
+        record["parity"] = parity_ok
+        record["resolution_rate"] = round(accepted / max(1, n_conflicts), 4)
+        record["resolve_on_ms"] = round(on_s * 1e3, 1)
+        record["resolve_off_ms"] = round(off_s * 1e3, 1)
+        for gate, total in gate_ms.items():
+            record[f"gate_{gate}_ms"] = round(total, 1)
+        if not json_only:
+            print(f"# resolve off: {off_s*1e3:8.1f} ms (exit 1, "
+                  f"{n_conflicts} conflicts)", file=sys.stderr)
+            print(f"# resolve on:  {on_s*1e3:8.1f} ms (exit 0, "
+                  f"{accepted}/{n_conflicts} accepted)", file=sys.stderr)
+            print("# gates: " + "  ".join(f"{g}={v:.1f}ms"
+                                          for g, v in gate_ms.items()),
+                  file=sys.stderr)
+        emit_record(record)
+        return 0 if (accepted == n_conflicts and parity_ok) else 1
+    finally:
         shutil.rmtree(scratch, ignore_errors=True)
 
 
@@ -1406,6 +1585,10 @@ def main() -> int:
         # Same shape again: admission control, breakers, and RSS are
         # all exercised inside the spawned daemon.
         return run_overload_bench(record, args, json_only=args.json_only)
+    if args.preset == "resolve":
+        # One-shot CLI subprocesses on the host backend: the parent
+        # needs no accelerator.
+        return run_resolve_bench(record, args, json_only=args.json_only)
 
     # Accelerator acquisition, hardened (round 1 died here with rc=1 and
     # no JSON): probe the relay-backed TPU plugin in a throwaway

@@ -3,9 +3,11 @@
 
 Drives a ``semmerge serve --supervise`` daemon with concurrent mixed
 traffic — clean ``--inplace`` merges, fault-injected merges that must
-degrade to the byte-exact textual rung, and strict-mode requests that
-must surface documented typed exits — while SIGKILLing the daemon at
-randomized points mid-soak. The supervisor must bring it back on the
+degrade to the byte-exact textual rung, strict-mode requests that
+must surface documented typed exits, and resolver-enabled merges of
+genuinely conflicting repos that must land on the search resolver's
+verified suggestion — while SIGKILLing the daemon at randomized
+points mid-soak. The supervisor must bring it back on the
 same socket; harness workers ride through the outage with bounded
 idempotent retries, exactly like the real client.
 
@@ -68,17 +70,35 @@ ARTIFACTS = {".semmerge-conflicts.json", ".semmerge-trace.json",
              ".semmerge-events.jsonl", ".semmerge-journal.json",
              ".semmerge-postmortem"}
 
+#: The tree the *conflict* repos must converge on once the resolver
+#: tier picks the evidence-backed rename (A renamed foo->bar and
+#: rewrote the call site; B renamed the declaration only, so keepA
+#: wins 2:1 on whole-word reference counts).
+RESOLVED_TREE = {
+    "src/util.ts": "export function bar(n: number): number {\n"
+                   "  return n;\n}\n"
+                   "export function use(s: string): number {\n"
+                   "  return bar(s.length);\n}\n",
+}
+
 #: Request shapes: (name, request env overlay, documented exit codes).
 #: Fault-injected non-strict merges must land on the textual rung
 #: (exit 0); strict ones surface the scan's ParseFault (10) — or, once
 #: the chaos traffic has tripped the host-rung circuit breaker, the
-#: breaker-open WorkerFault (12). Anything else fails the soak.
+#: breaker-open WorkerFault (12). The ``resolve`` shape runs against
+#: the conflict-repo pool with the resolution tier enabled and must
+#: merge clean (exit 0) on the resolver's verified suggestion — or,
+#: while the host-rung breaker is open, degrade to the textual rung
+#: where the rename genuinely conflicts (documented exit 1,
+#: conflict-as-result). Anything else fails the soak.
+RESOLVE_ENV = {"SEMMERGE_RESOLVE": "auto"}
 SHAPES = [
     ("clean", {}, {0}),
     ("degrade-scan", {"SEMMERGE_FAULT": "scan:raise"}, {0}),
     ("degrade-apply", {"SEMMERGE_FAULT": "apply:fault"}, {0}),
     ("strict-scan", {"SEMMERGE_FAULT": "scan:fault",
                      "SEMMERGE_STRICT": "1"}, {10, 12}),
+    ("resolve", dict(RESOLVE_ENV), {0, 1}),
 ]
 
 
@@ -121,10 +141,55 @@ def build_repo(root: pathlib.Path) -> pathlib.Path:
     return root
 
 
-def tree_errors(root: pathlib.Path) -> List[str]:
+def build_conflict_repo(root: pathlib.Path) -> pathlib.Path:
+    """A repo whose merge genuinely conflicts (DivergentRename) but
+    carries asymmetric reference evidence, so the search resolver
+    settles it deterministically onto :data:`RESOLVED_TREE`."""
+    root.mkdir(parents=True)
+    _git(["init", "-q", "-b", "main"], root)
+    _git(["config", "user.email", "t@example.com"], root)
+    _git(["config", "user.name", "t"], root)
+    env = dict(os.environ,
+               GIT_AUTHOR_DATE="2024-01-01T00:00:00Z",
+               GIT_COMMITTER_DATE="2024-01-01T00:00:00Z")
+
+    def commit(msg):
+        subprocess.run(["git", "add", "-A"], cwd=root, check=True,
+                       stdout=subprocess.DEVNULL)
+        subprocess.run(["git", "commit", "-q", "-m", msg], cwd=root,
+                       check=True, env=env, stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+
+    (root / "src").mkdir()
+    (root / "src/util.ts").write_text(
+        "export function foo(n: number): number {\n"
+        "  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        "  return foo(s.length);\n}\n")
+    commit("base")
+    _git(["branch", "basebr"], root)
+    _git(["checkout", "-qb", "brA"], root)
+    (root / "src/util.ts").write_text(RESOLVED_TREE["src/util.ts"])
+    commit("rename foo->bar, rewrite call site")
+    _git(["checkout", "-q", "main"], root)
+    _git(["checkout", "-qb", "brB"], root)
+    (root / "src/util.ts").write_text(
+        "export function baz(n: number): number {\n"
+        "  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        "  return foo(s.length);\n}\n")
+    commit("rename foo->baz declaration only")
+    _git(["checkout", "-q", "main"], root)
+    return root
+
+
+def tree_errors(root: pathlib.Path,
+                expected: Optional[Dict[str, str]] = None) -> List[str]:
     """Byte-exactness + debris check for one settled repo."""
     errors = []
-    for rel, want in EXPECTED_TREE.items():
+    if expected is None:
+        expected = EXPECTED_TREE
+    for rel, want in expected.items():
         p = root / rel
         if not p.is_file():
             errors.append(f"{root.name}: missing {rel}")
@@ -142,7 +207,7 @@ def tree_errors(root: pathlib.Path) -> List[str]:
         rel = p.relative_to(root).as_posix()
         if rel.startswith(".git/") or rel.split("/")[0] in ARTIFACTS:
             continue
-        if rel not in EXPECTED_TREE:
+        if rel not in expected:
             errors.append(f"{root.name}: unexpected file {rel}")
         extra.update(rel.encode())
     return errors
@@ -237,6 +302,7 @@ def spawn_supervised(sock_path: str, dump_path: pathlib.Path,
     })
     env.pop("SEMMERGE_FAULT", None)
     env.pop("SEMMERGE_STRICT", None)
+    env.pop("SEMMERGE_RESOLVE", None)
     if extra_env:
         env.update(extra_env)
     log = open(sock_path + ".log", "ab")
@@ -297,6 +363,11 @@ def run_soak(workdir: pathlib.Path, *, requests: int = 200, repos: int = 8,
     workdir = pathlib.Path(workdir)
     workdir.mkdir(parents=True, exist_ok=True)
     repo_paths = [build_repo(workdir / f"repo{i}") for i in range(repos)]
+    # A smaller pool of genuinely-conflicting repos serviced only by
+    # the resolver-enabled shape (resolver-off traffic against them
+    # would exit 1 and break the byte-exact settling invariant).
+    conflict_paths = [build_conflict_repo(workdir / f"crepo{i}")
+                      for i in range(max(1, repos // 4))]
     sock = str(workdir / "chaos.sock")
     dump = workdir / "supervisor-metrics.json"
     env = {"SEMMERGE_RSS_HARD_MB": str(hard_mb)}
@@ -314,10 +385,14 @@ def run_soak(workdir: pathlib.Path, *, requests: int = 200, repos: int = 8,
         status = wait_daemon(sock, sup)
         stats["pids_seen"].add(status["pid"])
 
-        # The request schedule: shapes spread over repos, kill points
-        # scattered through the middle of the run.
-        schedule = [(rng.randrange(repos), SHAPES[rng.randrange(len(SHAPES))])
-                    for _ in range(requests)]
+        # The request schedule: shapes spread over repos (the resolve
+        # shape over the conflict-repo pool), kill points scattered
+        # through the middle of the run.
+        schedule = []
+        for _ in range(requests):
+            shape = SHAPES[rng.randrange(len(SHAPES))]
+            pool = conflict_paths if shape[0] == "resolve" else repo_paths
+            schedule.append((pool[rng.randrange(len(pool))], shape))
         kill_points = sorted(rng.sample(
             range(requests // 4, max(requests // 4 + kills, 3 * requests // 4)),
             kills)) if kills else []
@@ -325,11 +400,10 @@ def run_soak(workdir: pathlib.Path, *, requests: int = 200, repos: int = 8,
         sem = threading.Semaphore(concurrency)
         threads: List[threading.Thread] = []
 
-        def fire(repo_idx: int, shape) -> None:
+        def fire(repo: pathlib.Path, shape) -> None:
             name, shape_env, allowed = shape
             try:
-                resp = request(sock, repo_paths[repo_idx], dict(shape_env),
-                               stats)
+                resp = request(sock, repo, dict(shape_env), stats)
             except RuntimeError as exc:
                 with stats["lock"]:
                     stats["bad_responses"].append(f"{name}: {exc}")
@@ -350,7 +424,7 @@ def run_soak(workdir: pathlib.Path, *, requests: int = 200, repos: int = 8,
                         f"{name}: exit {code!r} not in documented {allowed} "
                         f"({resp.get('error') or ''})")
 
-        for i, (repo_idx, shape) in enumerate(schedule):
+        for i, (repo, shape) in enumerate(schedule):
             if kill_points and i == kill_points[0]:
                 kill_points.pop(0)
                 status = daemon_status(sock)
@@ -362,7 +436,7 @@ def run_soak(workdir: pathlib.Path, *, requests: int = 200, repos: int = 8,
                     except OSError:
                         pass
             sem.acquire()
-            t = threading.Thread(target=fire, args=(repo_idx, shape))
+            t = threading.Thread(target=fire, args=(repo, shape))
             t.start()
             threads.append(t)
             done["n"] = i + 1
@@ -371,17 +445,34 @@ def run_soak(workdir: pathlib.Path, *, requests: int = 200, repos: int = 8,
 
         # Settle: one clean merge per repo resolves any journal left by
         # a SIGKILL mid-commit, then the tree must be byte-exact.
+        # Conflict repos settle with the resolution tier enabled and
+        # must land on the resolver's verified suggestion.
         final = wait_daemon(sock, sup)
         stats["pids_seen"].add(final["pid"])
-        for repo in repo_paths:
-            resp = request(sock, repo, {}, stats)
-            code = (resp.get("result") or resp.get("error") or {}) \
-                .get("exit_code")
-            if code != 0:
-                report["errors"].append(
-                    f"{repo.name}: settling merge exited {code!r}")
+        for repo in repo_paths + conflict_paths:
+            is_conflict = repo in conflict_paths
+            settle_env = dict(RESOLVE_ENV) if is_conflict else {}
+            settle_by = time.monotonic() + 60.0
+            while True:
+                resp = request(sock, repo, dict(settle_env), stats)
+                code = (resp.get("result") or resp.get("error") or {}) \
+                    .get("exit_code")
+                if code == 0:
+                    break
+                # A conflict repo's settle can land while the fault
+                # traffic's host-rung breaker is still open (textual
+                # rung, where the rename genuinely conflicts: exit 1).
+                # Wait out the breaker cooldown and retry.
+                if not (is_conflict and code == 1
+                        and time.monotonic() < settle_by):
+                    report["errors"].append(
+                        f"{repo.name}: settling merge exited {code!r}")
+                    break
+                time.sleep(1.0)
         for repo in repo_paths:
             report["errors"].extend(tree_errors(repo))
+        for repo in conflict_paths:
+            report["errors"].extend(tree_errors(repo, RESOLVED_TREE))
 
         final = daemon_status(sock) or final
         counters = (final.get("metrics") or {}).get("counters", {})
@@ -397,6 +488,10 @@ def run_soak(workdir: pathlib.Path, *, requests: int = 200, repos: int = 8,
         report["breaker_transitions"] = _counter_total(
             "breaker_transitions_total")
         report["shed_total"] = _counter_total("service_shed_total")
+        # Resolver activity in the surviving daemon's lifetime; the
+        # resolver-settled merges above guarantee at least one
+        # accepted resolution even right after a respawn.
+        report["resolutions_total"] = _counter_total("resolutions_total")
         report["breakers"] = (final.get("resilience") or {}).get("breakers")
         report["final_rss_mb"] = final.get("rss_mb")
         if report["final_rss_mb"] is None \

@@ -17,12 +17,14 @@ Subcommand modes for the request-tracing artifacts::
         TRACE_JSON TRACE_JSON [...]
     python scripts/check_trace_schema.py validate_slo \
         STATUS_OR_TRACE_JSON [...]
+    python scripts/check_trace_schema.py validate_conflicts \
+        .semmerge-conflicts.json [...]
 
 Exit 0 when everything conforms, 1 with one line per violation
 otherwise. The tier-1 suite imports :func:`validate_trace` /
 :func:`validate_events` / :func:`validate_bench` / :func:`validate_batch`
 / :func:`validate_request_traces` / :func:`validate_postmortem` /
-:func:`validate_slo` directly (``tests/test_trace_schema.py``), so
+:func:`validate_slo` / :func:`validate_conflicts` directly (``tests/test_trace_schema.py``), so
 trace-format drift fails CI before it reaches a consumer.
 
 Dependency-free on purpose: the schema IS this file plus the runbook
@@ -66,6 +68,7 @@ FAULT_METRIC_LABELS = {
     "merge_faults_total": ("fault", "stage"),
     "subprocess_retries_total": ("method",),
     "subprocess_deadline_kills_total": ("method",),
+    "resolutions_total": ("category", "outcome"),
 }
 
 #: Meta keys every ``service.*`` span must carry (which verb the
@@ -132,7 +135,8 @@ POSTMORTEM_REQUIRED = ("schema", "trace_id", "reason", "ts", "spans",
 
 #: Documented postmortem dump reasons (``obs/flight.py`` REASONS).
 POSTMORTEM_REASONS = ("fault-escape", "degradation", "breaker-transition",
-                      "supervisor-restart", "daemon-drain", "slo-burn")
+                      "supervisor-restart", "daemon-drain", "slo-burn",
+                      "resolver-fault")
 
 #: Required keys of one flight-ring row (``obs/flight.py`` note()).
 FLIGHT_ROW_REQUIRED = ("name", "t", "seconds", "layer", "status", "error",
@@ -158,7 +162,31 @@ BENCH_NUMERIC_OPTIONAL = (
     "breaker_open_latency_ms", "breaker_recovery_s", "steady_rss_mb",
     "trace_overhead_pct", "trace_dark_ms", "trace_on_ms",
     "slo_overhead_pct", "slo_dark_ms", "slo_on_ms",
+    "resolution_rate", "resolve_on_ms", "resolve_off_ms",
+    "gate_recompose_ms", "gate_parity_ms", "gate_typecheck_ms",
+    "gate_format_ms",
 )
+
+#: Versions of the structured ``.semmerge-conflicts.json`` object form.
+#: The legacy bare array (tier never ran) is implicitly version 1.
+CONFLICTS_SCHEMA_VERSIONS = (2,)
+
+#: Required keys of one conflict record (``core/conflict.py``).
+CONFLICT_REQUIRED = ("id", "category", "symbolId", "addressIds",
+                     "opA", "opB", "minimalSlice", "suggestions")
+
+#: Terminal statuses of one resolution audit record
+#: (``resolve/engine.py``).
+RESOLUTION_STATUSES = ("accepted", "rejected")
+
+#: Required keys of one resolution audit record.
+RESOLUTION_REQUIRED = ("conflict_id", "category", "resolver", "status",
+                       "cause", "candidate", "candidates", "scores",
+                       "gates")
+
+#: Verify gates of the resolution tier, in documented run order
+#: (``resolve/engine.py`` GATES).
+RESOLUTION_GATES = ("recompose", "parity", "typecheck", "format")
 
 #: Label keys of the SLO-engine metric series (``obs/slo.py``). The
 #: burn gauge carries exactly (objective, window) with window in
@@ -723,6 +751,107 @@ def validate_postmortem(data: Any) -> List[str]:
     return errors
 
 
+def _validate_conflict_rows(rows: Any, where: str) -> List[str]:
+    errors: List[str] = []
+    if not isinstance(rows, list):
+        return [f"{where}: must be an array"]
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            errors.append(f"{where}[{i}]: must be an object")
+            continue
+        for key in CONFLICT_REQUIRED:
+            if key not in row:
+                errors.append(f"{where}[{i}]: missing key {key!r}")
+        for key in ("id", "category", "symbolId"):
+            if key in row and (not isinstance(row[key], str)
+                               or not row[key]):
+                errors.append(f"{where}[{i}]: {key} must be a non-empty "
+                              f"string")
+        if "suggestions" in row and not isinstance(row["suggestions"], list):
+            errors.append(f"{where}[{i}]: suggestions must be an array")
+    return errors
+
+
+def validate_conflicts(data: Any) -> List[str]:
+    """Validate one ``.semmerge-conflicts.json`` artifact. Two shapes
+    are legal: the legacy bare array of conflict records (implicitly
+    schema version 1 — emitted whenever the resolution tier did not
+    run, byte-identical to the reference), and the versioned object
+    form ``{"schema_version", "conflicts", "resolutions"}`` the tier
+    emits, whose ``resolutions`` audit rows carry a documented status,
+    per-candidate scores, and gate rows in documented order."""
+    if isinstance(data, list):
+        return _validate_conflict_rows(data, "conflicts")
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["conflicts: top level must be an array or object"]
+    if data.get("schema_version") not in CONFLICTS_SCHEMA_VERSIONS:
+        errors.append(f"conflicts: unknown schema_version "
+                      f"{data.get('schema_version')!r}")
+    errors.extend(_validate_conflict_rows(data.get("conflicts"),
+                                          "conflicts.conflicts"))
+    resolutions = data.get("resolutions")
+    if not isinstance(resolutions, list):
+        errors.append("conflicts: resolutions must be an array")
+        resolutions = []
+    for i, row in enumerate(resolutions):
+        where = f"conflicts.resolutions[{i}]"
+        if not isinstance(row, dict):
+            errors.append(f"{where}: must be an object")
+            continue
+        for key in RESOLUTION_REQUIRED:
+            if key not in row:
+                errors.append(f"{where}: missing key {key!r}")
+        status = row.get("status")
+        if "status" in row and status not in RESOLUTION_STATUSES:
+            errors.append(f"{where}: status {status!r} not in "
+                          f"{RESOLUTION_STATUSES}")
+        cause = row.get("cause")
+        if status == "accepted" and cause is not None:
+            errors.append(f"{where}: accepted record must carry a null "
+                          f"cause (got {cause!r})")
+        if status == "rejected" and (not isinstance(cause, str)
+                                     or not cause):
+            errors.append(f"{where}: rejected record needs a non-empty "
+                          f"string cause")
+        n = row.get("candidates")
+        if "candidates" in row and (not isinstance(n, int)
+                                    or isinstance(n, bool) or n < 0):
+            errors.append(f"{where}: candidates must be an int >= 0")
+        scores = row.get("scores")
+        if "scores" in row:
+            if not isinstance(scores, dict):
+                errors.append(f"{where}: scores must be an object")
+            else:
+                for cid, v in scores.items():
+                    if not _is_num(v):
+                        errors.append(f"{where}: scores[{cid!r}] must be "
+                                      f"a number")
+        gates = row.get("gates")
+        if not isinstance(gates, list):
+            errors.append(f"{where}: gates must be an array")
+            gates = []
+        order = [g.get("gate") for g in gates if isinstance(g, dict)]
+        if order != [g for g in RESOLUTION_GATES if g in order]:
+            errors.append(f"{where}: gates out of documented order "
+                          f"{RESOLUTION_GATES}")
+        for j, g in enumerate(gates):
+            gw = f"{where}.gates[{j}]"
+            if not isinstance(g, dict):
+                errors.append(f"{gw}: must be an object")
+                continue
+            if g.get("gate") not in RESOLUTION_GATES:
+                errors.append(f"{gw}: gate {g.get('gate')!r} not in "
+                              f"{RESOLUTION_GATES}")
+            if not isinstance(g.get("ok"), bool):
+                errors.append(f"{gw}: ok must be a boolean")
+            if not _is_num(g.get("ms")) or g.get("ms") < 0:
+                errors.append(f"{gw}: ms must be a number >= 0")
+            if "detail" in g and not isinstance(g["detail"], str):
+                errors.append(f"{gw}: detail must be a string")
+    return errors
+
+
 def validate_bench(data: Any) -> List[str]:
     """Validate one BENCH JSON record (``bench.py``'s single output
     line). Required driver fields plus the additive extensions:
@@ -819,6 +948,20 @@ def main(argv: List[str]) -> int:
                 with open(path, encoding="utf-8") as fh:
                     errors.extend(f"{path}: {e}" for e in
                                   validate_postmortem(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
+    if argv and argv[0] == "validate_conflicts":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_conflicts "
+                  "CONFLICTS_JSON [...]", file=sys.stderr)
+            return 2
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_conflicts(json.load(fh)))
             except (OSError, json.JSONDecodeError) as exc:
                 errors.append(f"{path}: unreadable ({exc})")
         return _finish(errors)

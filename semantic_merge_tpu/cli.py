@@ -8,9 +8,17 @@ with the same observable contract:
 - ``semmerge BASE A B [--inplace] [--git]`` — full 3-way semantic merge.
   Exit codes: 0 merged; 1 conflicts (written to
   ``.semmerge-conflicts.json``); 2 type errors (diagnostics on stderr);
-  3 git plumbing failure; 10-16 a contained fault under
-  ``SEMMERGE_STRICT=1`` / ``--no-degrade`` (see ``errors.py`` and the
-  runbook's "Failure modes" table).
+  3 git plumbing failure; 10-17 a contained fault under
+  ``SEMMERGE_STRICT=1`` / ``--no-degrade`` (or, for 17, under
+  ``--resolve require``; see ``errors.py`` and the runbook's "Failure
+  modes" table).
+
+Conflict resolution — when compose yields conflicts and ``--resolve``
+/ ``SEMMERGE_RESOLVE`` is ``auto`` or ``require``, the resolution tier
+(:mod:`semantic_merge_tpu.resolve`) proposes per-category candidates
+and accepts only proposals that pass every verify gate; anything else
+falls back to conflict-as-result, byte-identical to the tier being
+off. Strict mode forces the tier off.
 
 Additions over the reference: ``--backend`` / ``--trace`` / ``--seed``
 flags, config actually loaded (backend + seed + formatter resolved from
@@ -98,6 +106,14 @@ def build_parser() -> argparse.ArgumentParser:
                          help="Fail fast with the fault's documented exit code "
                               "instead of walking the degradation ladder "
                               "(same as SEMMERGE_STRICT=1)")
+    p_merge.add_argument("--resolve", nargs="?", const="auto", default=None,
+                         choices=("off", "auto", "require"),
+                         help="Conflict-resolution tier posture (also "
+                              "SEMMERGE_RESOLVE). auto: resolve when every "
+                              "verify gate passes, fall back to conflict-as-"
+                              "result otherwise; require: a resolver fault "
+                              "exits 17 instead of falling back. Always off "
+                              "under --no-degrade/SEMMERGE_STRICT=1")
     p_merge.add_argument("--resume", action="store_true",
                          help="Complete (or roll back) an interrupted --inplace "
                               "commit in the current directory, then exit")
@@ -664,12 +680,47 @@ def _semantic_attempt(args: argparse.Namespace, config, backend,
         tracer.count("composed_ops", len(composed))
         tracer.count("conflicts", len(conflicts))
 
+        resolutions = None
         if conflicts:
-            _write_conflict_reports(conflicts)
-            return 1
-        # A clean merge must not leave a stale artifact from a previous
-        # conflicted run next to a success exit code.
-        _conflicts_path().unlink(missing_ok=True)
+            from .resolve import posture as resolve_posture
+            # Strict mode forces the tier off: fail-fast runs must not
+            # synthesize output, whatever the posture says.
+            posture = "off" if _strict_mode(args) else resolve_posture(args)
+            resolved = False
+            if posture != "off":
+                from .resolve import engine as resolve_engine
+                outcome = None
+                try:
+                    with tracer.phase("resolve"), fault_boundary("resolve"):
+                        outcome = resolve_engine.resolve_conflicts(
+                            conflicts, list(result.op_log_left),
+                            list(result.op_log_right), composed=composed,
+                            base_tar=base_tar, left_tar=left_tar,
+                            right_tar=right_tar, strict_detect=strict,
+                            config=config)
+                except MergeFault as fault:
+                    if posture == "require":
+                        # Tier availability IS the require contract: the
+                        # conflicts are still computed results, so the
+                        # artifact is written before the fault exit.
+                        _write_conflict_reports(conflicts)
+                        return _fail_fast(fault)
+                    resolve_engine.record_resolver_fault(fault)
+                if outcome is not None:
+                    resolutions = outcome.records
+                    if outcome.accepted:
+                        composed = outcome.composed
+                        resolved = True
+            # The artifact always carries the audit trail when the tier
+            # ran — rejected proposals on the conflict exit, accepted
+            # ones next to the success exit (the merged tree's evidence).
+            _write_conflict_reports(conflicts, resolutions)
+            if not resolved:
+                return 1
+        else:
+            # A clean merge must not leave a stale artifact from a
+            # previous conflicted run next to a success exit code.
+            _conflicts_path().unlink(missing_ok=True)
 
         with tracer.phase("materialize"), fault_boundary("apply"):
             from .runtime.git import temp_tree
@@ -692,7 +743,7 @@ def _semantic_attempt(args: argparse.Namespace, config, backend,
                                                    None))
                 tracer.count("text_conflicts", len(text_conflicts))
                 if text_conflicts:
-                    _write_conflict_reports(text_conflicts)
+                    _write_conflict_reports(text_conflicts, resolutions)
                     return 1
         with tracer.phase("format"), fault_boundary("format"):
             formatter = None
@@ -1292,8 +1343,10 @@ def cmd_train_matcher(args: argparse.Namespace) -> int:
     return 0
 
 
-def _write_conflict_reports(conflicts: Sequence[object]) -> None:
-    payload = [c.to_dict() if hasattr(c, "to_dict") else c for c in conflicts]
+def _write_conflict_reports(conflicts: Sequence[object],
+                            resolutions: Sequence[dict] | None = None) -> None:
+    from .core.conflict import conflicts_payload
+    payload = conflicts_payload(conflicts, resolutions)
     _conflicts_path().write_text(json.dumps(payload, indent=2),
                                  encoding="utf-8")
 

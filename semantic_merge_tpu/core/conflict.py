@@ -9,9 +9,34 @@ slice, and keepA/keepB suggestions.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional, Sequence
 
 from .ops import Op
+
+#: Version of the structured ``.semmerge-conflicts.json`` shape (the
+#: object form carrying a ``resolutions`` audit block). The legacy bare
+#: array — emitted whenever the resolution tier did not run — is
+#: implicitly version 1 and stays byte-identical to the reference.
+CONFLICTS_SCHEMA_VERSION = 2
+
+
+def conflicts_payload(conflicts: Sequence,
+                      resolutions: Optional[Sequence[dict]] = None):
+    """The JSON payload of ``.semmerge-conflicts.json``.
+
+    ``resolutions=None`` (the tier never ran) keeps the legacy bare
+    array — reference parity and byte-identity with every pre-tier
+    artifact. When the tier ran, the payload upgrades to the versioned
+    object form with the full audit trail, rejected proposals
+    included."""
+    rows = [c.to_dict() if hasattr(c, "to_dict") else c for c in conflicts]
+    if resolutions is None:
+        return rows
+    return {
+        "schema_version": CONFLICTS_SCHEMA_VERSION,
+        "conflicts": rows,
+        "resolutions": list(resolutions),
+    }
 
 
 @dataclass

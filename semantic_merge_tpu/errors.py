@@ -28,11 +28,14 @@ code  meaning
 14    ``FormatFault`` — formatter failure escalated by fault injection
 15    ``DeadlineFault`` — a per-request deadline expired
 16    ``BatchFault`` — batched dispatch failed or posture unsatisfiable
+17    ``ResolveFault`` — conflict-resolution tier failed under
+      ``--resolve require``
 ====  =============================================================
 
-Codes 10-16 are only ever *exit* codes in strict mode or when the
-textual rung itself fails; in the default posture they name the fault
-that triggered a ladder rung (the ``fault`` label of the
+Codes 10-17 are only ever *exit* codes in strict mode (or, for
+``ResolveFault``, under the ``require`` resolution posture) or when
+the textual rung itself fails; in the default posture they name the
+fault that triggered a ladder rung (the ``fault`` label of the
 ``merge_degradations_total`` metric and ``degradation`` span).
 """
 from __future__ import annotations
@@ -124,6 +127,16 @@ class BatchFault(MergeFault):
     default_stage = "batch"
 
 
+class ResolveFault(MergeFault):
+    """Conflict-resolution tier failure (``resolve/``). Under posture
+    ``auto`` the CLI contains it — conflict-as-result, byte-identical
+    to the tier being off — so this only ever *exits* under
+    ``--resolve require``, where tier availability is the contract."""
+
+    exit_code = 17
+    default_stage = "resolve"
+
+
 #: Fault class each pipeline stage wraps *unexpected* exceptions into.
 STAGE_FAULTS = {
     "snapshot": ParseFault,
@@ -147,6 +160,12 @@ STAGE_FAULTS = {
     "batch:pack": BatchFault,
     "batch:dispatch": BatchFault,
     "batch:scatter": BatchFault,
+    # Conflict-resolution tier (resolve/): propose/verify classify as
+    # ResolveFault so the CLI's containment (auto → conflict-as-result,
+    # require → exit 17) sees one fault type for the whole tier.
+    "resolve": ResolveFault,
+    "resolver:propose": ResolveFault,
+    "resolver:verify": ResolveFault,
     "materialize": ApplyFault,
     "apply": ApplyFault,
     "commit": ApplyFault,
@@ -158,7 +177,7 @@ STAGE_FAULTS = {
 #: The documented fault exit codes, by class name (runbook table).
 EXIT_CODES = {cls.__name__: cls.exit_code for cls in
               (ParseFault, KernelFault, WorkerFault, ApplyFault,
-               FormatFault, DeadlineFault, BatchFault)}
+               FormatFault, DeadlineFault, BatchFault, ResolveFault)}
 
 
 def fault_for_stage(stage: str) -> type:

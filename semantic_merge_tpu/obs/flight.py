@@ -54,7 +54,8 @@ POSTMORTEM_DIR = ".semmerge-postmortem"
 POSTMORTEM_SCHEMA = 1
 #: Documented ``reason`` values a bundle may carry.
 REASONS = ("fault-escape", "degradation", "breaker-transition",
-           "supervisor-restart", "daemon-drain", "slo-burn")
+           "supervisor-restart", "daemon-drain", "slo-burn",
+           "resolver-fault")
 
 _lock = threading.Lock()
 _ring: Optional[deque] = None

@@ -325,6 +325,23 @@ def encode_compose_inputs(delta_a: List[Op], delta_b: List[Op],
     return interner, ta, tb, na, nb
 
 
+def recompose_resolved(delta_a: List[Op], delta_b: List[Op],
+                       ) -> Tuple[List[Op], List[Conflict]]:
+    """Re-compose entry for the conflict-resolution tier
+    (:mod:`semantic_merge_tpu.resolve.engine`): compose the two
+    *rewritten* op streams after a resolution dropped/replaced the
+    conflicting ops. Delegates to the host oracle — the streams at this
+    point are plain object lists (the resolver works on materialized
+    ops), re-encoding them for one small device pass would cost more
+    than the compose, and the host composer is the semantics the verify
+    gates pin against."""
+    from ..core.compose import compose_oplogs
+    from ..obs import spans as obs_spans
+    with obs_spans.span("recompose_resolved", layer="ops",
+                        n_a=len(delta_a), n_b=len(delta_b)):
+        return compose_oplogs(list(delta_a), list(delta_b))
+
+
 def compose_oplogs_device(delta_a: List[Op], delta_b: List[Op]) -> Tuple[List[Op], List[Conflict]]:
     """Device-composed twin of :func:`core.compose.compose_oplogs`."""
     from ..obs import spans as obs_spans

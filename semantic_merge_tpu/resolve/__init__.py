@@ -1,0 +1,46 @@
+"""Conflict-resolution tier — the rung between "compose found
+conflicts" and "exit 1".
+
+Today every composed conflict is a terminal result. This package turns
+it into an *attempt*: a pluggable :class:`~semantic_merge_tpu.resolve.
+base.Resolver` proposes per-conflict candidate resolutions (the
+deterministic search-based baseline ships first — DeepMerge
+arXiv:2105.07569 and the search-vs-LLM study arXiv:2605.16646 show the
+classes we emit are largely recoverable by exactly this shape of
+search), and every accepted resolution must pass the verify gates in
+:mod:`semantic_merge_tpu.resolve.engine` — re-compose cleanly, byte
+parity of untouched regions, typecheck, format. Any gate failure,
+scoring tie, or resolver fault falls back to conflict-as-result,
+bitwise identical to the tier being off.
+
+Posture (``--resolve`` / ``SEMMERGE_RESOLVE``, read through the
+request overlay so daemon/batch requests carry their client's
+posture):
+
+- ``off`` (default) — the tier never runs; artifacts, exit codes and
+  trees are byte-identical to pre-tier behavior.
+- ``auto`` — resolve when possible; a resolver fault is contained
+  (postmortem + conflict-as-result), never an exit-code change.
+- ``require`` — the tier must be available; a resolver fault exits
+  with :class:`~semantic_merge_tpu.errors.ResolveFault`'s documented
+  code (17). A run that resolves nothing still exits 1 — ``require``
+  governs the tier's availability, not the outcome.
+
+Strict mode (``--no-degrade`` / ``SEMMERGE_STRICT=1``) forces the tier
+off regardless of posture: fail-fast runs must not synthesize output.
+"""
+from __future__ import annotations
+
+#: Accepted ``SEMMERGE_RESOLVE`` / ``--resolve`` values.
+POSTURES = ("off", "auto", "require")
+
+
+def posture(args=None) -> str:
+    """The effective resolution posture: the ``--resolve`` flag wins,
+    then ``SEMMERGE_RESOLVE`` via the request overlay; anything absent
+    or unrecognized is ``off``. Strict-mode suppression is the CLI's
+    call (it owns ``_strict_mode``)."""
+    from ..utils import reqenv
+    flag = getattr(args, "resolve", None) if args is not None else None
+    raw = (flag or reqenv.get("SEMMERGE_RESOLVE", "") or "").strip().lower()
+    return raw if raw in POSTURES else "off"

@@ -1,0 +1,310 @@
+"""The resolution engine: propose → verify → accept, with every
+escape hatch wired into the existing robustness machinery.
+
+Flow per merge (called from the CLI when compose yields conflicts and
+the posture enables the tier):
+
+1. **Propose** — one ``resolution.propose`` span per conflict; the
+   per-category circuit breaker (rung ``resolve:<Category>``, reusing
+   :mod:`semantic_merge_tpu.service.resilience`) gates the attempt, and
+   the ``resolver:propose`` fault-injection stage fires inside the
+   span. A unique top-scoring candidate wins; a tie or an empty
+   proposal list marks the conflict unresolvable.
+2. **Verify** — all-or-nothing: resolution is only attempted when
+   *every* conflict has a winner, because the merged tree either
+   replaces the conflict exit entirely or not at all (a half-resolved
+   tree would be a third output shape nothing downstream expects).
+   The gates run in documented order — ``recompose`` (the rewritten
+   streams re-compose with zero residual conflicts), ``parity`` (the
+   resolved tree is byte-identical to the conflict-free portion of the
+   merge everywhere outside the resolution's footprint), ``typecheck``
+   (``runtime/verify.py``; vacuous without the toolchain, exactly like
+   the main pipeline), ``format`` (the footprint formats cleanly).
+   Any gate failure rejects the whole proposal set.
+3. **Accept / fall back** — acceptance hands the re-composed stream
+   back to the CLI, which materializes it through the normal pipeline;
+   every other outcome falls back to conflict-as-result. All outcomes
+   land in ``resolutions_total{category,outcome}`` and the artifact's
+   ``resolutions`` audit block.
+
+A resolver *fault* (injected or real) escapes as
+:class:`~semantic_merge_tpu.errors.ResolveFault` after recording the
+breaker failure; the CLI contains it under posture ``auto``
+(postmortem + conflict-as-result) and exits 17 under ``require``.
+"""
+from __future__ import annotations
+
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.ops import Op
+from ..errors import MergeFault, fault_boundary
+from ..obs import flight as obs_flight
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from ..service.resilience import breakers
+from ..utils import faults
+from .base import Candidate, ResolveContext, Resolver
+from .search import SearchResolver
+
+#: Verify gates, in the order they run (documented in runbook.md).
+GATES = ("recompose", "parity", "typecheck", "format")
+
+#: Documented ``outcome`` label values of ``resolutions_total``.
+OUTCOMES = ("accepted", "rejected", "no-candidates", "tie",
+            "breaker-open", "fault")
+
+_METRIC_HELP = "Conflict-resolution proposals, by category and outcome"
+
+
+@dataclass
+class ResolutionOutcome:
+    """What the tier produced: the audit records always; a re-composed
+    op stream only when every gate passed."""
+
+    accepted: bool
+    composed: Optional[List[Op]]
+    records: List[dict] = field(default_factory=list)
+
+
+def _count(category: str, outcome: str) -> None:
+    obs_metrics.REGISTRY.counter("resolutions_total", _METRIC_HELP).inc(
+        1, category=category, outcome=outcome)
+
+
+def record_resolver_fault(fault: MergeFault) -> None:
+    """Containment bookkeeping for a resolver fault the CLI absorbed
+    (posture ``auto``): the metric plus a flight-recorder postmortem —
+    the tier degraded, and that must leave evidence."""
+    from ..utils import workdir
+    _count("all", "fault")
+    obs_flight.dump(
+        obs_spans.trace_id() or obs_flight.default_trace_id(),
+        "resolver-fault", fault=fault, breakers=breakers().snapshot(),
+        root=workdir.root())
+
+
+def resolve_conflicts(conflicts: Sequence, log_a: List[Op], log_b: List[Op],
+                      *, composed, base_tar: bytes, left_tar: bytes,
+                      right_tar: bytes, strict_detect: bool, config,
+                      resolver: Optional[Resolver] = None,
+                      ) -> ResolutionOutcome:
+    """Attempt to resolve ``conflicts`` by rewriting the raw op streams
+    and re-running the pipeline's own compose/apply machinery. Never
+    mutates its inputs; the only success path returns a freshly
+    composed stream that passed every gate."""
+    resolver = resolver or SearchResolver()
+    ctx = ResolveContext(log_a, log_b, base_tar=base_tar,
+                         left_tar=left_tar, right_tar=right_tar)
+    board = breakers()
+
+    records: List[dict] = []
+    winners: List[Tuple[dict, Candidate]] = []
+    for conflict in conflicts:
+        cd = conflict.to_dict() if hasattr(conflict, "to_dict") else dict(conflict)
+        category = str(cd.get("category", "unknown"))
+        rung = f"resolve:{category}"
+        rec = {
+            "conflict_id": cd.get("id"),
+            "category": category,
+            "resolver": resolver.name,
+            "status": "rejected",
+            "cause": None,
+            "candidate": None,
+            "candidates": 0,
+            "scores": {},
+            "gates": [],
+        }
+        records.append(rec)
+        if not board.allow(rung):
+            rec["cause"] = "breaker-open"
+            _count(category, "breaker-open")
+            continue
+        try:
+            with obs_spans.span("resolution.propose", layer="resolve",
+                                category=category), \
+                    fault_boundary("resolver:propose"):
+                faults.check("resolver:propose")
+                cands = list(resolver.propose(cd, ctx))
+        except MergeFault:
+            board.record_failure(rung)
+            raise
+        rec["candidates"] = len(cands)
+        rec["scores"] = {c.id: c.score for c in cands}
+        if not cands:
+            rec["cause"] = "no-candidates"
+            _count(category, "no-candidates")
+            continue
+        best = max(c.score for c in cands)
+        top = [c for c in cands if c.score == best]
+        if len(top) > 1 or best <= 0:
+            # Equal evidence (or none): choosing would be a guess, and
+            # guessing is the one thing this tier must never do.
+            rec["cause"] = "tie"
+            _count(category, "tie")
+            continue
+        rec["candidate"] = top[0].audit()
+        winners.append((rec, top[0]))
+
+    if len(winners) < len(records):
+        # All-or-nothing: a partially resolved merge is still a
+        # conflicted merge, so conflicts that DID find a winner are
+        # rejected alongside their unresolved peers.
+        for rec, _ in winners:
+            rec["cause"] = "peer-unresolved"
+            _count(rec["category"], "rejected")
+        return ResolutionOutcome(False, None, records)
+
+    gates: List[dict] = []
+    for rec in records:
+        rec["gates"] = gates  # one shared verify run covers the set
+    try:
+        with obs_spans.span("resolution.verify", layer="resolve",
+                            n=len(winners)), \
+                fault_boundary("resolver:verify"):
+            faults.check("resolver:verify")
+            composed2, failed = _verify(
+                [c for _, c in winners], log_a, log_b, gates,
+                composed=composed, base_tar=base_tar,
+                strict_detect=strict_detect, config=config)
+    except MergeFault:
+        for rec, _ in winners:
+            board.record_failure(f"resolve:{rec['category']}")
+        raise
+    if failed is not None:
+        for rec, _ in winners:
+            rec["cause"] = f"gate:{failed}"
+            _count(rec["category"], "rejected")
+            board.record_failure(f"resolve:{rec['category']}")
+        return ResolutionOutcome(False, None, records)
+
+    for rec, _ in winners:
+        rec["status"] = "accepted"
+        _count(rec["category"], "accepted")
+        board.record_success(f"resolve:{rec['category']}")
+    obs_spans.record("resolution.accept", 0.0, layer="resolve",
+                     n=len(winners))
+    return ResolutionOutcome(True, composed2, records)
+
+
+def _gate_row(name: str, ok: bool, t0: float,
+              detail: Optional[str] = None) -> dict:
+    row = {"gate": name, "ok": ok,
+           "ms": round((time.perf_counter() - t0) * 1000.0, 3)}
+    if detail:
+        row["detail"] = detail
+    return row
+
+
+def _verify(cands: List[Candidate], log_a: List[Op], log_b: List[Op],
+            gates: List[dict], *, composed, base_tar: bytes,
+            strict_detect: bool, config,
+            ) -> Tuple[Optional[List[Op]], Optional[str]]:
+    """Run the gate ladder over the united candidate set. Returns
+    ``(composed_stream, None)`` on full success or ``(None,
+    failed_gate_name)``; each gate appends its audit row either way.
+    Gate *failures* are legitimate rejections handled here; only
+    unexpected exceptions escape to the caller's fault boundary."""
+    from ..core.strict_conflicts import detect_conflicts_strict
+    from ..ops.compose import recompose_resolved
+    from ..runtime.applier import apply_ops, touched_paths
+    from ..runtime.emitter import emit_files
+    from ..runtime.git import temp_tree
+    from ..runtime.verify import typecheck_ts, untouched_parity
+
+    # -- gate: recompose ----------------------------------------------------
+    t0 = time.perf_counter()
+    drops: set = set()
+    replaces: Dict[str, Op] = {}
+    for cand in cands:
+        drops.update(cand.drops)
+        for op_id, op in cand.replaces.items():
+            if op_id in replaces and replaces[op_id].to_dict() != op.to_dict():
+                gates.append(_gate_row("recompose", False, t0,
+                                       "candidate-overlap"))
+                return None, "recompose"
+            replaces[op_id] = op
+    if drops & set(replaces):
+        gates.append(_gate_row("recompose", False, t0, "candidate-overlap"))
+        return None, "recompose"
+    ta = [replaces.get(op.id, op) for op in log_a if op.id not in drops]
+    tb = [replaces.get(op.id, op) for op in log_b if op.id not in drops]
+    if strict_detect:
+        ka, kb, residual = detect_conflicts_strict(ta, tb)
+        composed2, walk = recompose_resolved(ka, kb)
+        residual = list(residual) + list(walk)
+    else:
+        composed2, residual = recompose_resolved(ta, tb)
+    if residual:
+        gates.append(_gate_row(
+            "recompose", False, t0,
+            f"{len(residual)} residual conflict(s) after rewrite"))
+        return None, "recompose"
+    gates.append(_gate_row("recompose", True, t0))
+
+    # -- gate: parity -------------------------------------------------------
+    # The resolution's footprint is every file an op that *changed*
+    # between the conflict-free stream and the resolved stream can
+    # write (chain propagation may rewrite params of a surviving op,
+    # so compare materialized records, not just ids). Outside that
+    # footprint the two applied trees must match byte for byte.
+    t0 = time.perf_counter()
+    orig = {op.id: op.to_dict() for op in composed}
+    new = {op.id: op.to_dict() for op in composed2}
+    changed = [oid for oid in set(orig) | set(new)
+               if orig.get(oid) != new.get(oid)]
+    footprint: set = set()
+    for oid in changed:
+        for stream, table in ((composed, orig), (composed2, new)):
+            if oid in table:
+                src = next(op for op in stream if op.id == oid)
+                footprint |= touched_paths([src])
+    tree_orig = tree_new = None
+    try:
+        with temp_tree(base_tar) as base_tree:
+            tree_orig = apply_ops(base_tree, list(composed))
+        with temp_tree(base_tar) as base_tree:
+            tree_new = apply_ops(base_tree, composed2)
+        mismatches = untouched_parity(tree_orig, tree_new,
+                                      exclude=footprint)
+        if mismatches:
+            gates.append(_gate_row(
+                "parity", False, t0,
+                "outside-footprint drift: " + ", ".join(mismatches[:5])))
+            return None, "parity"
+        gates.append(_gate_row("parity", True, t0))
+
+        # -- gate: typecheck ------------------------------------------------
+        t0 = time.perf_counter()
+        if getattr(getattr(config, "ci", None), "require_typecheck", False):
+            ok, diagnostics = typecheck_ts(tree_new)
+            if not ok:
+                gates.append(_gate_row(
+                    "typecheck", False, t0,
+                    "; ".join(diagnostics[:3]) or "type errors"))
+                return None, "typecheck"
+        gates.append(_gate_row("typecheck", True, t0))
+
+        # -- gate: format ---------------------------------------------------
+        t0 = time.perf_counter()
+        formatter = None
+        languages = getattr(config, "languages", None) or {}
+        ts_cfg = languages.get("typescript") if hasattr(languages, "get") \
+            else None
+        if ts_cfg is not None and getattr(ts_cfg, "formatter_cmd", None):
+            formatter = list(ts_cfg.formatter_cmd)
+        try:
+            emit_files(tree_new, formatter, paths=sorted(footprint))
+        except Exception as exc:  # formatter blew past its own guards
+            gates.append(_gate_row("format", False, t0,
+                                   f"{type(exc).__name__}: {exc}"))
+            return None, "format"
+        gates.append(_gate_row("format", True, t0))
+    finally:
+        for tree in (tree_orig, tree_new):
+            if tree is not None:
+                shutil.rmtree(tree, ignore_errors=True)
+
+    return composed2, None

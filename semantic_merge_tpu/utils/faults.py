@@ -56,6 +56,17 @@ batch:dispatch       ``batch.dispatcher.collect_request`` (await row)
 batch:scatter        ``batch.dispatcher.collect_request`` (row fetch)
 ===================  ==================================================
 
+Conflict-resolution stages (``semantic_merge_tpu/resolve/engine.py``)
+parse the same way. Both land on conflict-as-result under posture
+``auto`` and on exit 17 under ``require``:
+
+===================  ==================================================
+stage                call site
+===================  ==================================================
+resolver:propose     ``resolve.engine`` inside the propose span
+resolver:verify      ``resolve.engine`` before the gate ladder
+===================  ==================================================
+
 Inside the daemon the injection spec and the per-stage hit counters are
 read through the request overlay (:mod:`semantic_merge_tpu.utils.
 reqenv`): each request carries its client's ``SEMMERGE_FAULT`` and gets
@@ -93,7 +104,7 @@ ENV_VAR = "SEMMERGE_FAULT"
 #: Stage-name prefixes that contain a colon themselves (the service
 #: daemon's and batching subsystem's stages) — the parser joins the
 #: first two segments for these.
-COMPOUND_STAGE_PREFIXES = ("service", "batch")
+COMPOUND_STAGE_PREFIXES = ("service", "batch", "resolver")
 
 _counters: Dict[str, int] = {}
 

@@ -13,6 +13,7 @@ overlay.
 """
 import contextlib
 import hashlib
+import json
 import os
 import pathlib
 import subprocess
@@ -342,6 +343,108 @@ def test_wire_postures_honored_inside_daemon(tmp_path, daemon_factory):
         "off posture must bypass the scheduler inside the daemon"
     assert status["batch"]["requests_batched"] == batched_after_require, \
         "off posture must never enqueue into the scheduler"
+
+
+def _make_resolve_repo(root: pathlib.Path) -> pathlib.Path:
+    """DivergentRename with asymmetric evidence (brA rewrote the call
+    site): the search resolver accepts ``keepA`` and the merge exits 0.
+    Commit dates are pinned so two builds are sha-identical and their
+    conflicts artifacts compare equal."""
+    root.mkdir()
+    _git(["init", "-q", "-b", "main"], root)
+    _git(["config", "user.email", "t@example.com"], root)
+    _git(["config", "user.name", "t"], root)
+    env = dict(os.environ,
+               GIT_AUTHOR_DATE="2024-01-01T00:00:00Z",
+               GIT_COMMITTER_DATE="2024-01-01T00:00:00Z")
+
+    def commit(msg):
+        subprocess.run(["git", "add", "-A"], cwd=root, check=True,
+                       stdout=subprocess.DEVNULL)
+        subprocess.run(["git", "commit", "-q", "-m", msg], cwd=root,
+                       check=True, env=env, stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+
+    (root / "src").mkdir()
+    (root / "src/util.ts").write_text(
+        "export function foo(n: number): number {\n  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        "  return foo(s.length);\n}\n")
+    commit("base")
+    _git(["branch", "basebr"], root)
+    _git(["checkout", "-qb", "brA"], root)
+    (root / "src/util.ts").write_text(
+        "export function bar(n: number): number {\n  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        "  return bar(s.length);\n}\n")
+    commit("rename foo->bar")
+    _git(["checkout", "-q", "main"], root)
+    _git(["checkout", "-qb", "brB"], root)
+    (root / "src/util.ts").write_text(
+        "export function baz(n: number): number {\n  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        "  return foo(s.length);\n}\n")
+    commit("rename foo->baz decl-only")
+    _git(["checkout", "-q", "main"], root)
+    return root
+
+
+def _normalized_artifact(path: pathlib.Path):
+    """Conflicts artifact with per-gate wall-clock stripped — gate
+    timings are the only nondeterministic field in the audit trail."""
+    payload = json.loads(path.read_text())
+    if isinstance(payload, dict):
+        for rec in payload.get("resolutions", []):
+            for gate in rec.get("gates", []):
+                gate.pop("ms", None)
+    return payload
+
+
+def test_wire_resolve_parity_on_batched_path(tmp_path, daemon_factory):
+    """``SEMMERGE_RESOLVE`` rides the request env overlay onto the
+    BATCHED daemon path: the same conflict repo merged one-shot
+    (unbatched) and through a batch-require daemon yields byte-identical
+    trees and audited conflicts artifacts, and the daemon's batch stats
+    prove the request actually took the batched dispatch."""
+    from semantic_merge_tpu.service import client as service_client
+    sock = str(tmp_path / "resolve.sock")
+    daemon_factory(sock, extra_env={
+        "SEMMERGE_MESH": "off",
+        "SEMMERGE_BATCH_WINDOW_MS": "5",
+    })
+    one = _make_resolve_repo(tmp_path / "oneshot")
+    two = _make_resolve_repo(tmp_path / "batched")
+    argv = [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+            "basebr", "brA", "brB", "--inplace", "--backend", "tpu"]
+
+    env_one = dict(os.environ)
+    env_one.update({"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+                    "SEMMERGE_DAEMON": "off", "SEMMERGE_MESH": "off",
+                    "SEMMERGE_RESOLVE": "auto"})
+    env_one.pop("SEMMERGE_FAULT", None)
+    proc = subprocess.run(argv, cwd=one, capture_output=True, text=True,
+                          env=env_one)
+    assert proc.returncode == 0, f"one-shot resolve failed: {proc.stderr}"
+
+    proc = subprocess.run(argv, cwd=two, capture_output=True, text=True,
+                          env=_wire_env(sock, SEMMERGE_BATCH="require",
+                                        SEMMERGE_RESOLVE="auto"))
+    assert proc.returncode == 0, \
+        f"batched resolve over the wire failed: {proc.stderr}"
+
+    want = (one / "src/util.ts").read_text()
+    assert "bar(s.length)" in want and "baz" not in want
+    assert (two / "src/util.ts").read_text() == want, \
+        "batched and one-shot resolver runs must produce identical trees"
+    pay_one = _normalized_artifact(one / ".semmerge-conflicts.json")
+    pay_two = _normalized_artifact(two / ".semmerge-conflicts.json")
+    assert pay_one == pay_two
+    assert pay_one["schema_version"] == 2
+    assert {r["status"] for r in pay_one["resolutions"]} == {"accepted"}
+
+    status = service_client.call_control("status", path=sock)
+    assert status["batch"]["requests_batched"] >= 1, \
+        "require posture must land the resolver merge on the batched path"
 
 
 # ---------------------------------------------------------------------------

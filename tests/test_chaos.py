@@ -1,9 +1,9 @@
 """Chaos/soak coverage for the supervised service (ISSUE 9 tentpole).
 
 Tier-1 runs the smoke: 200 mixed merges (clean / fault-degrade /
-strict-typed) from 8 concurrent workers against a ``semmerge serve
---supervise`` daemon, with 2 randomized SIGKILLs of the daemon child
-mid-soak. The harness (``scripts/chaos_soak.py``) asserts the full
+strict-typed / resolver-enabled conflict merges) from 8 concurrent
+workers against a ``semmerge serve --supervise`` daemon, with 2
+randomized SIGKILLs of the daemon child mid-soak. The harness (``scripts/chaos_soak.py``) asserts the full
 invariant set — byte-exact settled trees with no journal/lock debris,
 documented exit codes only, supervisor respawns observable, RSS under
 the hard watermark — and returns a report; the test checks the report
@@ -41,7 +41,16 @@ def _check_report(report, *, requests, kills):
                 for per_code in report["outcomes"].values())
     assert total == requests
     assert set(report["outcomes"]) == {
-        "clean", "degrade-scan", "degrade-apply", "strict-scan"}
+        "clean", "degrade-scan", "degrade-apply", "strict-scan",
+        "resolve"}
+    # Resolver-enabled traffic stayed on documented outcomes: exit 0
+    # (resolver's verified suggestion applied) or exit 1 (textual-rung
+    # conflict-as-result while the host breaker was open) — and the
+    # surviving daemon recorded accepted resolutions, at minimum from
+    # the resolver-settled conflict repos.
+    assert set(report["outcomes"]["resolve"]) <= {"0", "1"}
+    assert report["resolutions_total"] is not None
+    assert report["resolutions_total"] >= 1
     # The kill schedule landed and self-healing was observable: a new
     # daemon pid appeared and the supervisor counted its respawns.
     assert report["kills"] == kills

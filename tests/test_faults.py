@@ -223,7 +223,7 @@ def test_exit_codes_documented_and_distinct():
     assert EXIT_CODES == {"ParseFault": 10, "KernelFault": 11,
                           "WorkerFault": 12, "ApplyFault": 13,
                           "FormatFault": 14, "DeadlineFault": 15,
-                          "BatchFault": 16}
+                          "BatchFault": 16, "ResolveFault": 17}
     assert len(set(EXIT_CODES.values())) == len(EXIT_CODES)
     # Reserved result codes stay distinct from fault codes.
     assert not {0, 1, 2, 3} & set(EXIT_CODES.values())
@@ -370,6 +370,111 @@ def test_batch_stage_fault_strict_require_exits_16(repo, monkeypatch, stage):
         batch.deactivate()
     assert rc == BatchFault.exit_code
     assert tree_state(repo) == before
+
+
+# ---------------------------------------------------------------------------
+# Resolver stages: injected faults degrade to conflict-as-result
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def conflict_repo(tmp_path, monkeypatch):
+    """A repo whose semantic merge raises a DivergentRename conflict
+    (both branches rename ``foo``, to different names), with asymmetric
+    reference evidence so the search resolver has a unique winner."""
+    root = tmp_path / "crepo"
+    root.mkdir()
+    git(["init", "-q", "-b", "main"], root)
+    git(["config", "user.email", "t@example.com"], root)
+    git(["config", "user.name", "t"], root)
+    monkeypatch.chdir(root)
+    (root / "src").mkdir()
+    (root / "src/util.ts").write_text(
+        "export function foo(n: number): number {\n  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        "  return foo(s.length);\n}\n")
+    commit_all(root, "base")
+    git(["branch", "basebr"], root)
+    git(["checkout", "-qb", "brA"], root)
+    (root / "src/util.ts").write_text(
+        "export function bar(n: number): number {\n  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        "  return bar(s.length);\n}\n")
+    commit_all(root, "rename foo->bar + rewrite caller")
+    git(["checkout", "-q", "main"], root)
+    git(["checkout", "-qb", "brB"], root)
+    (root / "src/util.ts").write_text(
+        "export function baz(n: number): number {\n  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        "  return foo(s.length);\n}\n")
+    commit_all(root, "rename foo->baz, decl only")
+    git(["checkout", "-q", "main"], root)
+    faults.reset()
+    yield root
+    faults.reset()
+
+
+RESOLVER_FAULT_STAGES = ["resolver:propose", "resolver:verify"]
+
+
+@pytest.mark.parametrize("stage", RESOLVER_FAULT_STAGES)
+def test_resolver_stage_fault_falls_back_byte_exact(conflict_repo,
+                                                    monkeypatch, stage):
+    """Posture ``auto`` + injected resolver fault: the merge degrades
+    to conflict-as-result — exit 1, work tree and conflicts artifact
+    byte-exact against a resolver-OFF run — and leaves a postmortem
+    bundle with reason ``resolver-fault``. Never a crash."""
+    artifact = conflict_repo / ".semmerge-conflicts.json"
+    monkeypatch.setenv("SEMMERGE_RESOLVE", "off")
+    rc = run_merge_cli()
+    assert rc == 1, "the fixture must raise a real conflict"
+    baseline_tree = tree_state(conflict_repo)
+    baseline_artifact = artifact.read_bytes()
+    assert isinstance(json.loads(baseline_artifact), list), \
+        "resolver-off artifact keeps the legacy bare-array shape"
+    faults.reset()
+    monkeypatch.setenv("SEMMERGE_RESOLVE", "auto")
+    monkeypatch.setenv("SEMMERGE_FAULT", f"{stage}:fault")
+    rc = run_merge_cli()
+    assert rc == 1, f"{stage}:fault under auto must fall back to exit 1"
+    assert tree_state(conflict_repo) == baseline_tree, \
+        "the fallback work tree must be byte-exact vs resolver-off"
+    assert artifact.read_bytes() == baseline_artifact, \
+        "the fallback artifact must be byte-exact vs resolver-off"
+    bundles = list((conflict_repo / ".semmerge-postmortem").glob("*.json"))
+    assert any(json.loads(b.read_text()).get("reason") == "resolver-fault"
+               for b in bundles), \
+        "the absorbed resolver fault must leave a postmortem bundle"
+
+
+@pytest.mark.parametrize("stage", RESOLVER_FAULT_STAGES)
+def test_resolver_stage_fault_require_exits_17(conflict_repo, monkeypatch,
+                                               stage):
+    """``--resolve require``: the injected resolver fault is fatal with
+    the documented exit code and an untouched work tree."""
+    from semantic_merge_tpu.errors import ResolveFault
+    before = tree_state(conflict_repo)
+    monkeypatch.setenv("SEMMERGE_FAULT", f"{stage}:fault")
+    rc = run_merge_cli("--resolve", "require")
+    assert rc == ResolveFault.exit_code
+    assert tree_state(conflict_repo) == before
+
+
+def test_resolver_stages_registered_as_resolve_faults():
+    from semantic_merge_tpu.errors import STAGE_FAULTS, ResolveFault
+    assert ResolveFault.exit_code == 17
+    for stage in ("resolve", "resolver:propose", "resolver:verify"):
+        assert STAGE_FAULTS[stage] is ResolveFault
+    # The compound stage survives SEMMERGE_FAULT's colon syntax.
+    faults.reset()
+    try:
+        os.environ["SEMMERGE_FAULT"] = "resolver:propose:fault"
+        with pytest.raises(ResolveFault) as exc_info:
+            faults.check("resolver:propose")
+        assert exc_info.value.stage == "resolver:propose"
+        assert exc_info.value.cause == "injected"
+    finally:
+        os.environ.pop("SEMMERGE_FAULT", None)
+        faults.reset()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,440 @@
+"""Conflict-resolution tier (ISSUE 12 tentpole).
+
+Per-category golden resolutions through the real CLI (the accepted
+merge is byte-materialized through the normal pipeline and every verify
+gate runs), plus the fallback ladder: gate rejection, tie, strict-mode
+inertness, and breaker-open — each leaving a conflict-as-result exit
+with the full audit trail in ``.semmerge-conflicts.json``.
+"""
+import importlib.util
+import io
+import json
+import os
+import pathlib
+import subprocess
+import tarfile
+
+import pytest
+
+from semantic_merge_tpu.cli import main
+from semantic_merge_tpu.core.ops import Op, Target
+from semantic_merge_tpu.resolve import posture
+from semantic_merge_tpu.resolve.base import Candidate, ResolveContext, Resolver
+from semantic_merge_tpu.resolve.search import SearchResolver, _merge3_lines
+from semantic_merge_tpu.service.resilience import breakers
+from semantic_merge_tpu.utils import faults
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _schema_module():
+    script = REPO_ROOT / "scripts" / "check_trace_schema.py"
+    spec = importlib.util.spec_from_file_location("cts_resolve", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def git(args, cwd):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def make_repo(root, base, br_a, br_b):
+    """A basebr/brA/brB repo from three {relpath: content} trees."""
+    root.mkdir()
+    git(["init", "-q", "-b", "main"], root)
+    git(["config", "user.email", "t@example.com"], root)
+    git(["config", "user.name", "t"], root)
+
+    def write_tree(files):
+        for p in root.iterdir():
+            if p.name == ".git":
+                continue
+            if p.is_dir():
+                import shutil
+                shutil.rmtree(p)
+            else:
+                p.unlink()
+        for rel, content in files.items():
+            path = root / rel
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(content)
+
+    write_tree(base)
+    git(["add", "-A"], root)
+    git(["commit", "-q", "-m", "base"], root)
+    git(["branch", "basebr"], root)
+    git(["checkout", "-qb", "brA"], root)
+    write_tree(br_a)
+    git(["add", "-A"], root)
+    git(["commit", "-q", "-m", "A"], root)
+    git(["checkout", "-q", "main"], root)
+    git(["checkout", "-qb", "brB"], root)
+    write_tree(br_b)
+    git(["add", "-A"], root)
+    git(["commit", "-q", "-m", "B"], root)
+    git(["checkout", "-q", "main"], root)
+    return root
+
+
+def run_cli(*extra):
+    return main(["semmerge", "basebr", "brA", "brB",
+                 "--inplace", "--backend", "host", *extra])
+
+
+def read_artifact(root):
+    return json.loads((root / ".semmerge-conflicts.json").read_text())
+
+
+UTIL_BASE = ("export function foo(n: number): number {\n  return n;\n}\n"
+             "export function use(s: string): number {\n"
+             "  return foo(s.length);\n}\n")
+UTIL_A_BAR = ("export function bar(n: number): number {\n  return n;\n}\n"
+              "export function use(s: string): number {\n"
+              "  return bar(s.length);\n}\n")
+UTIL_B_BAZ = ("export function baz(n: number): number {\n  return n;\n}\n"
+              "export function use(s: string): number {\n"
+              "  return foo(s.length);\n}\n")
+
+
+@pytest.fixture
+def rename_repo(tmp_path, monkeypatch):
+    """DivergentRename with asymmetric evidence: brA renames foo→bar
+    and rewrites the caller; brB renames the declaration only."""
+    root = make_repo(tmp_path / "repo", {"src/util.ts": UTIL_BASE},
+                     {"src/util.ts": UTIL_A_BAR},
+                     {"src/util.ts": UTIL_B_BAZ})
+    monkeypatch.chdir(root)
+    faults.reset()
+    yield root
+    faults.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_breakers():
+    breakers().reset()
+    yield
+    breakers().reset()
+
+
+# ---------------------------------------------------------------------------
+# Posture plumbing
+# ---------------------------------------------------------------------------
+
+def test_posture_defaults_off(monkeypatch):
+    monkeypatch.delenv("SEMMERGE_RESOLVE", raising=False)
+    assert posture() == "off"
+    monkeypatch.setenv("SEMMERGE_RESOLVE", "auto")
+    assert posture() == "auto"
+    monkeypatch.setenv("SEMMERGE_RESOLVE", "REQUIRE")
+    assert posture() == "require"
+    monkeypatch.setenv("SEMMERGE_RESOLVE", "bogus")
+    assert posture() == "off"
+
+
+# ---------------------------------------------------------------------------
+# Golden resolutions, per category
+# ---------------------------------------------------------------------------
+
+def test_divergent_rename_resolved_end_to_end(rename_repo):
+    """The reference-rewriting rename wins; the merge succeeds, the
+    tree carries the winning name everywhere, and the artifact records
+    the accepted audit with all four gates green, in order."""
+    rc = run_cli("--resolve")
+    assert rc == 0, "the unique-winner rename must merge cleanly"
+    text = (rename_repo / "src/util.ts").read_text()
+    assert "bar(" in text and "return bar(s.length)" in text
+    assert "baz" not in text
+    payload = read_artifact(rename_repo)
+    assert payload["schema_version"] == 2
+    assert [c["category"] for c in payload["conflicts"]] == \
+        ["DivergentRename"]
+    (rec,) = payload["resolutions"]
+    assert rec["status"] == "accepted" and rec["cause"] is None
+    assert rec["resolver"] == "search"
+    assert rec["candidate"]["id"] == "keepA"
+    assert rec["scores"] == {"keepA": 2, "keepB": 1}
+    assert [g["gate"] for g in rec["gates"]] == \
+        ["recompose", "parity", "typecheck", "format"]
+    assert all(g["ok"] for g in rec["gates"])
+    assert _schema_module().validate_conflicts(payload) == []
+
+
+def test_delete_vs_edit_resolved_end_to_end(tmp_path, monkeypatch):
+    """Completed-cleanup deletion beats a body edit of the deleted
+    symbol: brB removed ``foo`` and its call site, brA only touched
+    ``foo``'s body — keepDelete is the unique evidence-backed winner."""
+    foo = "export function foo(n: number): number {\n  return n;\n}\n"
+    use = ("import { foo } from './foo';\n"
+           "export function use(s: string): number {\n"
+           "  return foo(s.length);\n}\n")
+    root = make_repo(
+        tmp_path / "repo",
+        {"src/foo.ts": foo, "src/use.ts": use},
+        {"src/foo.ts": foo.replace("return n;", "return n + 1;"),
+         "src/use.ts": use},
+        {"src/foo.ts": "",
+         "src/use.ts": "export function use(s: string): number {\n"
+                       "  return s.length;\n}\n"})
+    monkeypatch.chdir(root)
+    rc = run_cli("--resolve", "auto", "--strict-conflicts",
+                 "--structured-apply")
+    assert rc == 0
+    assert "function foo" not in (root / "src/foo.ts").read_text()
+    assert "return s.length" in (root / "src/use.ts").read_text()
+    payload = read_artifact(root)
+    cats = {r["category"]: r for r in payload["resolutions"]}
+    rec = cats["DeleteVsEdit"]
+    assert rec["status"] == "accepted"
+    assert rec["candidate"]["id"] == "keepDelete"
+    assert _schema_module().validate_conflicts(payload) == []
+
+
+def test_concurrent_stmt_edit_resolved_end_to_end(tmp_path, monkeypatch):
+    """Disjoint line edits of the same body 3-way-merge into one body
+    carrying both changes."""
+    base = ("export function calc(n: number): number {\n"
+            "  n = n + 1;\n"
+            "  n = n * 2;\n"
+            "  return n;\n"
+            "}\n")
+    root = make_repo(
+        tmp_path / "repo",
+        {"src/calc.ts": base},
+        {"src/calc.ts": base.replace("n = n + 1;", "n = n + 3;")},
+        {"src/calc.ts": base.replace("n = n * 2;", "n = n * 4;")})
+    monkeypatch.chdir(root)
+    rc = run_cli("--resolve", "auto", "--strict-conflicts")
+    assert rc == 0
+    text = (root / "src/calc.ts").read_text()
+    assert "n = n + 3;" in text and "n = n * 4;" in text
+    payload = read_artifact(root)
+    (rec,) = [r for r in payload["resolutions"]
+              if r["category"] == "ConcurrentStmtEdit"]
+    assert rec["status"] == "accepted"
+    assert rec["candidate"]["id"] == "merged3way"
+    assert _schema_module().validate_conflicts(payload) == []
+
+
+def test_overlapping_stmt_edits_fall_back(tmp_path, monkeypatch):
+    """The same line edited to different results on both sides: no
+    candidate — conflict-as-result, audit says so."""
+    root = make_repo(
+        tmp_path / "repo",
+        {"a.ts": "export function foo(n: number): number { return 0; }\n"},
+        {"a.ts": "export function foo(n: number): number { return 1; }\n"},
+        {"a.ts": "export function foo(n: number): number { return 2; }\n"})
+    monkeypatch.chdir(root)
+    rc = run_cli("--resolve", "auto", "--strict-conflicts")
+    assert rc == 1
+    payload = read_artifact(root)
+    (rec,) = [r for r in payload["resolutions"]
+              if r["category"] == "ConcurrentStmtEdit"]
+    assert rec["status"] == "rejected"
+    assert rec["cause"] == "no-candidates"
+
+
+# ---------------------------------------------------------------------------
+# Fallback ladder: tie, gate rejection, strict inertness, breaker-open
+# ---------------------------------------------------------------------------
+
+def test_symmetric_renames_tie_and_fall_back(tmp_path, monkeypatch):
+    """Both sides rename the declaration only — equal evidence, a tie,
+    and the tier refuses to guess. Work tree stays conflicted."""
+    base = "export function foo(n: number): number {\n  return n;\n}\n"
+    root = make_repo(
+        tmp_path / "repo",
+        {"src/util.ts": base},
+        {"src/util.ts": base.replace("foo", "bar")},
+        {"src/util.ts": base.replace("foo", "baz")})
+    monkeypatch.chdir(root)
+    monkeypatch.setenv("SEMMERGE_RESOLVE", "auto")  # env path, not flag
+    rc = run_cli()
+    assert rc == 1
+    payload = read_artifact(root)
+    (rec,) = payload["resolutions"]
+    assert rec["status"] == "rejected" and rec["cause"] == "tie"
+    assert rec["scores"] == {"keepA": 1, "keepB": 1}
+    assert rec["gates"] == []
+    assert _schema_module().validate_conflicts(payload) == []
+
+
+def test_gate_rejection_falls_back_byte_exact(rename_repo, monkeypatch):
+    """A candidate that fails a verify gate (here: drops nothing, so
+    recompose still sees the divergent renames) is rejected; the tree
+    is byte-identical to a resolver-off run and the audit carries the
+    failed gate."""
+
+    class NoopResolver(Resolver):
+        name = "noop"
+
+        def propose(self, conflict, ctx):
+            return [Candidate(id="noop", label="change nothing",
+                              rationale="test", score=1)]
+
+    monkeypatch.setenv("SEMMERGE_RESOLVE", "off")
+    assert run_cli() == 1
+    baseline = {p.relative_to(rename_repo).as_posix(): p.read_bytes()
+                for p in sorted(rename_repo.rglob("*.ts"))}
+    monkeypatch.setenv("SEMMERGE_RESOLVE", "auto")
+    monkeypatch.setattr("semantic_merge_tpu.resolve.engine.SearchResolver",
+                        NoopResolver)
+    rc = run_cli()
+    assert rc == 1
+    assert {p.relative_to(rename_repo).as_posix(): p.read_bytes()
+            for p in sorted(rename_repo.rglob("*.ts"))} == baseline
+    payload = read_artifact(rename_repo)
+    (rec,) = payload["resolutions"]
+    assert rec["status"] == "rejected"
+    assert rec["cause"] == "gate:recompose"
+    assert rec["gates"][0]["gate"] == "recompose"
+    assert rec["gates"][0]["ok"] is False
+    assert "residual" in rec["gates"][0]["detail"]
+    assert _schema_module().validate_conflicts(payload) == []
+
+
+@pytest.mark.parametrize("mode", ["env", "flag"])
+def test_strict_mode_keeps_resolver_inert(rename_repo, monkeypatch, mode):
+    """``SEMMERGE_STRICT=1`` / ``--no-degrade`` force the tier off even
+    when the posture asks for it: legacy bare-array artifact, exit 1."""
+    monkeypatch.setenv("SEMMERGE_RESOLVE", "auto")
+    if mode == "env":
+        monkeypatch.setenv("SEMMERGE_STRICT", "1")
+        rc = run_cli()
+    else:
+        rc = run_cli("--no-degrade")
+    assert rc == 1
+    payload = read_artifact(rename_repo)
+    assert isinstance(payload, list), \
+        "strict mode must keep the legacy artifact shape"
+    assert "baz" not in (rename_repo / "src/util.ts").read_text() \
+        or "bar" not in (rename_repo / "src/util.ts").read_text()
+
+
+def test_breaker_open_skips_propose(rename_repo, monkeypatch):
+    """An open ``resolve:<Category>`` breaker refuses the attempt
+    before propose runs: cause ``breaker-open``, conflict-as-result."""
+    monkeypatch.setenv("SEMMERGE_BREAKER", "on")
+    monkeypatch.setenv("SEMMERGE_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("SEMMERGE_BREAKER_COOLDOWN", "600")
+    breakers().record_failure("resolve:DivergentRename")  # opens it
+    assert breakers().snapshot()["resolve:DivergentRename"] == "open"
+    monkeypatch.setenv("SEMMERGE_RESOLVE", "auto")
+    rc = run_cli()
+    assert rc == 1
+    payload = read_artifact(rename_repo)
+    (rec,) = payload["resolutions"]
+    assert rec["status"] == "rejected" and rec["cause"] == "breaker-open"
+    assert rec["candidates"] == 0 and rec["gates"] == []
+
+
+def test_require_posture_tie_still_conflict_as_result(tmp_path, monkeypatch):
+    """``require`` escalates resolver *faults* to exit 17 (pinned in
+    test_faults.py); a clean tie is not a fault — it stays a documented
+    conflict exit with the tie recorded in the audit."""
+    base = "export function foo(n: number): number {\n  return n;\n}\n"
+    root = make_repo(
+        tmp_path / "repo",
+        {"src/util.ts": base},
+        {"src/util.ts": base.replace("foo", "bar")},
+        {"src/util.ts": base.replace("foo", "baz")})
+    monkeypatch.chdir(root)
+    rc = run_cli("--resolve", "require")
+    assert rc == 1
+    payload = read_artifact(root)
+    assert payload["resolutions"][0]["cause"] == "tie"
+    from semantic_merge_tpu.errors import ResolveFault
+    assert ResolveFault.exit_code == 17
+
+
+# ---------------------------------------------------------------------------
+# SearchResolver unit goldens (synthetic ops + snapshots)
+# ---------------------------------------------------------------------------
+
+def _tar(files):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for rel, content in files.items():
+            data = content.encode()
+            info = tarfile.TarInfo(rel)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def _op(op_type, sym, params, op_id, addr=None):
+    return Op.new(op_type,
+                  Target(symbolId=sym,
+                         addressId=addr or f"f.ts::{sym}::0"),
+                  params=params, op_id=op_id)
+
+
+def test_merge3_disjoint_and_overlap():
+    base = "a\nb\nc\n"
+    assert _merge3_lines(base, "A\nb\nc\n", "a\nb\nC\n") == "A\nb\nC\n"
+    assert _merge3_lines(base, "A\nb\nc\n", "X\nb\nc\n") is None
+    # Both inserting different text at the same point is a guess.
+    assert _merge3_lines(base, "a\nnew1\nb\nc\n", "a\nnew2\nb\nc\n") is None
+    # Identical edits on both sides dedupe.
+    assert _merge3_lines(base, "A\nb\nc\n", "A\nb\nc\n") == "A\nb\nc\n"
+
+
+def test_extract_vs_inline_unit_golden():
+    """keepExtract wins when the extracted helper is actually called;
+    the losing inline motion drops together with its companions."""
+    ext = _op("extractMethod", "host",
+              {"file": "f.ts", "newName": "helper", "blockHash": "h",
+               "newAddress": "f.ts::helper::0"}, "a-ext",
+              addr="f.ts::host::0")
+    ext_edit = _op("editStmtBlock", "host",
+                   {"file": "f.ts", "oldBodyHash": "x", "newBodyHash": "y",
+                    "oldBody": "body", "newBody": "helper();"}, "a-edit",
+                   addr="f.ts::host::0")
+    ext_add = _op("addDecl", "helper", {"file": "f.ts"}, "a-add",
+                  addr="f.ts::helper::0")
+    inl = _op("inlineMethod", "host",
+              {"file": "f.ts", "methodName": "callee", "blockHash": "h",
+               "oldAddress": "f.ts::callee::0"}, "b-inl",
+              addr="f.ts::host::0")
+    inl_del = _op("deleteDecl", "callee", {"file": "f.ts"}, "b-del",
+                  addr="f.ts::callee::0")
+    ctx = ResolveContext(
+        [ext, ext_edit, ext_add], [inl, inl_del],
+        base_tar=_tar({"f.ts": "function host() { callee(); }\n"
+                               "function callee() {}\n"}),
+        left_tar=_tar({"f.ts": "function host() { helper(); }\n"
+                               "function helper() {}\n"
+                               "function callee() {}\n"}),
+        right_tar=_tar({"f.ts": "function host() { /* inlined */ }\n"}))
+    conflict = {"category": "ExtractVsInline",
+                "opA": ext.to_dict(), "opB": inl.to_dict()}
+    cands = SearchResolver().propose(conflict, ctx)
+    by_id = {c.id: c for c in cands}
+    assert by_id["keepExtract"].score == 2  # helper decl + call site
+    assert set(by_id["keepExtract"].drops) == {"b-inl", "b-del"}
+    assert by_id["keepInline"].score == 1  # one call site cleaned up
+    assert set(by_id["keepInline"].drops) == {"a-ext", "a-edit", "a-add"}
+
+
+def test_delete_vs_edit_unit_tie_without_evidence():
+    """No cleanup and no new usage: both scores 0 — the engine will
+    treat that as a tie and fall back."""
+    op_del = _op("deleteDecl", "sym", {"file": "f.ts"}, "a1")
+    op_edit = _op("renameSymbol", "sym",
+                  {"oldName": "foo", "newName": "goo", "file": "f.ts"}, "b1")
+    src = "export function foo(): void {}\n"
+    ctx = ResolveContext([op_del], [op_edit],
+                         base_tar=_tar({"f.ts": src}),
+                         left_tar=_tar({"f.ts": ""}),
+                         right_tar=_tar({"f.ts": src.replace("foo", "goo")}))
+    conflict = {"category": "DeleteVsEdit",
+                "opA": op_del.to_dict(), "opB": op_edit.to_dict()}
+    cands = SearchResolver().propose(conflict, ctx)
+    assert {c.id: c.score for c in cands} == {"keepDelete": 0, "keepEdit": 0}
+
+
+def test_unknown_category_proposes_nothing():
+    ctx = ResolveContext([], [], base_tar=_tar({}), left_tar=_tar({}),
+                         right_tar=_tar({}))
+    assert SearchResolver().propose({"category": "DivergentMove"}, ctx) == []

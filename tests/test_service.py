@@ -95,6 +95,41 @@ def build_repo(root: pathlib.Path, conflict: bool = False) -> pathlib.Path:
     return root
 
 
+def build_resolve_repo(root: pathlib.Path, tie: bool = False) -> pathlib.Path:
+    """A DivergentRename repo for resolver parity. Default shape carries
+    asymmetric reference evidence (brA rewrote the call site) so the
+    search resolver accepts ``keepA`` and the merge exits 0; ``tie=True``
+    renames the declaration only on BOTH sides — symmetric evidence,
+    scoring tie, conflict-as-result exit 1 with a rejected audit row."""
+    root.mkdir(parents=True)
+    git(["init", "-q", "-b", "main"], root)
+    git(["config", "user.email", "t@example.com"], root)
+    git(["config", "user.name", "t"], root)
+    (root / "src").mkdir()
+    (root / "src/util.ts").write_text(
+        "export function foo(n: number): number {\n  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        "  return foo(s.length);\n}\n")
+    commit_all(root, "base")
+    git(["branch", "basebr"], root)
+    git(["checkout", "-qb", "brA"], root)
+    call_a = "foo" if tie else "bar"
+    (root / "src/util.ts").write_text(
+        "export function bar(n: number): number {\n  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        f"  return {call_a}(s.length);\n}}\n")
+    commit_all(root, "rename foo->bar")
+    git(["checkout", "-q", "main"], root)
+    git(["checkout", "-qb", "brB"], root)
+    (root / "src/util.ts").write_text(
+        "export function baz(n: number): number {\n  return n;\n}\n"
+        "export function use(s: string): number {\n"
+        "  return foo(s.length);\n}\n")
+    commit_all(root, "rename foo->baz decl-only")
+    git(["checkout", "-q", "main"], root)
+    return root
+
+
 def tree_state(root: pathlib.Path) -> dict:
     out = {}
     for p in sorted(root.rglob("*")):
@@ -204,6 +239,52 @@ def test_daemon_matches_one_shot(tmp_path, service_daemon, shape,
     if art_one.exists():
         assert json.loads(art_one.read_text()) == \
             json.loads(art_two.read_text())
+    assert semmerge_notes(one) == semmerge_notes(two)
+
+
+def _normalized_artifact(path: pathlib.Path):
+    """The conflicts artifact with per-gate wall-clock stripped — gate
+    timings are the only nondeterministic field in the audit trail."""
+    payload = json.loads(path.read_text())
+    if isinstance(payload, dict):
+        for rec in payload.get("resolutions", []):
+            for gate in rec.get("gates", []):
+                gate.pop("ms", None)
+    return payload
+
+
+@pytest.mark.parametrize("tie,expected", [
+    pytest.param(False, 0, id="resolve-accepted-exit0"),
+    pytest.param(True, 1, id="resolve-tie-exit1"),
+])
+def test_daemon_resolve_posture_parity(tmp_path, service_daemon, tie,
+                                       expected):
+    """``SEMMERGE_RESOLVE`` rides the request env overlay: the daemon's
+    resolver-enabled merge matches the one-shot run byte-for-byte —
+    exit code, work tree, v2 conflicts artifact (audit trail included),
+    git notes — for both an accepted resolution and a tie fallback."""
+    one = build_resolve_repo(tmp_path / "oneshot", tie=tie)
+    two = build_resolve_repo(tmp_path / "daemon", tie=tie)
+    extra = {"SEMMERGE_RESOLVE": "auto"}
+    with oneshot_env(one, extra):
+        rc_one = main(MERGE_ARGV)
+    assert rc_one == expected
+
+    proc = run_client(two, client_env(service_daemon, **extra))
+    assert proc.returncode == rc_one, \
+        f"daemon exit {proc.returncode} != one-shot {rc_one}: {proc.stderr}"
+    assert tree_state(one) == tree_state(two), \
+        "daemon and one-shot resolver runs must produce identical trees"
+    art_one = one / CONFLICTS_ARTIFACT
+    art_two = two / CONFLICTS_ARTIFACT
+    assert art_one.exists() and art_two.exists(), \
+        "a resolver-tier run must always leave the audited artifact"
+    pay_one = _normalized_artifact(art_one)
+    pay_two = _normalized_artifact(art_two)
+    assert pay_one == pay_two
+    assert pay_one["schema_version"] == 2
+    statuses = {r["status"] for r in pay_one["resolutions"]}
+    assert statuses == ({"rejected"} if tie else {"accepted"})
     assert semmerge_notes(one) == semmerge_notes(two)
 
 

@@ -566,6 +566,97 @@ def test_bench_cli_flag(schema, artifacts, tmp_path):
     assert "bench:" in fail.stderr
 
 
+def _conflict_row():
+    return {"id": "c1", "category": "DivergentRename", "symbolId": "sym",
+            "addressIds": ["src/util.ts::foo::0"], "opA": {}, "opB": {},
+            "minimalSlice": {}, "suggestions": []}
+
+
+def _v2_conflicts_payload():
+    return {
+        "schema_version": 2,
+        "conflicts": [_conflict_row()],
+        "resolutions": [{
+            "conflict_id": "c1", "category": "DivergentRename",
+            "resolver": "search", "status": "accepted", "cause": None,
+            "candidate": {"id": "keepA", "label": "Rename to bar",
+                          "rationale": "2 references", "drop": ["op-b"],
+                          "replace": []},
+            "candidates": 2, "scores": {"keepA": 2, "keepB": 1},
+            "gates": [
+                {"gate": "recompose", "ok": True, "ms": 1.2},
+                {"gate": "parity", "ok": True, "ms": 0.4},
+                {"gate": "typecheck", "ok": True, "ms": 3.0},
+                {"gate": "format", "ok": True, "ms": 0.2},
+            ]}],
+    }
+
+
+def test_conflicts_artifact_validates(schema, tmp_path):
+    """Both legal artifact shapes pass ``validate_conflicts`` — the
+    legacy bare array (resolution tier not run, byte-identical to the
+    reference) and the v2 object with the ``resolutions`` audit block —
+    and drift is rejected field by field; the CLI subcommand wires the
+    same validator."""
+    assert schema.validate_conflicts([_conflict_row()]) == []
+    v2 = _v2_conflicts_payload()
+    assert schema.validate_conflicts(v2) == []
+
+    assert any("schema_version" in e for e in schema.validate_conflicts(
+        {**v2, "schema_version": 3}))
+    assert any("missing key" in e for e in schema.validate_conflicts(
+        {**v2, "conflicts": [{}]}))
+
+    broken = json.loads(json.dumps(v2))
+    broken["resolutions"][0]["status"] = "maybe"
+    assert any("status" in e for e in schema.validate_conflicts(broken))
+
+    broken = json.loads(json.dumps(v2))
+    broken["resolutions"][0]["cause"] = "tie"  # accepted + cause: illegal
+    assert any("null" in e for e in schema.validate_conflicts(broken))
+
+    broken = json.loads(json.dumps(v2))
+    broken["resolutions"][0]["status"] = "rejected"
+    broken["resolutions"][0]["cause"] = None
+    assert any("non-empty" in e for e in schema.validate_conflicts(broken))
+
+    broken = json.loads(json.dumps(v2))
+    gates = broken["resolutions"][0]["gates"]
+    gates[0], gates[1] = gates[1], gates[0]
+    assert any("documented order" in e
+               for e in schema.validate_conflicts(broken))
+
+    broken = json.loads(json.dumps(v2))
+    broken["resolutions"][0]["gates"][0]["gate"] = "vibes"
+    assert any("'vibes'" in e for e in schema.validate_conflicts(broken))
+
+    broken = json.loads(json.dumps(v2))
+    broken["resolutions"][0]["gates"][0]["ms"] = -1
+    assert any("ms" in e for e in schema.validate_conflicts(broken))
+
+    good = tmp_path / "conflicts.json"
+    good.write_text(json.dumps(v2))
+    ok = subprocess.run([sys.executable, str(_SCRIPT),
+                         "validate_conflicts", str(good)],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"schema_version": 2}))
+    fail = subprocess.run([sys.executable, str(_SCRIPT),
+                           "validate_conflicts", str(bad)],
+                          capture_output=True, text=True, timeout=60)
+    assert fail.returncode == 1
+    assert "conflicts" in fail.stderr
+
+
+def test_resolver_fault_reason_and_metric_documented(schema):
+    from semantic_merge_tpu.obs import flight as obs_flight
+    assert "resolver-fault" in schema.POSTMORTEM_REASONS
+    assert tuple(schema.POSTMORTEM_REASONS) == tuple(obs_flight.REASONS)
+    assert schema.FAULT_METRIC_LABELS["resolutions_total"] == \
+        ("category", "outcome")
+
+
 def test_drifted_events_are_rejected(schema, artifacts):
     _, events = artifacts
     lines = events.read_text().splitlines()

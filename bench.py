@@ -1014,17 +1014,25 @@ def run_resolve_bench(record: dict, args, json_only: bool = False) -> int:
 
 def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
     """The ``batchserve`` preset: what continuous batching buys a WARM
-    daemon under concurrent load. One daemon (16 workers, single-device
-    engine via ``SEMMERGE_MESH=off`` so every request is batch-eligible)
-    serves the same synthetic merge at client concurrency 1 / 4 / 16;
-    overlapping fused dispatches coalesce into batched multi-merge
-    programs. Parity gates the number: a ``SEMMERGE_BATCH=require``
-    run and a ``SEMMERGE_BATCH=off`` run must exit identically and
-    leave byte-identical git-notes op-log payloads. Additive BENCH
-    fields: ``serial_merges_per_sec``, ``batch_merges_per_sec_c4`` /
-    ``_c16``, ``batch_speedup_c16``, ``batch_p50_ms`` /
-    ``batch_p99_ms`` (c16 request latency), ``mean_batch_size``,
-    ``batch_padding_waste_ratio``, ``batch_program_cache_hit_rate``."""
+    daemon under concurrent load — now along a **chips axis**. Phase 1
+    (``chips=1``) pins ``SEMMERGE_MESH=off`` (the single-device batched
+    program); phase 2 restarts the daemon mesh-on so the packed merge
+    axis shards across every local chip (on a CPU host the mesh runs
+    over 4 ``--xla_force_host_platform_device_count`` virtual devices).
+    Parity gates the number three ways: batched-vs-unbatched inside
+    phase 1, mesh-vs-single-device across the phases, and a one-shot
+    (no daemon) CLI run — all must leave byte-identical git-notes
+    op-log payloads. Additive BENCH fields: the phase-1 set
+    (``serial_merges_per_sec``, ``batch_merges_per_sec_c4``/``_c16``,
+    ``batch_speedup_c16``, ``batch_p50_ms``/``batch_p99_ms``,
+    ``mean_batch_size``, ``batch_padding_waste_ratio``,
+    ``batch_program_cache_hit_rate``) plus the chips axis: ``chips``,
+    ``mesh_merges_per_sec_c16``, ``merges_per_sec_per_chip``,
+    ``scaling_efficiency`` (mesh c16 rate over single-device c16 rate,
+    per effective chip — virtual CPU devices add no hardware, so there
+    the denominator is 1), ``mesh_p50_ms``/``mesh_p99_ms`` at matched
+    concurrency. Exit 0 requires parity AND ``scaling_efficiency`` ≥
+    0.7 whenever the mesh actually formed."""
     import shutil
     import statistics
     import subprocess
@@ -1035,24 +1043,21 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
 
     scratch = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-batchserve-"))
     repo = scratch / "repo"
-    sock = str(scratch / "daemon.sock")
     _build_service_repo(repo, args.files, args.decls)
+    on_cpu = os.environ.get("SEMMERGE_BENCH_PLATFORM") == "cpu"
 
-    child_env = dict(os.environ)
+    base_env = dict(os.environ)
     pkg_root = os.path.dirname(os.path.abspath(__file__))
-    prior_pp = child_env.get("PYTHONPATH", "")
-    child_env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{prior_pp}"
-                               if prior_pp else pkg_root)
-    child_env["SEMMERGE_DAEMON"] = "off"
-    child_env.pop("SEMMERGE_FAULT", None)
-    child_env.pop("SEMMERGE_METRICS", None)
-    # The batching daemon's deployment posture: fill the chip by
-    # coalescing requests, not by dp-sharding a single merge.
-    child_env["SEMMERGE_MESH"] = "off"
-    child_env["SEMMERGE_SERVICE_WORKERS"] = "16"
-    child_env.setdefault("SEMMERGE_BATCH_WINDOW_MS", "25")
-    if os.environ.get("SEMMERGE_BENCH_PLATFORM") == "cpu":
-        child_env["JAX_PLATFORMS"] = "cpu"
+    prior_pp = base_env.get("PYTHONPATH", "")
+    base_env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{prior_pp}"
+                              if prior_pp else pkg_root)
+    base_env["SEMMERGE_DAEMON"] = "off"
+    base_env.pop("SEMMERGE_FAULT", None)
+    base_env.pop("SEMMERGE_METRICS", None)
+    base_env["SEMMERGE_SERVICE_WORKERS"] = "16"
+    base_env.setdefault("SEMMERGE_BATCH_WINDOW_MS", "25")
+    if on_cpu:
+        base_env["JAX_PLATFORMS"] = "cpu"
     merge_argv = ["semmerge", "basebr", "brA", "brB", "--backend", "tpu"]
 
     def notes_blobs():
@@ -1065,7 +1070,7 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
             blobs.append((p.returncode, p.stdout))
         return blobs
 
-    def request(posture=None):
+    def request(sock, posture=None):
         env = {} if posture is None else {"SEMMERGE_BATCH": posture}
         t0 = time.perf_counter()
         frame = svc_client.call_verb(
@@ -1076,7 +1081,7 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
         result = frame.get("result") or {}
         return result.get("exit_code"), wall, frame
 
-    def drive(concurrency: int, per_thread: int):
+    def drive(sock, concurrency: int, per_thread: int):
         """``concurrency`` client threads, ``per_thread`` requests
         each, released together; returns (walls, total_wall, errors)."""
         walls, errors = [], []
@@ -1087,7 +1092,7 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
             try:
                 barrier.wait()
                 for _ in range(per_thread):
-                    code, wall, frame = request()
+                    code, wall, frame = request(sock)
                     with lock:
                         if code != 0:
                             errors.append(f"request exit {code}: {frame}")
@@ -1106,65 +1111,89 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
             t.join(timeout=600)
         return walls, time.perf_counter() - t0, errors
 
-    daemon = None
-    try:
+    def spawn(sock, mesh_posture):
+        """Start one daemon phase; returns (proc, error_or_None)."""
+        env = dict(base_env)
+        env["SEMMERGE_MESH"] = mesh_posture
+        if mesh_posture != "off" and on_cpu and \
+                "xla_force_host_platform_device_count" not in \
+                env.get("XLA_FLAGS", ""):
+            # CPU container: the mesh phase runs over virtual host-
+            # platform devices (they exercise the sharded program; they
+            # add no hardware, so scaling_efficiency divides by 1).
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count=4"
+                                ).strip()
+            # XLA:CPU aborts reloading AOT-cached multi-replica
+            # executables; the persistent compile cache must sit this
+            # phase out.
+            env["SEMMERGE_NO_COMPILE_CACHE"] = "1"
         log = open(sock + ".log", "ab")
-        daemon = subprocess.Popen(
+        proc = subprocess.Popen(
             [sys.executable, "-m", "semantic_merge_tpu", "serve",
              "--socket", sock],
             stdin=subprocess.DEVNULL, stdout=log, stderr=log,
-            cwd="/", env=child_env, start_new_session=True)
+            cwd="/", env=env, start_new_session=True)
         log.close()
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             conn = svc_client._try_connect(sock, timeout=2.0)
             if conn is not None:
                 svc_client._close(*conn)
-                break
-            if daemon.poll() is not None:
-                record["error"] = (f"daemon exited rc={daemon.returncode} "
-                                   f"during startup (log: {sock}.log)")
-                emit_record(record)
-                return 1
+                return proc, None
+            if proc.poll() is not None:
+                return proc, (f"daemon exited rc={proc.returncode} during "
+                              f"startup (log: {sock}.log)")
             time.sleep(0.1)
-        else:
-            record["error"] = "daemon did not come up within 120s"
-            emit_record(record)
-            return 1
+        proc.kill()
+        return proc, "daemon did not come up within 120s"
+
+    def teardown(proc, sock):
+        if proc is None:
+            return
+        try:
+            svc_client.call_control("shutdown", path=sock, timeout=10)
+            proc.wait(timeout=30)
+        except Exception:
+            proc.kill()
+
+    def fail(msg: str) -> int:
+        record["error"] = msg
+        emit_record(record)
+        return 1
+
+    daemon = None
+    sock = cur_sock = str(scratch / "daemon.sock")
+    try:
+        # ----- phase 1: chips=1 (single-device batched program) -----
+        daemon, err = spawn(sock, "off")
+        if err:
+            return fail(err)
 
         # Parity gate (doubles as warm-up of the B=1 batched program):
         # require-batched vs forced-unbatched, byte-identical notes.
         for posture in ("require", "require"):  # 2nd run is cache-warm
-            code, _, frame = request(posture)
+            code, _, frame = request(sock, posture)
             if code != 0:
-                record["error"] = f"batched warm-up failed: {frame}"
-                emit_record(record)
-                return 1
+                return fail(f"batched warm-up failed: {frame}")
         batched_notes = notes_blobs()
-        code, _, frame = request("off")
+        code, _, frame = request(sock, "off")
         if code != 0:
-            record["error"] = f"unbatched parity run failed: {frame}"
-            emit_record(record)
-            return 1
+            return fail(f"unbatched parity run failed: {frame}")
         parity = (notes_blobs() == batched_notes)
-        record["parity"] = bool(parity)
 
         # Untimed c16 burst: compiles the larger-B batched programs so
         # the timed sweep measures steady state, as the other presets do.
-        _, _, errs = drive(16, 1)
+        _, _, errs = drive(sock, 16, 1)
         if errs:
-            record["error"] = f"warm burst failed: {errs[0]}"
-            emit_record(record)
-            return 1
+            return fail(f"warm burst failed: {errs[0]}")
 
-        walls1, total1, errs1 = drive(1, 6)
-        walls4, total4, errs4 = drive(4, 4)
-        walls16, total16, errs16 = drive(16, 2)
+        walls1, total1, errs1 = drive(sock, 1, 6)
+        walls4, total4, errs4 = drive(sock, 4, 4)
+        walls16, total16, errs16 = drive(sock, 16, 2)
         for errs in (errs1, errs4, errs16):
             if errs:
-                record["error"] = errs[0]
-                emit_record(record)
-                return 1
+                return fail(errs[0])
         serial_rate = len(walls1) / total1
         rate4 = len(walls4) / total4
         rate16 = len(walls16) / total16
@@ -1175,11 +1204,63 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
         status = svc_client.call_control("status", path=sock, timeout=30)
         batch = status.get("batch") or {}
         cache = batch.get("program_cache") or {}
+        teardown(daemon, sock)
+        daemon = None
+
+        # ----- one-shot parity leg: no daemon, no batching, no mesh --
+        env_one = dict(base_env)
+        env_one.update({"SEMMERGE_MESH": "off", "SEMMERGE_BATCH": "off"})
+        proc = subprocess.run(
+            [sys.executable, "-m", "semantic_merge_tpu", *merge_argv],
+            cwd=repo, env=env_one, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True)
+        if proc.returncode != 0:
+            return fail(f"one-shot parity run failed: {proc.stderr[-500:]}")
+        parity = parity and (notes_blobs() == batched_notes)
+
+        # ----- phase 2: chips=N (mesh-sharded batched program) -------
+        # require on CPU (the phase forces 4 virtual devices, so the
+        # contract is satisfiable by construction); auto on real
+        # hardware, where the chip count is whatever the host has.
+        sock2 = cur_sock = str(scratch / "daemon-mesh.sock")
+        daemon, err = spawn(sock2, "require" if on_cpu else "auto")
+        if err:
+            return fail(err)
+        for posture in ("require", "require"):
+            code, _, frame = request(sock2, posture)
+            if code != 0:
+                return fail(f"mesh warm-up failed: {frame}")
+        parity = parity and (notes_blobs() == batched_notes)
+        _, _, errs = drive(sock2, 16, 1)
+        if errs:
+            return fail(f"mesh warm burst failed: {errs[0]}")
+        mwalls16, mtotal16, merrs16 = drive(sock2, 16, 2)
+        if merrs16:
+            return fail(merrs16[0])
+        parity = parity and (notes_blobs() == batched_notes)
+        record["parity"] = bool(parity)
+        mesh_rate16 = len(mwalls16) / mtotal16
+        mlat = sorted(mwalls16)
+        mp50 = statistics.median(mlat)
+        mp99 = mlat[min(len(mlat) - 1, round(0.99 * (len(mlat) - 1)))]
+
+        status2 = svc_client.call_control("status", path=sock2, timeout=30)
+        mesh = (status2.get("batch") or {}).get("mesh") or {}
+        meshed = int(mesh.get("mesh_dispatches") or 0) > 0
+        shape = str(mesh.get("last_shape") or "batch=1")
+        chips = int(shape.partition("=")[2] or 1) if meshed else 1
+        # Virtual host-platform devices exercise the sharded program
+        # but add no hardware: efficiency there is mesh-vs-off at
+        # matched concurrency (denominator 1). On real chips it is the
+        # per-chip share of the speedup.
+        chips_effective = 1 if on_cpu else max(1, chips)
+        scaling = (mesh_rate16 / rate16) / chips_effective if rate16 else 0.0
+        efficiency_ok = (not meshed) or scaling >= 0.7
 
         record["metric"] = (
             f"merges/sec (continuous batching, warm daemon, concurrency "
-            f"16 vs 1, {args.files} files x {args.decls} decls, "
-            f"parity={'ok' if parity else 'FAIL'})")
+            f"16 vs 1, chips={chips}, {args.files} files x {args.decls} "
+            f"decls, parity={'ok' if parity else 'FAIL'})")
         record["value"] = round(rate16, 2)
         record["unit"] = "merges/sec"
         record["vs_baseline"] = round(rate16 / serial_rate, 3)
@@ -1195,6 +1276,13 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
             float(batch.get("padding_waste_ratio", 0.0)), 4)
         record["batch_program_cache_hit_rate"] = round(
             float(cache.get("hit_rate", 0.0)), 4)
+        record["chips"] = chips
+        record["mesh_merges_per_sec_c16"] = round(mesh_rate16, 2)
+        record["merges_per_sec_per_chip"] = round(
+            mesh_rate16 / max(1, chips), 2)
+        record["scaling_efficiency"] = round(scaling, 3)
+        record["mesh_p50_ms"] = round(mp50 * 1e3, 1)
+        record["mesh_p99_ms"] = round(mp99 * 1e3, 1)
         if not json_only:
             print(f"# serial (c1):  {serial_rate:6.2f} merges/sec",
                   file=sys.stderr)
@@ -1209,15 +1297,15 @@ def run_batchserve_bench(record: dict, args, json_only: bool = False) -> int:
                   f"program cache hit rate: "
                   f"{record['batch_program_cache_hit_rate']}",
                   file=sys.stderr)
+            print(f"# mesh (c16, chips={chips}): {mesh_rate16:6.2f} "
+                  f"merges/sec  per-chip={record['merges_per_sec_per_chip']}"
+                  f"  efficiency={scaling:.2f}  "
+                  f"p50={mp50 * 1e3:.0f}ms p99={mp99 * 1e3:.0f}ms",
+                  file=sys.stderr)
         emit_record(record)
-        return 0 if parity else 1
+        return 0 if (parity and efficiency_ok) else 1
     finally:
-        if daemon is not None:
-            try:
-                svc_client.call_control("shutdown", path=sock, timeout=10)
-                daemon.wait(timeout=30)
-            except Exception:
-                daemon.kill()
+        teardown(daemon, cur_sock)
         shutil.rmtree(scratch, ignore_errors=True)
 
 

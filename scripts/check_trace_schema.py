@@ -87,22 +87,41 @@ SERVICE_METRIC_LABELS = {
     "declcache_evictions_total": (),
 }
 
-#: Span names of the continuous-batching layer (batch/). The window
+#: Span names every co-batched merge records, mesh or not: the window
 #: span is leader-side; pack/dispatch/scatter wrap one batched fused
 #: dispatch each.
-BATCH_SPANS = ("batch.window", "batch.pack", "batch.dispatch",
-               "batch.scatter")
+BATCH_CORE_SPANS = ("batch.window", "batch.pack", "batch.dispatch",
+                    "batch.scatter")
+
+#: All known batch-layer span names. ``batch.mesh_build`` records the
+#: dispatch-mesh planning choice and only fires when a mesh forms
+#: (posture ``auto``/``require`` on a multi-chip host).
+BATCH_SPANS = BATCH_CORE_SPANS + ("batch.mesh_build",)
 
 #: Meta keys every ``batch.*`` span must carry (how many valid requests
 #: the window/round held).
 BATCH_SPAN_META = ("requests",)
 
+#: Mesh meta of the sharded dispatch path: required on
+#: ``batch.mesh_build``, validated-when-present on ``batch.dispatch``
+#: (the single-device program carries neither).
+MESH_SPAN_META = ("mesh_shape", "rows_per_chip")
+
 #: Label keys of the batching metric series. ``batch_requests_total``
 #: is the per-request outcome counter; ``batch_size`` is a plain
-#: histogram; ``batch_padding_waste_ratio`` a plain gauge in [0, 1].
+#: histogram; ``batch_padding_waste_ratio`` and
+#: ``batch_mesh_occupancy_ratio`` plain gauges in [0, 1].
 BATCH_METRIC_LABELS = {
     "batch_requests_total": ("outcome",),
+    "batch_mesh_fallbacks_total": ("reason",),
 }
+
+#: Documented ``batch_mesh_fallbacks_total`` reasons
+#: (batch/dispatcher.py): 1-chip host, mesh construction failure,
+#: mesh program dispatch failure, injected/real ``batch:mesh``
+#: request-side fault.
+BATCH_MESH_FALLBACK_REASONS = ("single-device", "build-error",
+                               "dispatch-error", "fault")
 
 #: Label keys of the resilience-layer metric series (admission control
 #: and load shedding in service/daemon.py, circuit breakers in
@@ -165,6 +184,8 @@ BENCH_NUMERIC_OPTIONAL = (
     "resolution_rate", "resolve_on_ms", "resolve_off_ms",
     "gate_recompose_ms", "gate_parity_ms", "gate_typecheck_ms",
     "gate_format_ms",
+    "chips", "mesh_merges_per_sec_c16", "merges_per_sec_per_chip",
+    "scaling_efficiency", "mesh_p50_ms", "mesh_p99_ms",
 )
 
 #: Versions of the structured ``.semmerge-conflicts.json`` object form.
@@ -391,9 +412,12 @@ def validate_batch(data: Any) -> List[str]:
     """Validate the continuous-batching records of a trace/events-shaped
     artifact (or a daemon status payload's ``metrics`` block): every
     ``batch.*`` span is a documented one and carries its ``requests``
-    meta, ``batch_requests_total`` series carry exactly the ``outcome``
-    label, ``batch_size`` is an unlabeled histogram, and
-    ``batch_padding_waste_ratio`` an unlabeled gauge in [0, 1]."""
+    meta (mesh spans additionally ``mesh_shape``/``rows_per_chip``),
+    ``batch_requests_total``/``batch_mesh_fallbacks_total`` series
+    carry exactly their documented label (fallback reasons from the
+    documented set), ``batch_size`` is an unlabeled histogram, and
+    ``batch_padding_waste_ratio``/``batch_mesh_occupancy_ratio``
+    unlabeled gauges in [0, 1]."""
     errors: List[str] = []
     if not isinstance(data, dict):
         return ["batch: top level must be a JSON object"]
@@ -414,6 +438,21 @@ def validate_batch(data: Any) -> List[str]:
             if not isinstance(v, int) or isinstance(v, bool) or v < 0:
                 errors.append(f"trace.spans[{i}]: batch span meta "
                               f"{key!r} must be an int >= 0")
+        # Mesh meta: mandatory on mesh_build, optional-but-typed on
+        # dispatch (absent entirely on single-device dispatches).
+        check_mesh = name == "batch.mesh_build" or (
+            name == "batch.dispatch"
+            and any(k in meta for k in MESH_SPAN_META))
+        if check_mesh:
+            shape = meta.get("mesh_shape")
+            if not isinstance(shape, str) or not shape:
+                errors.append(f"trace.spans[{i}]: {name} meta "
+                              f"'mesh_shape' must be a non-empty string")
+            rows = meta.get("rows_per_chip")
+            if not isinstance(rows, int) or isinstance(rows, bool) \
+                    or rows < 1:
+                errors.append(f"trace.spans[{i}]: {name} meta "
+                              f"'rows_per_chip' must be an int >= 1")
     metrics = data.get("metrics", data)
     if not isinstance(metrics, dict):
         return errors
@@ -427,6 +466,12 @@ def validate_batch(data: Any) -> List[str]:
             if got != tuple(sorted(labels)):
                 errors.append(f"metrics.counters.{name}[{j}]: labels {got} "
                               f"!= documented {tuple(sorted(labels))}")
+            if name == "batch_mesh_fallbacks_total" and got == ("reason",):
+                reason = (s.get("labels") or {}).get("reason")
+                if reason not in BATCH_MESH_FALLBACK_REASONS:
+                    errors.append(
+                        f"metrics.counters.{name}[{j}]: reason {reason!r} "
+                        f"not in documented {BATCH_MESH_FALLBACK_REASONS}")
     hists = metrics.get("histograms", {})
     size = hists.get("batch_size") if isinstance(hists, dict) else None
     if isinstance(size, dict):
@@ -435,18 +480,19 @@ def validate_batch(data: Any) -> List[str]:
                 errors.append(f"metrics.histograms.batch_size[{j}]: "
                               f"must carry no labels")
     gauges = metrics.get("gauges", {})
-    waste = gauges.get("batch_padding_waste_ratio") \
-        if isinstance(gauges, dict) else None
-    if isinstance(waste, dict):
-        for j, s in enumerate(waste.get("series", [])):
+    for gname in ("batch_padding_waste_ratio",
+                  "batch_mesh_occupancy_ratio"):
+        g = gauges.get(gname) if isinstance(gauges, dict) else None
+        if not isinstance(g, dict):
+            continue
+        for j, s in enumerate(g.get("series", [])):
             if (s.get("labels") or {}) != {}:
                 errors.append(
-                    f"metrics.gauges.batch_padding_waste_ratio[{j}]: "
-                    f"must carry no labels")
+                    f"metrics.gauges.{gname}[{j}]: must carry no labels")
             v = s.get("value")
             if not _is_num(v) or not (0.0 <= v <= 1.0):
                 errors.append(
-                    f"metrics.gauges.batch_padding_waste_ratio[{j}]: "
+                    f"metrics.gauges.{gname}[{j}]: "
                     f"value must be a number in [0, 1]")
     return errors
 

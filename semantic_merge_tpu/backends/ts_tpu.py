@@ -53,23 +53,7 @@ class TpuTSBackend:
         # monitoring into the shared metrics registry.
         obs_device.ensure_jax_listeners()
         if mesh is None:
-            # SEMMERGE_MESH=off pins the single-device kernels even on
-            # a multi-chip host — the deployment posture of a batching
-            # service daemon, which fills the chips by coalescing
-            # concurrent merges (batch/) instead of sharding one
-            # merge's decl axis.
-            import os
-            if os.environ.get("SEMMERGE_MESH", "").strip().lower() in (
-                    "off", "none", "single", "0"):
-                mesh = False
-        if mesh is None and len(devices) > 1:
-            # Multi-chip: shard the merge kernels' decl/op axis over a
-            # dp mesh by default (BASELINE north star: the file/decl
-            # batch is the core parallel axis). Single chip keeps the
-            # lighter non-shard_map kernels.
-            from ..parallel.mesh import build_mesh
-            mesh = build_mesh(devices, dp=len(devices),
-                              pp=1, sp=1, tp=1, ep=1).mesh
+            mesh = self._posture_mesh(devices)
         self._mesh = mesh or None  # mesh=False forces the single-device path
         # Persistent across merges: encoded ids are stable for the
         # interner's lifetime, so per-file encoded columns cache in the
@@ -93,6 +77,48 @@ class TpuTSBackend:
         # warm merges reuse them. Same lifecycle and immutability
         # contract as the snapshot cache.
         self._symmap_cache: "OrderedDict" = OrderedDict()
+
+    @staticmethod
+    def _posture_mesh(devices, configured=None):
+        """The engine mesh the ``SEMMERGE_MESH`` posture asks for
+        (:data:`semantic_merge_tpu.parallel.mesh.MESH_POSTURES`):
+        ``False`` pins the single-device kernels, a dp mesh shards the
+        decl/op axis over a multi-chip host. With the batching
+        subsystem active the engine stays single-device regardless —
+        merges must be batch-eligible, and the mesh rides the batched
+        dispatcher's packed merge axis instead of one merge's decl
+        axis. ``require`` raises :class:`MeshFault` when neither path
+        can use a mesh (single-chip host, build failure)."""
+        from ..parallel.mesh import mesh_posture
+        posture = mesh_posture(configured)
+        if posture == "off":
+            return False
+        from .. import batch as batch_mod
+        if batch_mod.current() is not None:
+            # The batch dispatcher enforces (and, under require,
+            # raises for) the mesh contract itself per dispatch.
+            return False
+        if len(devices) > 1:
+            try:
+                from ..parallel.mesh import build_mesh
+                return build_mesh(devices, dp=len(devices),
+                                  pp=1, sp=1, tp=1, ep=1).mesh
+            except Exception as exc:
+                if posture == "require":
+                    from ..errors import MeshFault
+                    raise MeshFault(f"engine mesh build failed: {exc}",
+                                    cause=type(exc).__name__) from exc
+                from ..utils.loggingx import logger
+                logger.warning("engine mesh build failed, using "
+                               "single-device kernels: %s", exc)
+                return False
+        if posture == "require":
+            from ..errors import MeshFault
+            raise MeshFault(
+                f"SEMMERGE_MESH=require but the host has {len(devices)} "
+                f"device(s) and no batch scheduler is active",
+                cause="single-device")
+        return False
 
     def _symbol_map_cached(self, nodes, key):
         if key is not None:
@@ -184,13 +210,21 @@ class TpuTSBackend:
         return t, nodes, identity
 
     def configure(self, config) -> None:
-        """Apply ``.semmerge.toml`` settings (called by the CLI): an
-        explicit ``[engine] mesh_shape = "dp=4,tp=2"`` overrides the
-        auto dp mesh, and ``"hybrid:dcn=dp,dp=4,..."`` builds the
-        multi-slice mesh whose ``dcn`` axis crosses slices over DCN
-        while every other axis rides ICI."""
+        """Apply ``.semmerge.toml`` settings (called by the CLI): the
+        ``[engine] mesh`` posture re-resolves the auto dp mesh (env
+        still wins inside :func:`mesh_posture`), an explicit
+        ``[engine] mesh_shape = "dp=4,tp=2"`` overrides it, and
+        ``"hybrid:dcn=dp,dp=4,..."`` builds the multi-slice mesh whose
+        ``dcn`` axis crosses slices over DCN while every other axis
+        rides ICI."""
         workers = int(getattr(config.engine, "host_workers", 0) or 0)
         self._host_workers = workers if workers > 0 else None
+        from ..parallel.mesh import mesh_posture
+        configured = getattr(config.engine, "mesh", None)
+        import jax
+        self._mesh = self._posture_mesh(jax.devices(), configured) or None
+        if mesh_posture(configured) == "off":
+            return  # posture pins single-device; mesh_shape is moot
         shape = getattr(config.engine, "mesh_shape", "auto")
         try:
             from ..parallel.mesh import build_mesh, parse_mesh_spec

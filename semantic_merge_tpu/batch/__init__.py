@@ -129,8 +129,17 @@ def degrade_or_raise(fault) -> None:
     """Policy for a batching fault at the request seam: ``require``
     re-raises (typed, exit 16 strict); otherwise the caller falls back
     to the inline dispatch — affected request only, co-batched requests
-    are untouched."""
+    are untouched.
+
+    A :class:`~semantic_merge_tpu.errors.MeshFault` under
+    ``SEMMERGE_MESH=require`` also re-raises regardless of the batch
+    posture: the mesh contract (exit 18 strict) is independent of
+    whether batching itself may degrade."""
     if posture() == "require":
+        raise fault
+    from ..errors import MeshFault
+    from ..parallel.mesh import mesh_posture
+    if isinstance(fault, MeshFault) and mesh_posture() == "require":
         raise fault
     from ..utils.loggingx import logger
     logger.warning("batched dispatch degraded to inline: %s",

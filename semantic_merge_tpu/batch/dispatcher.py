@@ -6,15 +6,29 @@ Two halves live here, on two different threads:
 - the **request side** (:func:`submit_request` / :func:`collect_request`)
   runs on the merge request's own executor thread, so the per-request
   env overlay (``utils/reqenv``) is in scope — fault injection
-  (``batch:pack`` / ``batch:dispatch`` / ``batch:scatter``) and posture
-  therefore scope to ONE request, never to its co-batched neighbors;
+  (``batch:pack`` / ``batch:mesh`` / ``batch:dispatch`` /
+  ``batch:scatter``) and posture therefore scope to ONE request, never
+  to its co-batched neighbors;
 - the **leader side** (:func:`dispatch_group`) runs on the scheduler's
-  dispatch pool: pack the group along the merge axis, fetch (or
-  compile) the bucket's jitted program from the fused module's program
-  cache, run it, and scatter row ``i`` of the packed output to request
-  ``i``'s future. Each row is the single-merge kernel's one-buffer
-  packed layout, so the engine's existing non-split decode — and the
-  whole host tail behind it — runs per request, unchanged.
+  dispatch pool: plan the dispatch mesh, pack the group along the
+  merge axis, fetch (or compile) the bucket's jitted program from the
+  fused module's program cache, run it, and scatter each request's
+  packed output row to its future. Each row is the single-merge
+  kernel's one-buffer packed layout, so the engine's existing
+  non-split decode — and the whole host tail behind it — runs per
+  request, unchanged.
+
+Mesh posture (``SEMMERGE_MESH`` / ``[engine] mesh`` — see
+:data:`semantic_merge_tpu.parallel.mesh.MESH_POSTURES`) decides the
+program: ``off`` keeps the single-device vmapped program; ``auto`` and
+``require`` shard the packed merge axis across the host's chips
+(:func:`~semantic_merge_tpu.parallel.mesh.build_batch_mesh`). ``auto``
+falls back to the single-device program on 1-chip hosts, mesh-build
+failure, or a mesh dispatch error — every fallback increments
+``batch_mesh_fallbacks_total{reason}`` — while ``require`` raises a
+typed :class:`~semantic_merge_tpu.errors.MeshFault` (exit 18 strict).
+Lanes are independent, so mesh rows are bit-identical to the
+single-device program's.
 
 A leader-side error fails every member future; each request then
 applies its own posture at :func:`collect_request` (auto → inline
@@ -23,15 +37,17 @@ unbatched dispatch, require → typed ``BatchFault``).
 from __future__ import annotations
 
 import os
+import threading
 import time
+from typing import Dict, Optional
 
 import numpy as np
 
-from ..errors import fault_boundary
+from ..errors import MeshFault, fault_boundary
 from ..obs import device as obs_device
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
-from .packer import BatchRequest, pack_group
+from .packer import BatchRequest, batch_bucket, pack_group
 
 #: Small-integer buckets for the per-dispatch valid-merge count.
 BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
@@ -39,6 +55,15 @@ BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 #: Bound on a request's wait for its batch row — a wedged/killed leader
 #: must degrade the request to the inline path, not hang the daemon.
 _COLLECT_TIMEOUT_S = 300.0
+
+_FALLBACKS_HELP = ("Mesh-sharded batch dispatches that fell back to "
+                   "the single-device program, by reason")
+
+_mesh_lock = threading.Lock()
+_mesh_cache: Dict[int, object] = {}
+_mesh_stats: Dict[str, object] = {
+    "dispatches": 0, "mesh_dispatches": 0, "last_shape": None,
+    "last_rows_per_chip": 0, "last_chip_rows": [], "fallbacks": {}}
 
 
 def submit_request(scheduler, dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
@@ -63,8 +88,17 @@ def submit_request(scheduler, dev_b, dev_l, dev_r, hash_tab, dig_l, dig_r,
 def collect_request(future) -> np.ndarray:
     """Request side, post-dispatch: wait for this request's packed row.
     The wait is bounded; leader-side errors surface here (wrapped into
-    ``BatchFault``) so the caller can apply posture per request."""
+    ``BatchFault``) so the caller can apply posture per request. The
+    ``batch:mesh`` stage is the request-side seam of the mesh-sharded
+    program: an injected (or real) fault here degrades THIS request to
+    the inline dispatch — co-batched neighbors keep their rows."""
     from ..utils import faults
+    with fault_boundary("batch:mesh"):
+        try:
+            faults.check("batch:mesh")
+        except Exception:
+            _note_fallback("fault")
+            raise
     with fault_boundary("batch:dispatch"):
         faults.check("batch:dispatch")
         row = future.result(timeout=_COLLECT_TIMEOUT_S)
@@ -91,19 +125,102 @@ def _graft(members, batch_id: str, name: str, seconds: float,
                 **meta)
 
 
+def _note_fallback(reason: str) -> None:
+    obs_metrics.REGISTRY.counter(
+        "batch_mesh_fallbacks_total", _FALLBACKS_HELP).inc(1, reason=reason)
+    with _mesh_lock:
+        fallbacks = _mesh_stats["fallbacks"]
+        fallbacks[reason] = fallbacks.get(reason, 0) + 1
+
+
+def _plan_mesh(posture: str):
+    """Leader side: the dispatch mesh for this batch, or ``(None, 1)``
+    for the single-device program. ``auto`` downgrades on 1-chip hosts
+    and mesh-build failures (counted); ``require`` raises
+    :class:`MeshFault` instead — the scheduler fails every member
+    future with it, and each request's posture seam decides whether
+    that is fatal (``SEMMERGE_MESH=require``) or a per-request inline
+    degrade."""
+    import jax
+    devices = jax.devices()
+    from ..parallel.mesh import batch_mesh_shards, build_batch_mesh
+    shards = batch_mesh_shards(devices)
+    if shards < 2:
+        _note_fallback("single-device")
+        if posture == "require":
+            raise MeshFault(
+                f"SEMMERGE_MESH=require but the host has "
+                f"{len(devices)} device(s) — no batch mesh to shard "
+                f"over", cause="single-device")
+        return None, 1
+    try:
+        with _mesh_lock:
+            mesh = _mesh_cache.get(shards)
+        if mesh is None:
+            mesh = build_batch_mesh(devices, shards=shards)
+            with _mesh_lock:
+                mesh = _mesh_cache.setdefault(shards, mesh)
+    except Exception as exc:
+        _note_fallback("build-error")
+        if posture == "require":
+            raise MeshFault(f"batch mesh build failed: {exc}",
+                            cause=type(exc).__name__) from exc
+        from ..utils.loggingx import logger
+        logger.warning("batch mesh build failed, using single-device "
+                       "program: %s", exc)
+        return None, 1
+    return mesh, shards
+
+
+def mesh_stats() -> Dict[str, object]:
+    """Status/stats block of the mesh-sharded dispatch path: the live
+    posture, last mesh shape, per-chip real-row occupancy of the last
+    mesh dispatch, and cumulative fallback counts by reason."""
+    from ..parallel.mesh import mesh_posture
+    with _mesh_lock:
+        snap = {
+            "posture": mesh_posture(),
+            "dispatches": _mesh_stats["dispatches"],
+            "mesh_dispatches": _mesh_stats["mesh_dispatches"],
+            "last_shape": _mesh_stats["last_shape"],
+            "last_rows_per_chip": _mesh_stats["last_rows_per_chip"],
+            "last_chip_rows": list(_mesh_stats["last_chip_rows"]),
+            "fallbacks": dict(_mesh_stats["fallbacks"]),
+        }
+    return snap
+
+
 def dispatch_group(scheduler, members) -> None:
-    """Leader side: pack → one batched program → scatter. ``members``
-    is a same-bucket-key list of ``(BatchRequest, Future)`` pairs.
-    Every phase span is grafted into each member's request trace under
-    one shared ``batch_id``, so a co-batched request's artifact shows
-    the fused dispatch it rode without absorbing its neighbors' ids."""
+    """Leader side: plan mesh → pack → one batched program → scatter.
+    ``members`` is a same-bucket-key list of ``(BatchRequest, Future)``
+    pairs. Every phase span is grafted into each member's request trace
+    under one shared ``batch_id``, so a co-batched request's artifact
+    shows the fused dispatch it rode without absorbing its neighbors'
+    ids."""
     reqs = [req for req, _fut in members]
     valid = len(reqs)
     batch_id = os.urandom(4).hex()
+
+    from ..parallel.mesh import mesh_posture
+    posture = mesh_posture(getattr(scheduler, "mesh_config", None))
+    mesh, shards = (None, 1) if posture == "off" else _plan_mesh(posture)
+    mesh_shape = f"batch={shards}" if mesh is not None else None
+    if mesh is not None:
+        rows_per_chip = batch_bucket(valid, shards) // shards
+        t0 = time.perf_counter()
+        with obs_spans.span("batch.mesh_build", layer="batch",
+                            requests=valid, batch_id=batch_id,
+                            mesh_shape=mesh_shape,
+                            rows_per_chip=rows_per_chip):
+            pass  # planned above; the span records the placement choice
+        _graft(members, batch_id, "batch.mesh_build",
+               time.perf_counter() - t0, t0, requests=valid,
+               mesh_shape=mesh_shape, rows_per_chip=rows_per_chip)
+
     t0 = time.perf_counter()
     with obs_spans.span("batch.pack", layer="batch", requests=valid,
                         batch_id=batch_id):
-        arrays, padded = pack_group(reqs)
+        arrays, padded, placement = pack_group(reqs, shards)
     _graft(members, batch_id, "batch.pack", time.perf_counter() - t0, t0,
            requests=valid)
     reg = obs_metrics.REGISTRY
@@ -113,23 +230,64 @@ def dispatch_group(scheduler, members) -> None:
     reg.gauge("batch_padding_waste_ratio",
               "Merge-axis padding fraction of the last batched dispatch"
               ).set((padded - valid) / padded)
+    if mesh is not None:
+        reg.gauge("batch_mesh_occupancy_ratio",
+                  "Real-merge fraction of the last mesh-sharded batched "
+                  "dispatch (valid rows / padded rows)"
+                  ).set(valid / padded)
     geom = reqs[0]
     t0 = time.perf_counter()
-    with obs_spans.span("batch.dispatch", layer="batch", requests=valid,
-                        padded=padded, C=geom.C, batch_id=batch_id):
+    dispatch_meta = {"requests": valid, "padded": padded, "C": geom.C}
+    if mesh is not None:
+        dispatch_meta.update(mesh_shape=mesh_shape,
+                             rows_per_chip=padded // shards)
+    with obs_spans.span("batch.dispatch", layer="batch",
+                        batch_id=batch_id, **dispatch_meta):
         from ..ops.fused import batched_fused_program
-        program = batched_fused_program(padded, geom.nb, geom.nl,
-                                        geom.nr, geom.C)
-        flat = np.asarray(program(*arrays))
+        flat = None
+        if mesh is not None:
+            try:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from ..parallel.mesh import BATCH_AXIS
+                program = batched_fused_program(
+                    padded, geom.nb, geom.nl, geom.nr, geom.C, mesh=mesh)
+                sharded = jax.device_put(
+                    arrays, NamedSharding(mesh, P(BATCH_AXIS)))
+                flat = np.asarray(program(*sharded))
+            except Exception as exc:
+                _note_fallback("dispatch-error")
+                if posture == "require":
+                    raise MeshFault(
+                        f"mesh-sharded batch dispatch failed: {exc}",
+                        cause=type(exc).__name__) from exc
+                from ..utils.loggingx import logger
+                logger.warning("mesh-sharded dispatch failed, retrying "
+                               "on the single-device program: %s", exc)
+                mesh = None
+        if flat is None:
+            program = batched_fused_program(padded, geom.nb, geom.nl,
+                                            geom.nr, geom.C)
+            flat = np.asarray(program(*arrays))
         obs_device.record_transfer("d2h", flat.nbytes)
-    _graft(members, batch_id, "batch.dispatch", time.perf_counter() - t0, t0,
-           requests=valid, padded=padded)
+    _graft(members, batch_id, "batch.dispatch", time.perf_counter() - t0,
+           t0, **dispatch_meta)
+    with _mesh_lock:
+        _mesh_stats["dispatches"] += 1
+        if mesh is not None:
+            _mesh_stats["mesh_dispatches"] += 1
+            _mesh_stats["last_shape"] = mesh_shape
+            _mesh_stats["last_rows_per_chip"] = padded // shards
+            _mesh_stats["last_chip_rows"] = [
+                sum(1 for i in range(valid) if i % shards == chip)
+                for chip in range(shards)]
     t0 = time.perf_counter()
     with obs_spans.span("batch.scatter", layer="batch", requests=valid,
                         batch_id=batch_id):
         for i, (_req, fut) in enumerate(members):
             if not fut.done():
-                fut.set_result(flat[i])
+                fut.set_result(flat[placement[i]])
     _graft(members, batch_id, "batch.scatter", time.perf_counter() - t0, t0,
            requests=valid)
     scheduler.note_batch(valid, padded)

@@ -9,6 +9,17 @@ hash table grown in power-of-two steps — so the co-batch **bucket key**
 keys stack with zero per-request reshaping. The merge axis itself is
 padded up its own small ladder (:func:`batch_bucket`) so the jitted
 batched program cache stays O(log) per bucket key.
+
+Mesh-sharded dispatch adds two constraints the packer owns:
+
+- the padded merge axis must be a **multiple of the mesh's batch-axis
+  size** (each chip takes a contiguous ``padded // shards`` row block),
+  so the ladder becomes ``shards × 2^k`` — 3 real merges on a 4-chip
+  mesh pad to 4 rows, not 8;
+- inert padding rows should land **evenly**: requests are placed
+  round-robin across the chip blocks (:func:`placement_for`), so with
+  5 valid rows in an 8-row bucket over 4 chips every chip holds at
+  least one real merge instead of the last chip holding only padding.
 """
 from __future__ import annotations
 
@@ -54,28 +65,50 @@ class BatchRequest:
                 int(self.hash_tab.shape[0]))
 
 
-def batch_bucket(n: int) -> int:
-    """Merge-axis ladder: the next power of two ≥ ``n`` (1, 2, 4, 8, …)
-    — a small rung set so batched program shapes, like the decl
-    buckets, compile O(log) variants instead of one per batch size."""
-    bucket = 1
+def batch_bucket(n: int, multiple: int = 1) -> int:
+    """Merge-axis ladder: the next ``multiple × 2^k`` ≥ ``n`` — a small
+    rung set so batched program shapes, like the decl buckets, compile
+    O(log) variants instead of one per batch size. ``multiple`` is the
+    mesh batch-axis size (1 for the single-device program, giving the
+    classic power-of-two ladder): every rung divides evenly into
+    per-chip row blocks, and 3 real merges on a 4-chip mesh pad to 4
+    rows (one block each), never 8."""
+    multiple = max(1, int(multiple))
+    bucket = multiple
     while bucket < n:
         bucket *= 2
     return bucket
 
 
-def pack_group(reqs: List[BatchRequest]):
+def placement_for(valid: int, padded: int, shards: int = 1) -> List[int]:
+    """Row index for each of the ``valid`` requests in a ``padded``-row
+    batch sharded into ``shards`` contiguous chip blocks: request ``i``
+    lands in block ``i % shards`` at slot ``i // shards`` — round-robin
+    across chips, so real merges (and therefore inert padding) spread
+    evenly instead of piling the padding onto the tail chips. With
+    ``shards == 1`` this is the identity layout."""
+    block = padded // max(1, shards)
+    return [(i % shards) * block + (i // shards) for i in range(valid)]
+
+
+def pack_group(reqs: List[BatchRequest], shards: int = 1):
     """Stack one co-batch group's inputs along a new leading merge
-    axis, padded up :func:`batch_bucket` by replicating request 0 —
-    padding rows are inert by construction: every lane of the vmapped
+    axis, padded up :func:`batch_bucket` (rounded to a multiple of
+    ``shards``) by replicating request 0 into every unplaced row —
+    padding rows are inert by construction: every lane of the batched
     program is independent, and padded lanes' outputs are simply never
     scattered back to any request.
 
-    Returns ``((b, l, r, hash_tabs, digs_l, digs_r), padded_size)``.
+    Returns ``((b, l, r, hash_tabs, digs_l, digs_r), padded_size,
+    placement)`` where ``placement[i]`` is the packed row carrying
+    request ``i`` (see :func:`placement_for`).
     """
     valid = len(reqs)
-    padded = batch_bucket(valid)
-    order = list(range(valid)) + [0] * (padded - valid)
+    padded = batch_bucket(valid, shards)
+    placement = placement_for(valid, padded, shards)
+    order = [0] * padded
+    for i, row in enumerate(placement):
+        order[row] = i
 
     def stack(field: str):
         return jnp.stack([getattr(reqs[i], field) for i in order])
@@ -83,4 +116,4 @@ def pack_group(reqs: List[BatchRequest]):
     digs_l = np.stack([np.asarray(reqs[i].dig_l) for i in order])
     digs_r = np.stack([np.asarray(reqs[i].dig_r) for i in order])
     return ((stack("dev_b"), stack("dev_l"), stack("dev_r"),
-             stack("hash_tab"), digs_l, digs_r), padded)
+             stack("hash_tab"), digs_l, digs_r), padded, placement)

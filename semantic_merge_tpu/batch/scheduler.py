@@ -54,9 +54,15 @@ class BatchScheduler:
 
     def __init__(self, *, window_ms: Optional[float] = None,
                  max_batch: Optional[int] = None,
-                 max_inflight: Optional[int] = None) -> None:
+                 max_inflight: Optional[int] = None,
+                 mesh: Optional[str] = None) -> None:
         if window_ms is None:
             window_ms = _env_float(ENV_WINDOW_MS, 5.0)
+        #: Configured mesh posture (``[engine] mesh``) the dispatcher
+        #: reads when the SEMMERGE_MESH env var is unset — the daemon
+        #: threads its config through here so one posture governs both
+        #: the one-shot engine and the sharded batch dispatch.
+        self.mesh_config = mesh
         self.window_s = max(0.0, float(window_ms) / 1000.0)
         self.max_batch = max(1, max_batch if max_batch is not None
                              else _env_int(ENV_MAX_BATCH, 16))
@@ -134,8 +140,10 @@ class BatchScheduler:
             batches, requests = self._batches, self._requests
             waste_sum = self._waste_sum
         from ..ops.fused import batched_program_cache_stats
+        from .dispatcher import mesh_stats
         return {
             "queue_depth": self._queue.qsize(),
+            "mesh": mesh_stats(),
             "window_ms": self.window_s * 1e3,
             "max_batch": self.max_batch,
             "max_inflight": self.max_inflight,

@@ -34,6 +34,13 @@ Schema (superset of the reference's documented schema at reference
                                    # (0 => auto: min(8, cpu_count);
                                    # SEMMERGE_HOST_WORKERS overrides)
     max_nodes_per_bucket = 2048    # padding bucket sizes, powers of two
+    mesh = "auto"                  # mesh posture: "off" (single-device
+                                   # programs everywhere) | "auto"
+                                   # (mesh when usable, fall back on
+                                   # 1-chip hosts / build failure) |
+                                   # "require" (MeshFault, exit 18,
+                                   # when no mesh can be used);
+                                   # SEMMERGE_MESH overrides
     mesh_shape = "auto"            # or e.g. "dp=4,tp=2"
 
     [languages.typescript]
@@ -91,6 +98,11 @@ class EngineConfig:
     # both (see ops.fused.resolve_host_workers).
     host_workers: int = 0
     max_nodes_per_bucket: int = 2048
+    # Mesh posture (shared by the one-shot engine and the batching
+    # daemon's sharded dispatcher; the SEMMERGE_MESH env var — read
+    # through the per-request overlay — wins over this row). See
+    # parallel.mesh.MESH_POSTURES for the off|auto|require semantics.
+    mesh: str = "auto"
     mesh_shape: str = "auto"
     # Model-scored changeSignature pairing for renamed+retyped decls
     # (reference design architecture.md:145-153; needs change_signature).
@@ -192,6 +204,9 @@ def load_config(start: pathlib.Path | None = None) -> Config:
         max_nodes_per_bucket=int(
             engine.get("max_nodes_per_bucket", config.engine.max_nodes_per_bucket)
         ),
+        mesh=_validated(
+            str(engine.get("mesh", config.engine.mesh)).strip().lower(),
+            "engine.mesh", ("off", "auto", "require")),
         mesh_shape=str(engine.get("mesh_shape", config.engine.mesh_shape)),
         signature_matcher=bool(
             engine.get("signature_matcher", config.engine.signature_matcher)),

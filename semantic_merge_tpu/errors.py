@@ -30,6 +30,8 @@ code  meaning
 16    ``BatchFault`` — batched dispatch failed or posture unsatisfiable
 17    ``ResolveFault`` — conflict-resolution tier failed under
       ``--resolve require``
+18    ``MeshFault`` — a device mesh could not be built/used under
+      ``SEMMERGE_MESH=require``
 ====  =============================================================
 
 Codes 10-17 are only ever *exit* codes in strict mode (or, for
@@ -137,6 +139,18 @@ class ResolveFault(MergeFault):
     default_stage = "resolve"
 
 
+class MeshFault(MergeFault):
+    """A device mesh the ``SEMMERGE_MESH=require`` posture demands
+    could not be built or used (single-chip host, mesh construction
+    failure, or a mesh-sharded dispatch failure). Under the default
+    ``auto`` posture the mesh layers fall back to the single-device
+    programs instead — byte-identical output, never worse than a
+    1-chip run — so this fault only surfaces under ``require``."""
+
+    exit_code = 18
+    default_stage = "mesh"
+
+
 #: Fault class each pipeline stage wraps *unexpected* exceptions into.
 STAGE_FAULTS = {
     "snapshot": ParseFault,
@@ -160,6 +174,12 @@ STAGE_FAULTS = {
     "batch:pack": BatchFault,
     "batch:dispatch": BatchFault,
     "batch:scatter": BatchFault,
+    # The mesh-sharded batched program: a request-side batch:mesh fault
+    # degrades that one request to the inline dispatch like any other
+    # batch stage; the leader-side mesh build itself raises MeshFault
+    # (under SEMMERGE_MESH=require) with its own stage "mesh".
+    "batch:mesh": BatchFault,
+    "mesh": MeshFault,
     # Conflict-resolution tier (resolve/): propose/verify classify as
     # ResolveFault so the CLI's containment (auto → conflict-as-result,
     # require → exit 17) sees one fault type for the whole tier.
@@ -177,7 +197,8 @@ STAGE_FAULTS = {
 #: The documented fault exit codes, by class name (runbook table).
 EXIT_CODES = {cls.__name__: cls.exit_code for cls in
               (ParseFault, KernelFault, WorkerFault, ApplyFault,
-               FormatFault, DeadlineFault, BatchFault, ResolveFault)}
+               FormatFault, DeadlineFault, BatchFault, ResolveFault,
+               MeshFault)}
 
 
 def fault_for_stage(stage: str) -> type:

@@ -107,8 +107,18 @@ def train_matcher(cfg: TrainConfig, mesh=None, *, resume: bool = True):
             specs = param_specs(cfg.matcher.encoder)
             params = {k: jax.device_put(v, mesh.sharding(*specs[k]))
                       for k, v in params.items()}
+            # The opt_state must stay UNCOMMITTED (host arrays): the
+            # jitted step leaves its opt_state shardings unpinned, so
+            # GSPMD chooses layouts that follow the backward pass — not
+            # the param specs — and donation requires the input buffer
+            # to carry the exact per-device shape of its aliased
+            # output. Committing restored moments to any pre-chosen
+            # sharding (replicated or param-spec) trips the resume-only
+            # "Expected aliased input ... same size" XLA crash; host
+            # arrays let the step lay them out exactly as the
+            # uninterrupted run's first step did.
             opt_state = jax.tree.map(
-                lambda leaf: jax.device_put(leaf, mesh.replicated()), opt_state)
+                lambda leaf: np.asarray(jax.device_get(leaf)), opt_state)
             start_step = latest
             logger.info("resumed matcher training at step %d from %s",
                         start_step, cfg.ckpt_dir)

@@ -45,6 +45,16 @@ MIN_PHASE_MS = 5.0
 #: Units where a larger number is better.
 _HIGHER_BETTER_SUFFIXES = ("/sec", "/s")
 
+#: Bench-record fields (beyond the headline ``value``) carried into
+#: baseline entries and compared with the headline tolerance. The
+#: direction is explicit because these are unitless ratios, not
+#: suffix-typed rates: the batchserve chips axis must not silently
+#: lose mesh scaling efficiency or per-chip throughput.
+GUARDED_FIELDS = {
+    "scaling_efficiency": "higher",
+    "merges_per_sec_per_chip": "higher",
+}
+
 
 def higher_is_better(unit: str) -> bool:
     return str(unit).endswith(_HIGHER_BETTER_SUFFIXES)
@@ -70,6 +80,10 @@ def normalize_record(record: dict, *, source: Optional[str] = None
     if isinstance(phases, dict) and phases:
         entry["phases_ms"] = {str(k): float(v)
                               for k, v in sorted(phases.items())}
+    guarded = {name: float(record[name]) for name in sorted(GUARDED_FIELDS)
+               if isinstance(record.get(name), (int, float))}
+    if guarded:
+        entry["guarded"] = guarded
     if record.get("error"):
         entry["error"] = str(record["error"])
     if source:
@@ -164,6 +178,19 @@ def compare_entry(key: str, current: dict, baseline: dict, *,
         "tolerance_pct": tolerance_pct,
         "regression": bad > tolerance_pct,
     })
+    base_guarded = baseline.get("guarded") or {}
+    cur_guarded = current.get("guarded") or {}
+    for name in sorted(set(base_guarded) & set(cur_guarded)):
+        bg, cg = float(base_guarded[name]), float(cur_guarded[name])
+        gdelta = _delta_pct(cg, bg)
+        gbad = -gdelta if GUARDED_FIELDS.get(name) == "higher" else gdelta
+        findings.append({
+            "key": key, "field": f"guarded.{name}", "unit": "ratio",
+            "current": cg, "baseline": bg,
+            "delta_pct": round(gdelta, 2),
+            "tolerance_pct": tolerance_pct,
+            "regression": gbad > tolerance_pct,
+        })
     base_phases = baseline.get("phases_ms") or {}
     cur_phases = current.get("phases_ms") or {}
     for phase in sorted(set(base_phases) & set(cur_phases)):

@@ -739,22 +739,32 @@ def _sharded_fn(mesh, nb: int, nl: int, nr: int,
 # O(log), so a warm daemon compiles a handful of variants ever.
 
 _batch_prog_lock = threading.Lock()
-_batch_progs: "OrderedDict[Tuple[int, int, int, int, int], object]" = \
-    OrderedDict()
+_batch_progs: "OrderedDict[Tuple, object]" = OrderedDict()
 _batch_prog_hits = 0
 _batch_prog_misses = 0
 _batch_prog_evictions = 0
 
 
-def batched_fused_program(B: int, nb: int, nl: int, nr: int, C: int):
+def batched_fused_program(B: int, nb: int, nl: int, nr: int, C: int,
+                          mesh=None):
     """The jitted batched fused-merge program for one bucket shape:
     maps ``(b[B,4,nb], l[B,4,nl], r[B,4,nr], hash_tab[B,cap,10],
     dig_l[B,16], dig_r[B,16])`` to the ``[B, 8 + 24C]`` stack of
     one-buffer packed rows (``split=False`` layout). The cache is an
     LRU bounded at ``SEMMERGE_PROG_CACHE`` entries with evictions
-    counted (``program_cache_evictions_total{cache="batched"}``)."""
+    counted (``program_cache_evictions_total{cache="batched"}``).
+
+    With ``mesh`` (the 1-axis dispatch mesh of
+    :func:`semantic_merge_tpu.parallel.mesh.build_batch_mesh`) the
+    vmapped body runs under ``shard_map`` partitioning the leading
+    merge axis across the mesh — ``B`` must be a multiple of the axis
+    size (the packer's ``batch_bucket(n, shards)`` ladder guarantees
+    it). Lanes are independent and no collective crosses the axis, so
+    every row is bit-identical to the single-device program's. The
+    cache key includes the mesh, so single-device and per-mesh-shape
+    variants coexist under the same LRU bound."""
     global _batch_prog_hits, _batch_prog_misses, _batch_prog_evictions
-    key = (B, nb, nl, nr, C)
+    key = (B, nb, nl, nr, C, mesh)
     with _batch_prog_lock:
         prog = _batch_progs.get(key)
         if prog is not None:
@@ -768,7 +778,18 @@ def batched_fused_program(B: int, nb: int, nl: int, nr: int, C: int):
                                    dig_l, dig_r, nb=nb, nl=nl, nr=nr,
                                    C=C, split=False)
 
-    prog = jax.jit(jax.vmap(one))
+    vmapped = jax.vmap(one)
+    if mesh is None:
+        prog = jax.jit(vmapped)
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import BATCH_AXIS
+        from ..utils.jaxenv import shard_map_compat
+        row = P(BATCH_AXIS)
+        prog = jax.jit(shard_map_compat(
+            vmapped, mesh=mesh, in_specs=(row,) * 6, out_specs=row,
+            check_vma=False))
     evicted = 0
     with _batch_prog_lock:
         prog = _batch_progs.setdefault(key, prog)

@@ -26,6 +26,93 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 MESH_AXES = ("dp", "pp", "sp", "tp", "ep")
 
+#: Axis name of the continuous-batching dispatch mesh — the packed
+#: merge axis of the batched fused program (batch/dispatcher.py) shards
+#: over it, one lane group per chip. Distinct from the 5-axis engine
+#: mesh above: the batch mesh is 1-D by construction.
+BATCH_AXIS = "batch"
+
+#: Documented ``SEMMERGE_MESH`` postures (``[engine] mesh`` in
+#: ``.semmerge.toml``; the env var, read through the per-request
+#: overlay, wins over the config row):
+#:
+#: - ``off``     — pin the single-device programs everywhere: the merge
+#:   kernels stay unsharded even on a multi-chip host and the batched
+#:   dispatcher keeps its single-device vmapped program;
+#: - ``auto``    — (default) use a mesh when one is usable: the one-shot
+#:   engine dp-shards a merge's decl axis, the batching daemon shards
+#:   the packed merge axis across chips; 1-chip hosts and any
+#:   mesh-build failure fall back to the single-device programs
+#:   (byte-identical output, never worse than ``off``);
+#: - ``require`` — a mesh must be used; failure raises a typed
+#:   :class:`~semantic_merge_tpu.errors.MeshFault` (exit 18 strict).
+MESH_POSTURES = ("off", "auto", "require")
+
+#: Pre-posture spellings of "off" (kept working; a deprecation note is
+#: logged once per process so deployments migrate to the posture
+#: vocabulary).
+_LEGACY_OFF_ALIASES = ("none", "single", "0")
+
+_warned_aliases: set = set()
+
+
+def mesh_posture(configured: str | None = None) -> str:
+    """The effective ``SEMMERGE_MESH`` posture: the env var (through the
+    per-request overlay, so a daemon honors a client's setting) when
+    set, else the ``[engine] mesh`` config value, else ``auto``.
+    Legacy aliases ``none``/``single``/``0`` read as ``off`` with a
+    one-time deprecation note; unknown values read as ``auto``."""
+    from ..utils import reqenv
+    raw = (reqenv.get("SEMMERGE_MESH") or "").strip().lower()
+    if not raw:
+        raw = (configured or "auto").strip().lower()
+    if raw in _LEGACY_OFF_ALIASES:
+        if raw not in _warned_aliases:
+            _warned_aliases.add(raw)
+            from ..utils.loggingx import logger
+            logger.warning(
+                "SEMMERGE_MESH=%s is a deprecated alias of 'off' — use "
+                "off|auto|require (see runbook 'Environment variables')",
+                raw)
+        return "off"
+    return raw if raw in MESH_POSTURES else "auto"
+
+
+def batch_mesh_shards(devices: Sequence[jax.Device] | None = None) -> int:
+    """Batch-axis size for :func:`build_batch_mesh`: the largest power
+    of two ≤ the local device count (the merge-axis bucket ladder is
+    power-of-two, so a pow2 axis always divides the padded batch)."""
+    n = len(jax.devices() if devices is None else devices)
+    shards = 1
+    while shards * 2 <= n:
+        shards *= 2
+    return shards
+
+
+def build_batch_mesh(devices: Sequence[jax.Device] | None = None,
+                     *, shards: int | None = None) -> Mesh:
+    """The 1-axis dispatch mesh of the continuous-batching subsystem:
+    ``shards`` devices (default :func:`batch_mesh_shards`) under the
+    single :data:`BATCH_AXIS` axis. The batched fused program shards
+    its packed leading merge axis over it; lanes are independent, so
+    no collectives cross the axis and the rows are bit-identical to
+    the single-device vmapped program's."""
+    if devices is None:
+        devices = jax.devices()
+    if shards is None:
+        shards = batch_mesh_shards(devices)
+    if shards < 1 or shards > len(devices):
+        raise ValueError(f"batch mesh wants {shards} of "
+                         f"{len(devices)} devices")
+    arr = np.asarray(list(devices[:shards]))
+    from ..obs import event as obs_event, metrics as obs_metrics
+    obs_metrics.REGISTRY.gauge(
+        "semmerge_batch_mesh_shards",
+        "Batch-axis size of the last batch dispatch mesh built"
+    ).set(shards)
+    obs_event("batch_mesh_built", devices=len(devices), shards=shards)
+    return Mesh(arr, (BATCH_AXIS,))
+
 
 @dataclass
 class MergeMesh:

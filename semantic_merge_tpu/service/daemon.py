@@ -245,9 +245,21 @@ class Daemon:
         enable_compile_cache()
         # Continuous batching: one process-global micro-batch scheduler
         # coalesces concurrent requests' fused dispatches. Activated
-        # only here — one-shot CLI processes never batch.
+        # only here — one-shot CLI processes never batch. The [engine]
+        # mesh posture rides along so the dispatcher can shard the
+        # packed merge axis across the host's chips (SEMMERGE_MESH
+        # still wins inside mesh_posture).
         from .. import batch
-        batch.activate()
+        from ..config import load_config
+        from ..parallel.mesh import mesh_posture
+        try:
+            mesh_cfg = load_config().engine.mesh
+        except Exception:  # config errors surface per request, not here
+            mesh_cfg = None
+        batch.activate(mesh=mesh_cfg)
+        import jax
+        logger.info("batch dispatch mesh posture: %s (%d local device(s))",
+                    mesh_posture(mesh_cfg), len(jax.devices()))
         for _ in range(self._workers_n):
             threading.Thread(target=self._executor, daemon=True).start()
         if self._repo_ttl > 0:

@@ -42,7 +42,7 @@ service:execute      executor thread, inside the execute span
 ===================  ==================================================
 
 Continuous-batching stages (``semantic_merge_tpu/batch/``) parse the
-same way (``SEMMERGE_FAULT=batch:pack:fault`` …). All three fire on the
+same way (``SEMMERGE_FAULT=batch:pack:fault`` …). All four fire on the
 *request's* thread, where its env overlay is in scope — so a batch
 fault lands the affected request alone on the inline unbatched path
 (posture ``auto``) or its documented exit code (``require`` + strict),
@@ -52,6 +52,9 @@ while co-batched requests complete normally:
 stage                call site
 ===================  ==================================================
 batch:pack           ``batch.dispatcher.submit_request`` (pre-enqueue)
+batch:mesh           ``batch.dispatcher.collect_request`` (mesh seam;
+                     also counts a ``batch_mesh_fallbacks_total``
+                     ``reason="fault"`` increment)
 batch:dispatch       ``batch.dispatcher.collect_request`` (await row)
 batch:scatter        ``batch.dispatcher.collect_request`` (row fetch)
 ===================  ==================================================

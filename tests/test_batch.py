@@ -195,7 +195,10 @@ def test_mixed_repos_one_window_parity(single_device):
 def test_midflight_fault_degrades_only_affected_request(single_device, stage):
     """A batching fault on ONE member of a window degrades that request
     to the inline unbatched dispatch; its co-batched neighbour completes
-    normally. Both results stay byte-identical to the unbatched run."""
+    normally. Both results stay byte-identical to the unbatched run.
+    (The fourth request-side stage, ``batch:mesh``, is drilled end-to-
+    end in test_faults.py — same degradation contract plus the
+    fallback-counter increment.)"""
     snaps = synth_repo(4, 2)
     want = baseline(snaps)
     degraded_before = outcome_total("degraded")
@@ -445,6 +448,184 @@ def test_wire_resolve_parity_on_batched_path(tmp_path, daemon_factory):
     status = service_client.call_control("status", path=sock)
     assert status["batch"]["requests_batched"] >= 1, \
         "require posture must land the resolver merge on the batched path"
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded dispatch (ISSUE 13 tentpole): byte parity vs single-device
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def mesh_batching(monkeypatch):
+    """Mesh posture ON for the dispatcher while every backend stays
+    batch-eligible: the test backends are built ``mesh=False``
+    explicitly, so the 8 virtual devices (conftest) belong to the
+    batch mesh alone."""
+    monkeypatch.delenv("SEMMERGE_MESH", raising=False)
+    faults.reset()
+    yield monkeypatch
+    batch.deactivate()
+    faults.reset()
+
+
+@pytest.mark.slow
+def test_mesh_cobatch_parity(mesh_batching):
+    """The mesh-sharded batched program is byte-identical to the
+    unbatched single-device run for a padding-heavy co-batch (2 same-
+    shape merges on an 8-chip mesh pad to 8 rows) and a conflict-
+    bearing one. Run under ``require`` — the posture that faults
+    rather than silently narrowing, so a mesh that failed to form
+    cannot fake parity. ``auto`` takes the identical code path once
+    the mesh forms; its fallback branches are covered by
+    test_mesh_require_unsatisfiable_on_single_chip,
+    test_mesh_posture_parsing, and the test_faults.py mesh drill.
+    Bucket-straddling and resolver-active meshed co-batches live in
+    the slow tier (the wire tests below)."""
+    posture = "require"
+    mesh_batching.setenv("SEMMERGE_MESH", posture)
+    scenarios = [
+        synth_repo(4, 2), synth_repo(4, 2),
+        synth_repo(6, 2, divergent=True),    # conflict-bearing
+    ]
+    want = [baseline(s) for s in scenarios]
+    assert want[2][3], "the divergent scenario must carry a conflict"
+    with active_batching(window_ms=100.0) as sched:
+        got = run_concurrent([(s, None) for s in scenarios])
+        stats = sched.stats()
+    for i, fp in enumerate(got):
+        assert fp == want[i], \
+            f"scenario {i} diverged from its unbatched run under {posture}"
+    mesh = stats["mesh"]
+    assert mesh["mesh_dispatches"] >= 1, \
+        "the packed merge axis must actually shard across the chips"
+    assert mesh["last_shape"] == "batch=8"
+    assert sum(mesh["last_chip_rows"]) >= 1
+    assert stats["requests_batched"] == len(scenarios)
+    occupancy = obs_metrics.REGISTRY.gauge(
+        "batch_mesh_occupancy_ratio").value()
+    assert 0.0 < occupancy <= 1.0
+
+
+@pytest.mark.slow
+def test_wire_mesh_resolver_parity(tmp_path, daemon_factory):
+    """An ACTIVE search resolver rides the mesh-sharded batched path
+    byte-identically: rows scatter per request, so the resolver tier
+    runs on the request thread exactly as it does single-device — same
+    merged tree, same audited conflicts artifact."""
+    from semantic_merge_tpu.service import client as service_client
+    sock = str(tmp_path / "meshres.sock")
+    daemon_factory(sock, extra_env={
+        "SEMMERGE_MESH": "require",
+        "SEMMERGE_BATCH_WINDOW_MS": "5",
+    })
+    one = _make_resolve_repo(tmp_path / "oneshot")
+    two = _make_resolve_repo(tmp_path / "meshed")
+    argv = [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+            "basebr", "brA", "brB", "--inplace", "--backend", "tpu"]
+
+    env_one = dict(os.environ)
+    env_one.update({"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+                    "SEMMERGE_DAEMON": "off", "SEMMERGE_MESH": "off",
+                    "SEMMERGE_RESOLVE": "auto"})
+    env_one.pop("SEMMERGE_FAULT", None)
+    proc = subprocess.run(argv, cwd=one, capture_output=True, text=True,
+                          env=env_one)
+    assert proc.returncode == 0, f"one-shot resolve failed: {proc.stderr}"
+
+    proc = subprocess.run(argv, cwd=two, capture_output=True, text=True,
+                          env=_wire_env(sock, SEMMERGE_BATCH="require",
+                                        SEMMERGE_MESH="require",
+                                        SEMMERGE_RESOLVE="auto"))
+    assert proc.returncode == 0, \
+        f"mesh resolve over the wire failed: {proc.stderr}"
+    assert (two / "src/util.ts").read_text() == \
+        (one / "src/util.ts").read_text()
+    assert _normalized_artifact(two / ".semmerge-conflicts.json") == \
+        _normalized_artifact(one / ".semmerge-conflicts.json")
+    status = service_client.call_control("status", path=sock)
+    assert status["batch"]["mesh"]["mesh_dispatches"] >= 1
+
+
+def test_mesh_require_unsatisfiable_on_single_chip(mesh_batching):
+    """Leader-side planning: a 1-chip host under ``require`` raises
+    the typed MeshFault (exit 18); ``auto`` falls back to the
+    single-device program and counts the fallback."""
+    from semantic_merge_tpu.batch import dispatcher
+    from semantic_merge_tpu.errors import MeshFault
+    from semantic_merge_tpu.parallel import mesh as mesh_mod
+    mesh_batching.setattr(mesh_mod, "batch_mesh_shards",
+                          lambda devices=None: 1)
+    fallbacks = obs_metrics.REGISTRY.counter("batch_mesh_fallbacks_total")
+    before = fallbacks.value(reason="single-device")
+    with pytest.raises(MeshFault) as exc_info:
+        dispatcher._plan_mesh("require")
+    assert exc_info.value.exit_code == 18
+    assert dispatcher._plan_mesh("auto") == (None, 1)
+    assert fallbacks.value(reason="single-device") >= before + 2
+
+
+def test_mesh_posture_parsing(mesh_batching):
+    """One posture definition: env overlay wins over the configured
+    value, legacy off-aliases keep working, unknown values read as
+    ``auto``."""
+    from semantic_merge_tpu.parallel.mesh import mesh_posture
+    assert mesh_posture() == "auto"
+    assert mesh_posture("require") == "require"
+    assert mesh_posture("off") == "off"
+    for alias in ("none", "single", "0"):
+        mesh_batching.setenv("SEMMERGE_MESH", alias)
+        assert mesh_posture() == "off", f"legacy alias {alias!r}"
+        assert mesh_posture("require") == "off", "env must beat config"
+    mesh_batching.setenv("SEMMERGE_MESH", "bogus")
+    assert mesh_posture() == "auto"
+    with reqenv.overlay({"SEMMERGE_MESH": "require"}):
+        assert mesh_posture("off") == "require", \
+            "the per-request overlay must win over config"
+
+
+@pytest.mark.slow
+def test_wire_mesh_parity_and_status(tmp_path, daemon_factory):
+    """Over-the-wire mesh parity: the same repo merged one-shot
+    (mesh off) and through a SEMMERGE_MESH=require daemon on the
+    batched path yields byte-identical trees, and the daemon status
+    exposes the mesh shape, per-chip occupancy and fallback counts."""
+    from semantic_merge_tpu.service import client as service_client
+    sock = str(tmp_path / "mesh.sock")
+    daemon_factory(sock, extra_env={
+        "SEMMERGE_MESH": "require",
+        "SEMMERGE_BATCH_WINDOW_MS": "5",
+    })
+    one = _make_repo(tmp_path / "oneshot_repo")
+    two = _make_repo(tmp_path / "mesh_repo")
+    argv = [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+            "basebr", "brA", "brB", "--inplace", "--backend", "tpu"]
+
+    env_one = dict(os.environ)
+    env_one.update({"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+                    "SEMMERGE_DAEMON": "off", "SEMMERGE_MESH": "off"})
+    env_one.pop("SEMMERGE_FAULT", None)
+    proc = subprocess.run(argv, cwd=one, capture_output=True, text=True,
+                          env=env_one)
+    assert proc.returncode == 0, f"one-shot merge failed: {proc.stderr}"
+
+    proc = subprocess.run(argv, cwd=two, capture_output=True, text=True,
+                          env=_wire_env(sock, SEMMERGE_BATCH="require",
+                                        SEMMERGE_MESH="require"))
+    assert proc.returncode == 0, \
+        f"mesh-require merge over the wire failed: {proc.stderr}"
+
+    for rel in ("src/util.ts", "extra.ts"):
+        assert (two / rel).read_bytes() == (one / rel).read_bytes(), \
+            f"{rel}: mesh and single-device trees must be byte-identical"
+
+    status = service_client.call_control("status", path=sock)
+    mesh = status["batch"]["mesh"]
+    assert mesh["posture"] == "require"
+    assert mesh["mesh_dispatches"] >= 1, \
+        "require posture must land on the mesh-sharded program"
+    assert mesh["last_shape"] == "batch=8"
+    assert sum(mesh["last_chip_rows"]) >= 1
+    assert "dispatch-error" not in mesh["fallbacks"], \
+        "the mesh program must not silently fall back per dispatch"
 
 
 # ---------------------------------------------------------------------------

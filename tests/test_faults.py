@@ -223,7 +223,8 @@ def test_exit_codes_documented_and_distinct():
     assert EXIT_CODES == {"ParseFault": 10, "KernelFault": 11,
                           "WorkerFault": 12, "ApplyFault": 13,
                           "FormatFault": 14, "DeadlineFault": 15,
-                          "BatchFault": 16, "ResolveFault": 17}
+                          "BatchFault": 16, "ResolveFault": 17,
+                          "MeshFault": 18}
     assert len(set(EXIT_CODES.values())) == len(EXIT_CODES)
     # Reserved result codes stay distinct from fault codes.
     assert not {0, 1, 2, 3} & set(EXIT_CODES.values())
@@ -313,10 +314,15 @@ def test_service_stages_registered_as_worker_faults():
 # ---------------------------------------------------------------------------
 
 def test_batch_stages_registered_as_batch_faults():
-    from semantic_merge_tpu.errors import STAGE_FAULTS, BatchFault
+    from semantic_merge_tpu.errors import STAGE_FAULTS, BatchFault, MeshFault
     assert BatchFault.exit_code == 16
-    for stage in ("batch", "batch:pack", "batch:dispatch", "batch:scatter"):
+    for stage in ("batch", "batch:pack", "batch:dispatch", "batch:scatter",
+                  "batch:mesh"):
         assert STAGE_FAULTS[stage] is BatchFault
+    # The leader-side mesh contract has its own typed fault: exit 18,
+    # only ever surfaced under SEMMERGE_MESH=require.
+    assert STAGE_FAULTS["mesh"] is MeshFault
+    assert MeshFault.exit_code == 18
     # The compound stage survives SEMMERGE_FAULT's colon syntax.
     faults.reset()
     try:
@@ -330,7 +336,8 @@ def test_batch_stages_registered_as_batch_faults():
         faults.reset()
 
 
-BATCH_FAULT_STAGES = ["batch:pack", "batch:dispatch", "batch:scatter"]
+BATCH_FAULT_STAGES = ["batch:pack", "batch:mesh", "batch:dispatch",
+                      "batch:scatter"]
 
 
 @pytest.mark.parametrize("stage", BATCH_FAULT_STAGES)
@@ -370,6 +377,29 @@ def test_batch_stage_fault_strict_require_exits_16(repo, monkeypatch, stage):
         batch.deactivate()
     assert rc == BatchFault.exit_code
     assert tree_state(repo) == before
+
+
+def test_batch_mesh_fault_counts_fallback_and_degrades(repo, monkeypatch):
+    """The ``batch:mesh`` stage is the mesh seam of the batched path:
+    an injected fault there degrades THIS request to the inline
+    dispatch (merge still exact) AND increments the
+    ``batch_mesh_fallbacks_total{reason="fault"}`` counter the mesh
+    runbook keys its fallback alerting on."""
+    from semantic_merge_tpu import batch
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    expected = expected_textual_tree(repo)
+    monkeypatch.setenv("SEMMERGE_MESH", "off")  # single-device: eligible
+    monkeypatch.setenv("SEMMERGE_FAULT", "batch:mesh:fault")
+    counter = obs_metrics.REGISTRY.counter("batch_mesh_fallbacks_total")
+    before = counter.value(reason="fault")
+    batch.activate(window_ms=20.0)
+    try:
+        rc = run_merge_cli(backend="tpu")
+    finally:
+        batch.deactivate()
+    assert rc == 0, "batch:mesh fault must degrade to the inline dispatch"
+    assert tree_state(repo) == expected
+    assert counter.value(reason="fault") >= before + 1
 
 
 # ---------------------------------------------------------------------------

@@ -475,8 +475,10 @@ def test_batched_program_cache_bounded_lru(monkeypatch):
     assert stats["programs"] == 2
     assert stats["evictions"] == ev0 + 1
     with fused._batch_prog_lock:
-        assert (1, 1, 1, 1, 2) not in fused._batch_progs
-        assert (1, 1, 1, 1, 1) in fused._batch_progs
+        # program-cache keys carry the dispatch mesh (None =
+        # single-device) since the mesh-sharded batch PR
+        assert (1, 1, 1, 1, 2, None) not in fused._batch_progs
+        assert (1, 1, 1, 1, 1, None) in fused._batch_progs
     assert counter_series("program_cache_evictions_total",
                           cache="batched") == mev0 + 1
 

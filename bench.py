@@ -552,6 +552,7 @@ PRESETS = {
     "warmserve": {"files": 48, "decls": 4, "warmserve": True},
     "batchserve": {"files": 48, "decls": 4, "batchserve": True},
     "overload": {"files": 24, "decls": 4, "overload": True},
+    "fleet": {"files": 24, "decls": 4, "fleet": True},
     "tracecost": {"files": 10000, "decls": 4, "tracecost": True},
     "slocost": {"files": 10000, "decls": 4, "slocost": True},
     # resolve: files = number of independently-resolvable
@@ -1535,6 +1536,297 @@ def run_overload_bench(record: dict, args, json_only: bool = False) -> int:
         shutil.rmtree(scratch, ignore_errors=True)
 
 
+def run_fleet_bench(record: dict, args, json_only: bool = False) -> int:
+    """The ``fleet`` preset: what the consistent-hash router buys and
+    costs. Four phases, all subprocess-shaped (router + member daemons
+    spawned; the parent needs no accelerator):
+
+    1. throughput sweep at members in {1, 2, 3} (hedging off so every
+       merge runs exactly once) -> ``fleet_merges_per_sec_m1/2/3``;
+       headline value = merges/sec at 3 members, ``vs_baseline`` = the
+       m3/m1 scaling ratio.
+    2. SIGKILL the rendezvous owner of one repo mid-fleet and time
+       until that repo's next merge lands on the rehashed owner
+       -> ``fleet_failover_recovery_s``.
+    3. rendezvous rehash quality, measured over a 240-key population:
+       mean fraction of keys whose owner changes when one of three
+       members is lost -> ``fleet_rehash_miss_rate`` (a plain
+       mod-N ring would score ~1.0; rendezvous ~1/3).
+    4. fresh hedge-enabled fleet: wedge one repo's owner (single
+       worker + injected execute hang), fire reads at it, and report
+       ``fleet_hedge_win_rate`` = hedge wins / hedges launched.
+    """
+    import shutil
+    import signal as signal_mod
+    import subprocess
+    import tempfile
+    import threading
+
+    from semantic_merge_tpu.fleet import hashring
+    from semantic_merge_tpu.service import client as svc_client
+
+    scratch = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-fleet-"))
+    n_repos = 4
+    repos = []
+    for i in range(n_repos):
+        repo = scratch / f"repo{i}"
+        _build_service_repo(repo, args.files, args.decls)
+        repos.append(repo)
+
+    child_env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    prior_pp = child_env.get("PYTHONPATH", "")
+    child_env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{prior_pp}"
+                               if prior_pp else pkg_root)
+    child_env.update({
+        "SEMMERGE_DAEMON": "off",
+        "SEMMERGE_FLEET_HEALTH_INTERVAL": "0.2",
+        "SEMMERGE_SUPERVISE_BACKOFF": "0.1",
+        # One worker per member: the m1 -> m3 sweep then measures ring
+        # fan-out, not intra-member parallelism, and phase 4's wedge
+        # deterministically occupies the owner.
+        "SEMMERGE_SERVICE_WORKERS": "1",
+        "SEMMERGE_SERVICE_DRAIN_TIMEOUT": "2",
+    })
+    for key in ("SEMMERGE_FAULT", "SEMMERGE_METRICS",
+                "SEMMERGE_SERVICE_SOCKET", "SEMMERGE_FLEET",
+                "SEMMERGE_FLEET_MEMBERS", "SEMMERGE_FLEET_HEDGE",
+                "SEMMERGE_FLEET_HEDGE_MS"):
+        child_env.pop(key, None)
+    if os.environ.get("SEMMERGE_BENCH_PLATFORM") == "cpu":
+        child_env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn_router(sock, members, extra_env=None):
+        env = dict(child_env)
+        env.update(extra_env or {})
+        log = open(sock + ".log", "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "semantic_merge_tpu", "fleet",
+             "--socket", sock, "--members", str(members)],
+            stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+            cwd="/", env=env, start_new_session=True)
+        log.close()
+        return proc
+
+    def wait_fleet(sock, proc, members, timeout=240.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                return None, (f"router exited rc={proc.returncode} "
+                              f"during startup (log: {sock}.log)")
+            try:
+                status = svc_client.call_control("status", path=sock,
+                                                 timeout=10)
+            except Exception:
+                status = None
+            if status and status.get("fleet") \
+                    and status.get("members_up", 0) >= members:
+                return status, None
+            time.sleep(0.2)
+        return None, f"fleet of {members} not up within {timeout:g}s " \
+                     f"(log: {sock}.log)"
+
+    def call(sock, repo, *, extra_env=None, inplace=False, timeout=180):
+        argv = ["basebr", "brA", "brB", "--backend", "host"]
+        if inplace:
+            argv.insert(3, "--inplace")
+        return svc_client.call_verb(
+            "semmerge",
+            {"argv": argv, "cwd": str(repo), "env": extra_env or {},
+             "idempotency_key": f"bench-{os.urandom(8).hex()}"},
+            path=sock, timeout=timeout)
+
+    def teardown(proc, sock):
+        if proc is None or proc.poll() is not None:
+            return
+        proc.send_signal(signal_mod.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def fail(msg: str) -> int:
+        record["error"] = msg
+        emit_record(record)
+        return 1
+
+    def sweep(sock, total, concurrency):
+        """``total`` clean merges round-robined over the repos from
+        ``concurrency`` client threads; returns (merges/sec, errors)."""
+        work = [repos[i % n_repos] for i in range(total)]
+        lock = threading.Lock()
+        errors = []
+
+        def worker():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    repo = work.pop()
+                try:
+                    frame = call(sock, repo)
+                except Exception as exc:
+                    with lock:
+                        errors.append(f"sweep request died: {exc}")
+                    return
+                if (frame.get("result") or {}).get("exit_code") != 0:
+                    with lock:
+                        errors.append(f"sweep merge failed: "
+                                      f"{str(frame)[:200]}")
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t0
+        return (total / wall if wall else 0.0), errors
+
+    def counter_total(status, name):
+        metric = ((status or {}).get("metrics") or {}) \
+            .get("counters", {}).get(name, {})
+        return sum(s["value"] for s in metric.get("series", []))
+
+    router = sock = None
+    try:
+        # ----- phase 1: throughput sweep, hedging off -----
+        rates = {}
+        for n in (1, 2, 3):
+            sock = str(scratch / f"fleet-m{n}.sock")
+            router = spawn_router(sock, n,
+                                  {"SEMMERGE_FLEET_HEDGE": "off"})
+            status, err = wait_fleet(sock, router, n)
+            if err:
+                return fail(err)
+            for repo in repos:  # warm every member's first-merge path
+                frame = call(sock, repo)
+                if (frame.get("result") or {}).get("exit_code") != 0:
+                    return fail(f"warm-up merge failed at m{n}: "
+                                f"{str(frame)[:200]}")
+            rate, errors = sweep(sock, total=24, concurrency=6)
+            if errors:
+                return fail(f"m{n} sweep: " + "; ".join(errors[:3]))
+            rates[n] = rate
+            record[f"fleet_merges_per_sec_m{n}"] = round(rate, 2)
+            if not json_only:
+                print(f"# fleet m{n}: {rate:6.2f} merges/sec",
+                      file=sys.stderr)
+            if n < 3:
+                teardown(router, sock)
+                router = None
+
+        # ----- phase 2: failover recovery on the 3-member fleet -----
+        status, err = wait_fleet(sock, router, 3)
+        if err:
+            return fail(err)
+        ring = [m["id"] for m in status.get("members", [])
+                if m.get("in_ring")]
+        victim_id = hashring.owner(hashring.repo_key(str(repos[0])), ring)
+        victim_pid = next((m["pid"] for m in status["members"]
+                           if m["id"] == victim_id and m.get("pid")),
+                          None)
+        if victim_pid is None:
+            return fail(f"owner {victim_id} of repo0 has no live pid")
+        t0 = time.perf_counter()
+        os.kill(victim_pid, signal_mod.SIGKILL)
+        recovery_s = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                frame = call(sock, repos[0], timeout=60)
+            except Exception:
+                time.sleep(0.1)
+                continue
+            if (frame.get("result") or {}).get("exit_code") == 0:
+                recovery_s = time.perf_counter() - t0
+                break
+            time.sleep(0.1)
+        if recovery_s is None:
+            return fail("repo0 merge did not recover within 120s of "
+                        "its owner's SIGKILL")
+        record["fleet_failover_recovery_s"] = round(recovery_s, 3)
+        status = svc_client.call_control("status", path=sock, timeout=30)
+        failovers = counter_total(status, "fleet_failovers_total")
+        teardown(router, sock)
+        router = None
+
+        # ----- phase 3: rendezvous rehash quality (analytic) -----
+        ids = [f"m{i}" for i in range(3)]
+        keys = [f"/bench/repo-{i:03d}" for i in range(240)]
+        moved = 0
+        for gone in ids:
+            survivors = [m for m in ids if m != gone]
+            moved += sum(1 for k in keys
+                         if hashring.owner(k, ids)
+                         != hashring.owner(k, survivors))
+        miss_rate = moved / (len(keys) * len(ids))
+        record["fleet_rehash_miss_rate"] = round(miss_rate, 4)
+
+        # ----- phase 4: hedge win rate on a fresh hedge-enabled fleet --
+        sock = str(scratch / "fleet-hedge.sock")
+        router = spawn_router(sock, 3,
+                              {"SEMMERGE_FLEET_HEDGE_MS": "50"})
+        status, err = wait_fleet(sock, router, 3)
+        if err:
+            return fail(err)
+        for repo in repos:
+            call(sock, repo)  # warm (may hedge; counters reset below)
+        status = svc_client.call_control("status", path=sock, timeout=30)
+        hedges0 = counter_total(status, "fleet_hedges_total")
+        wins0 = counter_total(status, "fleet_hedge_wins_total")
+        # Wedge repo1's owner: --inplace never hedges, so the injected
+        # 20s execute hang pins the owner's single worker.
+        def wedge_owner():
+            try:
+                call(sock, repos[1], inplace=True, timeout=60,
+                     extra_env={"SEMMERGE_FAULT":
+                                "service:execute:hang=20"})
+            except Exception:
+                pass  # torn down mid-hang by design
+
+        wedge = threading.Thread(target=wedge_owner, daemon=True)
+        wedge.start()
+        time.sleep(0.5)
+        hedge_ok = 0
+        for _ in range(4):
+            frame = call(sock, repos[1], timeout=60)
+            if (frame.get("result") or {}).get("exit_code") == 0:
+                hedge_ok += 1
+        status = svc_client.call_control("status", path=sock, timeout=30)
+        hedges = counter_total(status, "fleet_hedges_total") - hedges0
+        wins = counter_total(status, "fleet_hedge_wins_total") - wins0
+        if hedges < 1 or hedge_ok < 1:
+            return fail(f"wedged owner produced no hedges "
+                        f"(hedges={hedges}, ok={hedge_ok})")
+        win_rate = wins / hedges if hedges else 0.0
+        record["fleet_hedge_win_rate"] = round(win_rate, 4)
+
+        record["metric"] = (
+            f"merges/sec through a 3-member fleet router (rendezvous "
+            f"affinity, hedging off, {n_repos} repos x {args.files} "
+            f"files x {args.decls} decls, host backend, 1 worker/member)")
+        record["value"] = round(rates[3], 2)
+        record["unit"] = "merges/sec"
+        record["vs_baseline"] = round(
+            rates[3] / rates[1], 3) if rates[1] else 0.0
+        if not json_only:
+            print(f"# failover recovery: {recovery_s:6.3f} s "
+                  f"(failovers counted: {failovers:.0f})",
+                  file=sys.stderr)
+            print(f"# rehash miss rate: {miss_rate:.3f} "
+                  f"(mod-N ring would be ~1.0)", file=sys.stderr)
+            print(f"# hedge win rate: {win_rate:.3f} "
+                  f"({wins:.0f}/{hedges:.0f} hedges, "
+                  f"{hedge_ok}/4 wedged reads served)", file=sys.stderr)
+        emit_record(record)
+        return 0
+    finally:
+        teardown(router, sock)
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
 def run_incremental_bench(record: dict, args, n_changed: int,
                           json_only: bool = False) -> int:
     """The rung5i scenario: a 10k-file tree where only ``n_changed``
@@ -1673,6 +1965,10 @@ def main() -> int:
         # Same shape again: admission control, breakers, and RSS are
         # all exercised inside the spawned daemon.
         return run_overload_bench(record, args, json_only=args.json_only)
+    if args.preset == "fleet":
+        # Router + member daemons are all subprocesses; the parent
+        # needs no accelerator.
+        return run_fleet_bench(record, args, json_only=args.json_only)
     if args.preset == "resolve":
         # One-shot CLI subprocesses on the host backend: the parent
         # needs no accelerator.

@@ -31,6 +31,15 @@ Run standalone::
 Exit 0 when every invariant holds, 1 otherwise. The tier-1 smoke
 (``tests/test_chaos.py``) imports :func:`run_soak` directly; the
 slow-marked full soak runs a longer schedule with memory pressure.
+
+``--fleet`` switches to the ISSUE 14 kill-drill: a ``semmerge fleet``
+router fronting N member daemons takes the same byte-exact traffic
+while random members — and, separately, the router itself — are
+SIGKILLed mid-stream. The replacement router reclaims the orphaned
+members and replays its dispatch WAL; :func:`audit_wal` then walks the
+full retained journal history to prove every effect was accounted for
+exactly once (no duplicate ``--inplace`` effect from a replay or a
+failover re-dispatch).
 """
 from __future__ import annotations
 
@@ -534,6 +543,283 @@ def run_soak(workdir: pathlib.Path, *, requests: int = 200, repos: int = 8,
     return report
 
 
+# ---------------------------------------------------------------------------
+# Fleet soak (ISSUE 14): member + router SIGKILLs, WAL replay audit
+# ---------------------------------------------------------------------------
+
+#: Fleet-soak request shapes: only exit-0 traffic, because the fleet
+#: invariant under test is exactly-once *effects* — every settled tree
+#: byte-exact, no duplicate inplace effect from a WAL replay or a
+#: failover re-dispatch.
+FLEET_SHAPES = [
+    ("clean", {}, {0}),
+    ("degrade-scan", {"SEMMERGE_FAULT": "scan:raise"}, {0}),
+]
+
+
+def spawn_fleet_router(sock_path: str, *, members: int = 3,
+                       extra_env: Optional[Dict[str, str]] = None
+                       ) -> subprocess.Popen:
+    """Start a ``semmerge fleet`` router fronting ``members`` supervised
+    member daemons."""
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": str(REPO_ROOT),
+        "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+        "SEMMERGE_DAEMON": "off",
+        "SEMMERGE_FLEET_HEALTH_INTERVAL": "0.2",
+        "SEMMERGE_SUPERVISE_BACKOFF": "0.1",
+    })
+    for key in ("SEMMERGE_FAULT", "SEMMERGE_STRICT", "SEMMERGE_RESOLVE",
+                "SEMMERGE_METRICS", "SEMMERGE_SERVICE_SOCKET"):
+        env.pop(key, None)
+    if extra_env:
+        env.update(extra_env)
+    log = open(sock_path + ".log", "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "semantic_merge_tpu", "fleet",
+         "--socket", sock_path, "--members", str(members)],
+        stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+        cwd="/", env=env, start_new_session=True)
+    log.close()
+    return proc
+
+
+def wait_fleet(sock_path: str, router: subprocess.Popen,
+               min_members: int, timeout: float = 240.0) -> dict:
+    """Wait until the router answers ``status`` with ``fleet: true``
+    and at least ``min_members`` ring members."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if router.poll() is not None:
+            raise RuntimeError(f"fleet router exited rc="
+                               f"{router.returncode} "
+                               f"(log: {sock_path}.log)")
+        status = daemon_status(sock_path)
+        if status and status.get("fleet") \
+                and status.get("members_up", 0) >= min_members:
+            return status
+        time.sleep(0.2)
+    raise RuntimeError(f"fleet not up within {timeout:g}s "
+                       f"(log: {sock_path}.log)")
+
+
+def audit_wal(wal_dir: str) -> List[str]:
+    """Exactly-once accounting over the full retained WAL history.
+
+    Invariants: only documented record kinds; every ``dispatch`` and
+    ``ack`` names a journaled request; retries and carried-forward
+    replays of one key always journal the *same* request (same verb +
+    params — two different requests under one idempotency key would be
+    a duplicate-effect hazard); acks never outnumber the journaled
+    incarnations of their key.
+    """
+    from semantic_merge_tpu.fleet import wal as fleet_wal
+    errors: List[str] = []
+    records = fleet_wal.read_records(wal_dir)
+    if not records:
+        return [f"wal: no records found under {wal_dir}"]
+    requests: Dict[str, List[dict]] = {}
+    dispatches: Dict[str, int] = {}
+    acks: Dict[str, int] = {}
+    for rec in records:
+        kind, key = rec.get("kind"), rec.get("key")
+        if kind not in fleet_wal.RECORD_KINDS:
+            errors.append(f"wal: undocumented record kind {kind!r}")
+            continue
+        if not isinstance(key, str) or not key:
+            errors.append(f"wal: {kind} record without a key")
+            continue
+        if kind == "request":
+            requests.setdefault(key, []).append(rec)
+        elif kind == "dispatch":
+            dispatches[key] = dispatches.get(key, 0) + 1
+        else:
+            acks[key] = acks.get(key, 0) + 1
+    for key in set(dispatches) | set(acks):
+        if key not in requests:
+            errors.append(f"wal: key {key} dispatched/acked but never "
+                          f"journaled")
+    for key, recs in requests.items():
+        shapes = {json.dumps({"verb": r.get("verb"),
+                              "params": r.get("params")},
+                             sort_keys=True) for r in recs}
+        if len(shapes) > 1:
+            errors.append(f"wal: key {key} journaled with "
+                          f"{len(shapes)} different payloads — "
+                          f"duplicate-effect hazard")
+        if acks.get(key, 0) > len(recs):
+            errors.append(f"wal: key {key} acked {acks[key]}x for "
+                          f"{len(recs)} journaled incarnation(s)")
+    return errors
+
+
+def run_fleet_soak(workdir: pathlib.Path, *, requests: int = 40,
+                   repos: int = 6, concurrency: int = 6,
+                   members: int = 3, member_kills: int = 2,
+                   router_kills: int = 1, seed: int = 1
+                   ) -> Dict[str, Any]:
+    """Fleet kill-drill: randomized member SIGKILLs plus a router
+    SIGKILL mid-stream (the replacement router reclaims the orphaned
+    members, replays the WAL, and keeps serving). Every request must
+    settle byte-exact with documented exits only; the WAL history must
+    account for every effect exactly once."""
+    rng = random.Random(seed)
+    workdir = pathlib.Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    repo_paths = [build_repo(workdir / f"repo{i}") for i in range(repos)]
+    sock = str(workdir / "fleet.sock")
+    wal_dir = sock + ".semmerge-fleet-wal"
+    router = spawn_fleet_router(sock, members=members)
+
+    stats: Dict[str, Any] = {
+        "lock": threading.Lock(), "transport_retries": 0,
+        "shed_retries": 0, "outcomes": {}, "bad_responses": [],
+        "member_kills": 0, "router_kills": 0,
+        "router_pids_seen": set(), "member_pids_seen": set(),
+    }
+    report: Dict[str, Any] = {"requests": requests, "errors": []}
+    t0 = time.monotonic()
+    try:
+        status = wait_fleet(sock, router, min_members=members)
+        stats["router_pids_seen"].add(status["pid"])
+        for m in status.get("members", []):
+            if m.get("pid"):
+                stats["member_pids_seen"].add(m["pid"])
+
+        schedule = []
+        for _ in range(requests):
+            shape = FLEET_SHAPES[rng.randrange(len(FLEET_SHAPES))]
+            schedule.append((repo_paths[rng.randrange(repos)], shape))
+        kill_events = (["member"] * member_kills
+                       + ["router"] * router_kills)
+        lo, hi = requests // 4, max(requests // 4 + len(kill_events),
+                                    3 * requests // 4)
+        kill_points = sorted(
+            zip(rng.sample(range(lo, hi), len(kill_events)),
+                rng.sample(kill_events, len(kill_events))))
+        sem = threading.Semaphore(concurrency)
+        threads: List[threading.Thread] = []
+
+        def fire(repo: pathlib.Path, shape) -> None:
+            name, shape_env, allowed = shape
+            try:
+                resp = request(sock, repo, dict(shape_env), stats)
+            except RuntimeError as exc:
+                with stats["lock"]:
+                    stats["bad_responses"].append(f"{name}: {exc}")
+                return
+            finally:
+                sem.release()
+            code = None
+            if "result" in resp:
+                code = resp["result"].get("exit_code")
+            elif "error" in resp:
+                code = resp["error"].get("exit_code")
+            with stats["lock"]:
+                stats["outcomes"].setdefault(name, {}).setdefault(
+                    str(code), 0)
+                stats["outcomes"][name][str(code)] += 1
+                if code not in allowed:
+                    stats["bad_responses"].append(
+                        f"{name}: exit {code!r} not in documented "
+                        f"{allowed} ({resp.get('error') or ''})")
+
+        for i, (repo, shape) in enumerate(schedule):
+            while kill_points and i == kill_points[0][0]:
+                _, what = kill_points.pop(0)
+                if what == "member":
+                    status = daemon_status(sock)
+                    live = [m for m in (status or {}).get("members", [])
+                            if m.get("pid") and m.get("in_ring")]
+                    if live:
+                        victim = live[rng.randrange(len(live))]
+                        try:
+                            os.kill(victim["pid"], signal.SIGKILL)
+                            with stats["lock"]:
+                                stats["member_kills"] += 1
+                        except OSError:
+                            pass
+                else:
+                    try:
+                        os.kill(router.pid, signal.SIGKILL)
+                        router.wait(timeout=10)
+                        with stats["lock"]:
+                            stats["router_kills"] += 1
+                    except OSError:
+                        pass
+                    router = spawn_fleet_router(sock, members=members)
+            sem.acquire()
+            t = threading.Thread(target=fire, args=(repo, shape))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=300)
+
+        final = wait_fleet(sock, router, min_members=members)
+        stats["router_pids_seen"].add(final["pid"])
+        for m in final.get("members", []):
+            if m.get("pid"):
+                stats["member_pids_seen"].add(m["pid"])
+        for repo in repo_paths:
+            resp = request(sock, repo, {}, stats)
+            code = (resp.get("result") or resp.get("error") or {}) \
+                .get("exit_code")
+            if code != 0:
+                report["errors"].append(
+                    f"{repo.name}: settling merge exited {code!r}")
+        for repo in repo_paths:
+            report["errors"].extend(tree_errors(repo))
+
+        final = daemon_status(sock) or final
+        counters = (final.get("metrics") or {}).get("counters", {})
+
+        def _counter_total(name):
+            series = counters.get(name, {}).get("series")
+            if series is None:
+                return None
+            return sum(s["value"] for s in series)
+
+        report["failovers_total"] = _counter_total("fleet_failovers_total")
+        report["rehash_moves_total"] = _counter_total(
+            "fleet_rehash_moves_total")
+        report["wal_replayed_total"] = _counter_total(
+            "fleet_wal_replayed_total")
+        report["members_up"] = final.get("members_up")
+        report["wal_open"] = (final.get("wal") or {}).get("open")
+        if report["wal_open"] != 0:
+            report["errors"].append(
+                f"{report['wal_open']} WAL entries still open after "
+                f"settling — journaled effects unaccounted for")
+    finally:
+        if router.poll() is None:
+            router.send_signal(signal.SIGTERM)
+            try:
+                router.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                router.kill()
+                router.wait(timeout=10)
+
+    report["errors"].extend(audit_wal(wal_dir))
+    report["elapsed_s"] = round(time.monotonic() - t0, 3)
+    report["outcomes"] = stats["outcomes"]
+    report["transport_retries"] = stats["transport_retries"]
+    report["shed_retries"] = stats["shed_retries"]
+    report["member_kills"] = stats["member_kills"]
+    report["router_kills"] = stats["router_kills"]
+    report["router_pids_seen"] = len(stats["router_pids_seen"])
+    report["member_pids_seen"] = len(stats["member_pids_seen"])
+    report["errors"].extend(stats["bad_responses"])
+    if stats["member_kills"] and not report.get("failovers_total"):
+        report["errors"].append(
+            "members were SIGKILLed but no fleet failover was counted")
+    if stats["router_kills"] and report["router_pids_seen"] < 2:
+        report["errors"].append(
+            "router was SIGKILLed but no replacement pid was observed")
+    report["ok"] = not report["errors"]
+    return report
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         description="Chaos/soak the supervised merge service")
@@ -543,6 +829,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--kills", type=int, default=2)
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument("--hard-mb", type=float, default=4096.0)
+    parser.add_argument("--fleet", action="store_true",
+                        help="Run the fleet kill-drill shape instead "
+                             "(router + N members, member/router "
+                             "SIGKILLs, WAL replay audit)")
+    parser.add_argument("--members", type=int, default=3,
+                        help="Fleet members (with --fleet)")
+    parser.add_argument("--router-kills", type=int, default=1,
+                        help="Router SIGKILLs mid-stream (with --fleet)")
     parser.add_argument("--workdir", default=None,
                         help="Scratch dir (default: a fresh temp dir)")
     parser.add_argument("--json", action="store_true",
@@ -553,11 +847,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         import tempfile
         workdir = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-chaos-"))
-    report = run_soak(workdir, requests=args.requests, repos=args.repos,
-                      concurrency=args.concurrency, kills=args.kills,
-                      seed=args.seed, hard_mb=args.hard_mb)
+    if args.fleet:
+        report = run_fleet_soak(
+            workdir, requests=args.requests, repos=args.repos,
+            concurrency=args.concurrency, members=args.members,
+            member_kills=args.kills, router_kills=args.router_kills,
+            seed=args.seed)
+    else:
+        report = run_soak(workdir, requests=args.requests,
+                          repos=args.repos,
+                          concurrency=args.concurrency, kills=args.kills,
+                          seed=args.seed, hard_mb=args.hard_mb)
     if args.json:
         print(json.dumps(report, indent=2, default=str))
+    elif args.fleet:
+        print(f"fleet soak: {report['requests']} requests, "
+              f"{report['member_kills']} member kills, "
+              f"{report['router_kills']} router kills, "
+              f"{report['transport_retries']} transport retries, "
+              f"{report['elapsed_s']}s -> "
+              f"{'OK' if report['ok'] else 'FAIL'}")
+        for err in report["errors"]:
+            print(f"  {err}", file=sys.stderr)
     else:
         print(f"soak: {report['requests']} requests, "
               f"{report['kills']} kills, "

@@ -19,12 +19,15 @@ Subcommand modes for the request-tracing artifacts::
         STATUS_OR_TRACE_JSON [...]
     python scripts/check_trace_schema.py validate_conflicts \
         .semmerge-conflicts.json [...]
+    python scripts/check_trace_schema.py validate_fleet \
+        STATUS_OR_TRACE_JSON [...]
 
 Exit 0 when everything conforms, 1 with one line per violation
 otherwise. The tier-1 suite imports :func:`validate_trace` /
 :func:`validate_events` / :func:`validate_bench` / :func:`validate_batch`
 / :func:`validate_request_traces` / :func:`validate_postmortem` /
-:func:`validate_slo` / :func:`validate_conflicts` directly (``tests/test_trace_schema.py``), so
+:func:`validate_slo` / :func:`validate_conflicts` /
+:func:`validate_fleet` directly (``tests/test_trace_schema.py``), so
 trace-format drift fails CI before it reaches a consumer.
 
 Dependency-free on purpose: the schema IS this file plus the runbook
@@ -140,7 +143,10 @@ RESILIENCE_METRIC_LABELS = {
 #: Documented load-shed reasons (runbook, "Overload & self-healing").
 #: Queue-full is deliberately NOT a shed reason: it keeps its own
 #: ``service_requests_total{outcome="rejected"}`` accounting.
-SHED_REASONS = ("rss-hard", "rss-soft", "projected-deadline")
+#: ``draining`` is the fleet-era admission close: a member told to
+#: drain sheds new work with a retryable rejection while finishing
+#: its in-flight requests.
+SHED_REASONS = ("rss-hard", "rss-soft", "projected-deadline", "draining")
 
 #: Circuit-breaker states as published in the ``breaker_state`` gauge.
 BREAKER_STATES = (0, 1, 2)  # closed / open / half-open
@@ -155,7 +161,7 @@ POSTMORTEM_REQUIRED = ("schema", "trace_id", "reason", "ts", "spans",
 #: Documented postmortem dump reasons (``obs/flight.py`` REASONS).
 POSTMORTEM_REASONS = ("fault-escape", "degradation", "breaker-transition",
                       "supervisor-restart", "daemon-drain", "slo-burn",
-                      "resolver-fault")
+                      "resolver-fault", "fleet-failover")
 
 #: Required keys of one flight-ring row (``obs/flight.py`` note()).
 FLIGHT_ROW_REQUIRED = ("name", "t", "seconds", "layer", "status", "error",
@@ -186,6 +192,9 @@ BENCH_NUMERIC_OPTIONAL = (
     "gate_format_ms",
     "chips", "mesh_merges_per_sec_c16", "merges_per_sec_per_chip",
     "scaling_efficiency", "mesh_p50_ms", "mesh_p99_ms",
+    "fleet_merges_per_sec_m1", "fleet_merges_per_sec_m2",
+    "fleet_merges_per_sec_m3", "fleet_failover_recovery_s",
+    "fleet_rehash_miss_rate", "fleet_hedge_win_rate",
 )
 
 #: Versions of the structured ``.semmerge-conflicts.json`` object form.
@@ -208,6 +217,46 @@ RESOLUTION_REQUIRED = ("conflict_id", "category", "resolver", "status",
 #: Verify gates of the resolution tier, in documented run order
 #: (``resolve/engine.py`` GATES).
 RESOLUTION_GATES = ("recompose", "parity", "typecheck", "format")
+
+#: Span names of the fleet router layer (``fleet/router.py``).
+#: ``fleet.route`` wraps one successfully dispatched request;
+#: ``fleet.failover`` records one member ejection/dispatch transfer;
+#: ``fleet.hedge`` fires only when the hedge leg won the race.
+FLEET_SPANS = ("fleet.route", "fleet.failover", "fleet.hedge")
+
+#: Required meta keys per fleet span name.
+FLEET_SPAN_META = {
+    "fleet.route": ("verb", "member"),
+    "fleet.failover": ("reason", "member"),
+    "fleet.hedge": ("member", "won"),
+}
+
+#: Documented ``fleet_failovers_total`` / ``fleet.failover`` reasons:
+#: supervisor reaped the child (``crash``), a dispatch hit a dead
+#: socket (``transport``), the heartbeat probe failed repeatedly
+#: (``health``), the member was told to drain (``drain``).
+FLEET_FAILOVER_REASONS = ("crash", "transport", "health", "drain")
+
+#: Label keys of the fleet metric series (``fleet/router.py``). The
+#: ``fleet_members`` gauge is the live ring size (unlabeled, >= 0);
+#: everything else is an event counter.
+FLEET_METRIC_LABELS = {
+    "fleet_failovers_total": ("reason",),
+    "fleet_rehash_moves_total": (),
+    "fleet_hedges_total": (),
+    "fleet_hedge_wins_total": (),
+    "fleet_wal_replayed_total": (),
+}
+
+#: Documented WAL record kinds (``fleet/wal.py``).
+FLEET_WAL_KINDS = ("request", "dispatch", "ack")
+
+#: Required keys per WAL record kind.
+FLEET_WAL_REQUIRED = {
+    "request": ("kind", "key", "verb", "params", "trace_id", "t"),
+    "dispatch": ("kind", "key", "member", "t"),
+    "ack": ("kind", "key", "t"),
+}
 
 #: Label keys of the SLO-engine metric series (``obs/slo.py``). The
 #: burn gauge carries exactly (objective, window) with window in
@@ -660,6 +709,123 @@ def validate_slo(data: Any) -> List[str]:
     return errors
 
 
+def validate_fleet(data: Any) -> List[str]:
+    """Validate the fleet-router records of a trace/events-shaped
+    artifact (or a router status payload's ``metrics`` block), plus —
+    when a ``wal`` array is present — the dispatch-journal records:
+    every ``fleet.*`` span is a documented one carrying its meta
+    (failover reasons from the documented set, ``fleet.hedge`` a
+    boolean ``won``), the fleet metric series carry their documented
+    label sets, ``fleet_members`` is an unlabeled non-negative gauge,
+    and each WAL record has its kind's required keys."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["fleet: top level must be a JSON object"]
+    for i, row in enumerate(data.get("spans", [])):
+        if not isinstance(row, dict):
+            continue
+        name = row.get("name")
+        if not isinstance(name, str) or not name.startswith("fleet."):
+            continue
+        if name not in FLEET_SPANS:
+            errors.append(f"trace.spans[{i}]: unknown fleet span {name!r}")
+            continue
+        meta = row.get("meta")
+        if not isinstance(meta, dict):
+            errors.append(f"trace.spans[{i}]: fleet span needs meta")
+            continue
+        for key in FLEET_SPAN_META[name]:
+            if key not in meta:
+                errors.append(f"trace.spans[{i}]: {name} meta missing "
+                              f"{key!r}")
+        member = meta.get("member")
+        if "member" in meta and (not isinstance(member, str)
+                                 or not member):
+            errors.append(f"trace.spans[{i}]: {name} meta 'member' must "
+                          f"be a non-empty string")
+        if name == "fleet.failover":
+            reason = meta.get("reason")
+            if "reason" in meta and reason not in FLEET_FAILOVER_REASONS:
+                errors.append(f"trace.spans[{i}]: fleet.failover reason "
+                              f"{reason!r} not in "
+                              f"{FLEET_FAILOVER_REASONS}")
+        if name == "fleet.hedge" and "won" in meta \
+                and not isinstance(meta["won"], bool):
+            errors.append(f"trace.spans[{i}]: fleet.hedge meta 'won' "
+                          f"must be a boolean")
+        if name == "fleet.route":
+            verb = meta.get("verb")
+            if "verb" in meta and (not isinstance(verb, str) or not verb):
+                errors.append(f"trace.spans[{i}]: fleet.route meta "
+                              f"'verb' must be a non-empty string")
+    metrics = data.get("metrics", data)
+    if isinstance(metrics, dict):
+        counters = metrics.get("counters", {})
+        if not isinstance(counters, dict):
+            counters = {}
+        for name, labels in FLEET_METRIC_LABELS.items():
+            m = counters.get(name)
+            if not isinstance(m, dict):
+                continue
+            for j, s in enumerate(m.get("series", [])):
+                got = tuple(sorted((s.get("labels") or {}).keys()))
+                if got != tuple(sorted(labels)):
+                    errors.append(f"metrics.counters.{name}[{j}]: labels "
+                                  f"{got} != documented "
+                                  f"{tuple(sorted(labels))}")
+        fo = counters.get("fleet_failovers_total")
+        if isinstance(fo, dict):
+            for j, s in enumerate(fo.get("series", [])):
+                reason = (s.get("labels") or {}).get("reason")
+                if reason not in FLEET_FAILOVER_REASONS:
+                    errors.append(
+                        f"metrics.counters.fleet_failovers_total[{j}]: "
+                        f"reason {reason!r} not in "
+                        f"{FLEET_FAILOVER_REASONS}")
+        gauges = metrics.get("gauges", {})
+        members = gauges.get("fleet_members") \
+            if isinstance(gauges, dict) else None
+        if isinstance(members, dict):
+            for j, s in enumerate(members.get("series", [])):
+                if (s.get("labels") or {}) != {}:
+                    errors.append(f"metrics.gauges.fleet_members[{j}]: "
+                                  f"must carry no labels")
+                if not _is_num(s.get("value")) or s.get("value") < 0:
+                    errors.append(f"metrics.gauges.fleet_members[{j}]: "
+                                  f"value must be a number >= 0")
+    wal = data.get("wal")
+    if isinstance(wal, list):
+        for i, rec in enumerate(wal):
+            where = f"wal[{i}]"
+            if not isinstance(rec, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            kind = rec.get("kind")
+            if kind not in FLEET_WAL_KINDS:
+                errors.append(f"{where}: kind {kind!r} not in "
+                              f"{FLEET_WAL_KINDS}")
+                continue
+            for key in FLEET_WAL_REQUIRED[kind]:
+                if key not in rec:
+                    errors.append(f"{where}: {kind} record missing "
+                                  f"key {key!r}")
+            if not isinstance(rec.get("key"), str) or not rec.get("key"):
+                errors.append(f"{where}: key must be a non-empty string")
+            if "t" in rec and (not _is_num(rec["t"]) or rec["t"] < 0):
+                errors.append(f"{where}: t must be a number >= 0")
+            if kind == "request" and not isinstance(rec.get("params"),
+                                                   dict):
+                errors.append(f"{where}: request params must be an object")
+            if kind == "dispatch" and (
+                    not isinstance(rec.get("member"), str)
+                    or not rec.get("member")):
+                errors.append(f"{where}: dispatch member must be a "
+                              f"non-empty string")
+    elif wal is not None:
+        errors.append("fleet: wal must be an array of records")
+    return errors
+
+
 def validate_phase_coverage(data: Any, required) -> List[str]:
     """Check a trace artifact's span/phase names include ``required`` —
     the drift guard for load-bearing phase names (e.g. the apply-layer
@@ -1025,6 +1191,20 @@ def main(argv: List[str]) -> int:
             except (OSError, json.JSONDecodeError) as exc:
                 errors.append(f"{path}: unreadable ({exc})")
         return _finish(errors)
+    if argv and argv[0] == "validate_fleet":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_fleet "
+                  "STATUS_OR_TRACE_JSON [...]", file=sys.stderr)
+            return 2
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_fleet(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
     if argv and argv[0] == "validate_request_traces":
         if len(argv) < 2:
             print("usage: check_trace_schema.py validate_request_traces "
@@ -1064,6 +1244,7 @@ def main(argv: List[str]) -> int:
         errors.extend(validate_batch(trace))
         errors.extend(validate_resilience(trace))
         errors.extend(validate_slo(trace))
+        errors.extend(validate_fleet(trace))
     except (OSError, json.JSONDecodeError) as exc:
         errors.append(f"trace: unreadable ({exc})")
     if len(argv) == 2:

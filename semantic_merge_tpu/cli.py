@@ -181,6 +181,35 @@ def build_parser() -> argparse.ArgumentParser:
                               "SEMMERGE_SUPERVISE_MAX_RESTARTS); a clean "
                               "exit (idle-exit, shutdown) ends supervision")
 
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="Run a fault-tolerant routing tier over N supervised merge "
+             "daemons: consistent-hash repo affinity, health-aware "
+             "failover, a durable dispatch WAL, and hedged reads (see "
+             "runbook: Fleet mode)")
+    p_fleet.add_argument("--socket", default=None,
+                         help="Client-facing unix socket (same resolution "
+                              "chain as serve); members bind "
+                              "<socket>.m0, .m1, …")
+    p_fleet.add_argument("--members", type=int, default=None,
+                         help="Member daemons to supervise "
+                              "(SEMMERGE_FLEET_MEMBERS, default 3)")
+    p_fleet.add_argument("--workers", type=int, default=None,
+                         help="Executor threads per member "
+                              "(SEMMERGE_SERVICE_WORKERS, default 4)")
+    p_fleet.add_argument("--queue", type=int, default=None,
+                         help="Admission queue bound per member")
+    p_fleet.add_argument("--wal-dir", default=None,
+                         help="Dispatch WAL directory "
+                              "(SEMMERGE_FLEET_WAL_DIR, default "
+                              "<socket>.semmerge-fleet-wal/)")
+    p_fleet.add_argument("--status", action="store_true",
+                         help="Query a running router's status and exit")
+    p_fleet.add_argument("--drain", default=None, metavar="MEMBER",
+                         help="Drain one member (e.g. m1) out of a running "
+                              "fleet and exit; 'all' drains the router "
+                              "itself")
+
     p_stats = sub.add_parser("stats",
                              help="Pretty-print a semmerge trace/metrics "
                                   "artifact (.semmerge-trace.json, "
@@ -324,6 +353,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             return cmd_perf(args)
         if args.command == "serve":
             return cmd_serve(args)
+        if args.command == "fleet":
+            return cmd_fleet(args)
     except subprocess.CalledProcessError as exc:
         cmd = exc.cmd if isinstance(exc.cmd, str) else " ".join(map(str, exc.cmd))
         print(f"error: subprocess failed ({cmd}): exit {exc.returncode}", file=sys.stderr)
@@ -882,6 +913,39 @@ def cmd_serve(args: argparse.Namespace) -> int:
                     queue_size=args.queue, idle_exit=args.idle_exit,
                     events_path=args.events)
     return daemon.serve_forever()
+
+
+def cmd_fleet(args: argparse.Namespace) -> int:
+    """Start (or query/drain) the fleet router. The router process is
+    import-light like the supervisor — members carry the heavy
+    runtime."""
+    from .service import client as service_client
+    if args.status:
+        try:
+            status = service_client.call_control("status",
+                                                 path=args.socket)
+        except service_client.DaemonUnavailable as exc:
+            print(f"semmerge fleet: no router running ({exc})",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(status, indent=2, default=str))
+        return 0 if status.get("fleet") else 1
+    if args.drain:
+        params = {} if args.drain == "all" else {"member": args.drain}
+        try:
+            result = service_client.call_control("drain", params=params,
+                                                 path=args.socket)
+        except service_client.DaemonUnavailable as exc:
+            print(f"semmerge fleet: no router running ({exc})",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(result, indent=2, default=str))
+        return 0 if result.get("ok") else 1
+    from .fleet.router import FleetRouter
+    router = FleetRouter(socket_path=args.socket, members=args.members,
+                         workers=args.workers, queue_size=args.queue,
+                         wal_dir=args.wal_dir)
+    return router.serve_forever()
 
 
 def cmd_profile(args: argparse.Namespace) -> int:

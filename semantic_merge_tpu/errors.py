@@ -32,6 +32,8 @@ code  meaning
       ``--resolve require``
 18    ``MeshFault`` — a device mesh could not be built/used under
       ``SEMMERGE_MESH=require``
+19    ``FleetFault`` — the daemon fleet router could not route/serve a
+      request under ``SEMMERGE_FLEET=require``
 ====  =============================================================
 
 Codes 10-17 are only ever *exit* codes in strict mode (or, for
@@ -151,6 +153,19 @@ class MeshFault(MergeFault):
     default_stage = "mesh"
 
 
+class FleetFault(MergeFault):
+    """The daemon fleet tier (``fleet/``) could not route or serve a
+    request. Under the default ``auto`` posture the client falls back
+    to the single-daemon path (and from there to in-process execution)
+    — never worse than a fleet-less run — so this fault only surfaces
+    as an exit under ``SEMMERGE_FLEET=require``, where router
+    availability is the contract. Inside the router it also classifies
+    unexpected routing/WAL/dispatch errors."""
+
+    exit_code = 19
+    default_stage = "fleet"
+
+
 #: Fault class each pipeline stage wraps *unexpected* exceptions into.
 STAGE_FAULTS = {
     "snapshot": ParseFault,
@@ -180,6 +195,14 @@ STAGE_FAULTS = {
     # (under SEMMERGE_MESH=require) with its own stage "mesh".
     "batch:mesh": BatchFault,
     "mesh": MeshFault,
+    # Fleet router tier (fleet/): routing, WAL, and failover stages all
+    # classify as FleetFault; member-side execution faults keep their
+    # own typed class from the member daemon's wire error.
+    "fleet": FleetFault,
+    "fleet:route": FleetFault,
+    "fleet:dispatch": FleetFault,
+    "fleet:failover": FleetFault,
+    "fleet:replay": FleetFault,
     # Conflict-resolution tier (resolve/): propose/verify classify as
     # ResolveFault so the CLI's containment (auto → conflict-as-result,
     # require → exit 17) sees one fault type for the whole tier.
@@ -198,7 +221,7 @@ STAGE_FAULTS = {
 EXIT_CODES = {cls.__name__: cls.exit_code for cls in
               (ParseFault, KernelFault, WorkerFault, ApplyFault,
                FormatFault, DeadlineFault, BatchFault, ResolveFault,
-               MeshFault)}
+               MeshFault, FleetFault)}
 
 
 def fault_for_stage(stage: str) -> type:

@@ -1,0 +1,34 @@
+"""Fault-tolerant daemon fleet — a routing tier over N merge daemons.
+
+ROADMAP's routing-tier item: one supervised daemon (PR 9) is a single
+point of failure and a single queue; the fleet puts a lightweight
+router in front of N supervised member daemons with consistent-hash
+repo affinity (``hashring``), a durable dispatch WAL (``wal``), and
+health-aware failover + hedged reads (``router``).
+
+Postures (``SEMMERGE_FLEET``):
+
+- ``off`` (default) — no fleet anywhere; the client path is
+  byte-identical to the single-daemon service stack.
+- ``auto`` — the client prefers an already-running fleet router on the
+  service socket, and falls back to the plain ``SEMMERGE_DAEMON``
+  posture when none is listening. Never worse than fleet-less.
+- ``require`` — the client must reach a fleet router; failure is
+  :class:`~semantic_merge_tpu.errors.FleetFault` (exit 19).
+
+The package is import-light (stdlib only at import time) — the router
+process never imports jax; member daemons carry the heavy runtime.
+"""
+from __future__ import annotations
+
+from ..utils import reqenv
+
+#: Posture env var (``off`` | ``auto`` | ``require``).
+ENV_POSTURE = "SEMMERGE_FLEET"
+#: Documented ``FleetFault`` exit code (see ``errors.EXIT_CODES``).
+FLEET_EXIT = 19
+
+
+def mode() -> str:
+    """The effective fleet posture (overlay-aware)."""
+    return reqenv.posture(ENV_POSTURE, default="off")

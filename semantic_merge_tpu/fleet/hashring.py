@@ -1,0 +1,85 @@
+"""Rendezvous (highest-random-weight) hashing for repo→member affinity.
+
+Why rendezvous and not a token ring: the fleet is small (single-digit
+members) and the property that matters is *minimal disruption* — when
+a member fails, only the keys it owned move, and they move to the
+member that was already each key's second choice. Rendezvous hashing
+gives exactly that with no virtual-node bookkeeping: every (key,
+member) pair gets an independent uniform score, a key's owner is the
+highest-scoring member, and removing a member can only promote the
+runner-up for the keys it owned — every other key's ranking is
+untouched. The full descending ranking doubles as the failover order
+and the hedge-target order, so routing, failover, and hedging all
+share one deterministic notion of "who serves this repo".
+
+Keys are canonicalized repo roots (``repo_key``) so that per-repo
+state — the inplace lockfile, decl caches, warm compiled programs —
+concentrates on one member across requests and across failovers.
+
+Pure stdlib, no service imports: unit-testable without a daemon.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Sequence
+
+
+def repo_key(cwd: str) -> str:
+    """Canonical affinity key for a request working directory.
+
+    Resolves symlinks and normalizes so that every spelling of the
+    same repo root hashes identically. The *request* cwd (not the git
+    toplevel) is deliberate: the router stays git-free and the cwd is
+    what the member daemon chdirs to anyway, so affinity follows the
+    directory clients actually merge from.
+    """
+    try:
+        return os.path.realpath(cwd or ".")
+    except OSError:
+        return os.path.normpath(cwd or ".")
+
+
+def _score(key: str, member: str) -> int:
+    digest = hashlib.blake2b(
+        key.encode("utf-8", "surrogateescape") + b"\x00" +
+        member.encode("utf-8", "surrogateescape"),
+        digest_size=8).digest()
+    return int.from_bytes(digest, "big")
+
+
+def rank(key: str, members: Sequence[str]) -> List[str]:
+    """Members ranked best-first for ``key`` (deterministic total order).
+
+    ``rank(key, members)[0]`` is the owner; ``[1]`` is the failover /
+    hedge target; ties (astronomically unlikely with 64-bit scores)
+    break on the member id so the order is total either way.
+    """
+    return sorted(members, key=lambda m: (_score(key, m), m),
+                  reverse=True)
+
+
+def owner(key: str, members: Sequence[str]) -> str:
+    """The single owning member for ``key`` (raises on empty fleet)."""
+    if not members:
+        raise ValueError("rendezvous rank over an empty member set")
+    best = members[0]
+    best_score = (_score(key, best), best)
+    for m in members[1:]:
+        s = (_score(key, m), m)
+        if s > best_score:
+            best, best_score = m, s
+    return best
+
+
+def moved_keys(keys: Sequence[str], before: Sequence[str],
+               after: Sequence[str]) -> List[str]:
+    """Keys whose owner changes between two member sets.
+
+    Used by the router to count ``fleet_rehash_moves_total`` when a
+    member is ejected, and by tests to assert the minimal-disruption
+    property (shrinking the set moves only the dead member's keys).
+    """
+    if not before or not after:
+        return list(keys) if (before or after) else []
+    return [k for k in keys if owner(k, before) != owner(k, after)]

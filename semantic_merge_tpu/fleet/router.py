@@ -1,0 +1,850 @@
+"""The fleet router: N supervised member daemons behind one socket.
+
+The router binds the *standard* service socket (so every existing
+client transparently talks to the fleet) and spawns N member daemons
+on derived socket paths (``<socket>.m0``, ``<socket>.m1``, …), each
+under a per-member respawn policy
+(:class:`~semantic_merge_tpu.service.supervisor.MemberSupervisor`).
+Like the supervisor, the router process is deliberately boring — no
+jax, no engine imports; nothing in it can fail the way a member does.
+
+Request flow::
+
+    client conn thread → WAL journal → rendezvous rank → member dispatch
+                                  ↘ (transport failure) failover to next
+                                  ↘ (idle, non-inplace) hedge to second
+
+- **Affinity**: requests hash by resolved request cwd
+  (:func:`fleet.hashring.repo_key`), so per-repo state — the inplace
+  lockfile, decl caches, warm compiled programs — concentrates on one
+  member. Failover order and hedge targets come from the same ranking.
+- **Membership**: a health thread ticks every member's supervisor,
+  probes liveness (the member's loopback ``/healthz`` when its
+  ephemeral telemetry port is known, the socket ``hello`` handshake
+  otherwise), ejects failed or draining members from the ring
+  (counting the keys whose owner moved — ``fleet_rehash_moves_total``)
+  and re-admits them when they come back.
+- **Durability**: every verb request is journaled to the router's WAL
+  before first dispatch and acked after the response is written
+  toward the client; a router restart replays unacked entries to
+  their rehashed owners. Idempotency keys (router-minted when the
+  client sent none) plus the PR 4 inplace journal + repo lockfile
+  collapse at-least-once dispatch into exactly-once effects.
+- **Hedging**: a non-``--inplace`` request may be hedged to the
+  second-ranked member after a p99-derived delay
+  (``SEMMERGE_FLEET_HEDGE=off`` disables); first response wins and
+  the loser's connection is closed.
+
+Typed wire errors from a member (``exit_code`` present) pass through
+to the client unchanged — the member is the authority on
+request-shaped failures; the router only converts *transport* loss
+into failover. A router drain (SIGTERM or the ``drain`` control verb)
+closes admission with retryable ``FleetFault`` rejections
+(``retry_after_ms`` attached), finishes in-flight dispatches, then
+SIGTERMs the members so they drain too.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..errors import FleetFault, MergeFault, fault_boundary
+from ..obs import flight as obs_flight
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from ..service import protocol, telemetry
+from ..service.supervisor import MemberSupervisor
+from ..utils import faults
+from ..utils.loggingx import logger
+from ..utils.procs import env_seconds
+from . import hashring, wal as fleet_wal
+
+_MEMBERS_HELP = "Fleet members currently in the routing ring"
+_FAILOVERS_HELP = "Fleet failovers (member ejections/re-dispatches), by reason"
+_REHASH_HELP = "Repo keys whose owner moved on a membership change"
+_HEDGES_HELP = "Hedged dispatches issued for slow primaries"
+_HEDGE_WINS_HELP = "Hedged dispatches where the hedge answered first"
+_REPLAY_HELP = "WAL entries replayed after a router restart"
+
+#: Health-probe failures before a member is ejected from the ring.
+_EJECT_AFTER = 3
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+class _MemberTransport(Exception):
+    """A member connection died mid-request (crash, SIGKILL, garbage) —
+    the failover trigger, never surfaced to the client directly."""
+
+
+class _Member:
+    """Router-side view of one member daemon."""
+
+    def __init__(self, member_id: str, socket_path: str,
+                 sup: MemberSupervisor) -> None:
+        self.id = member_id
+        self.socket_path = socket_path
+        self.sup = sup
+        self.in_ring = False
+        self.draining = False
+        self.fail_streak = 0
+        self.metrics_port: Optional[int] = None
+        self.dispatches = 0
+
+    def view(self) -> Dict[str, Any]:
+        return {"id": self.id, "socket": self.socket_path,
+                "pid": self.sup.pid, "in_ring": self.in_ring,
+                "draining": self.draining,
+                "restarts": self.sup.restarts,
+                "last_rc": self.sup.last_rc,
+                "metrics_port": self.metrics_port,
+                "dispatches": self.dispatches}
+
+
+class FleetRouter:
+    """One ``semmerge fleet`` process. Construct, then
+    :meth:`serve_forever`."""
+
+    def __init__(self, socket_path: Optional[str] = None,
+                 members: Optional[int] = None,
+                 workers: Optional[int] = None,
+                 queue_size: Optional[int] = None,
+                 wal_dir: Optional[str] = None) -> None:
+        self._socket_path = protocol.socket_path(socket_path)
+        n = members if members is not None else \
+            _env_int("SEMMERGE_FLEET_MEMBERS", 3)
+        self._n = max(1, n)
+        self._workers = workers
+        self._queue_size = queue_size
+        self._wal = fleet_wal.WriteAheadLog(
+            wal_dir or os.environ.get("SEMMERGE_FLEET_WAL_DIR", "").strip()
+            or fleet_wal.default_dir(self._socket_path))
+        self._members: List[_Member] = []
+        self._ring_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._in_flight = 0
+        self._served = 0
+        self._replayed = 0
+        self._stop = threading.Event()
+        self._draining = False
+        self._t0 = time.time()
+        self._seen_keys: "deque[str]" = deque(maxlen=1024)
+        self._seen_set: set = set()
+        self._latencies: "deque[float]" = deque(maxlen=256)
+        self._hedge_on = os.environ.get(
+            "SEMMERGE_FLEET_HEDGE", "").strip().lower() not in (
+                "off", "0", "no", "false")
+        self._hedge_default_ms = _env_int("SEMMERGE_FLEET_HEDGE_MS", 250)
+        self._hedge_min_ms = _env_int("SEMMERGE_FLEET_HEDGE_MIN_MS", 50)
+        self._hedge_cap_ms = _env_int("SEMMERGE_FLEET_HEDGE_CAP_MS", 2000)
+        self._ready_timeout = env_seconds("SEMMERGE_FLEET_READY_TIMEOUT",
+                                          60.0)
+        self._health_interval = env_seconds(
+            "SEMMERGE_FLEET_HEALTH_INTERVAL", 0.5)
+        self._request_timeout = env_seconds("SEMMERGE_FLEET_TIMEOUT", 600.0)
+        self._telemetry: Optional[telemetry.TelemetryServer] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def member_argv(self, member_sock: str) -> List[str]:
+        argv = [sys.executable, "-m", "semantic_merge_tpu", "serve",
+                "--socket", member_sock, "--idle-exit", "0"]
+        if self._workers is not None:
+            argv += ["--workers", str(self._workers)]
+        if self._queue_size is not None:
+            argv += ["--queue", str(self._queue_size)]
+        return argv
+
+    def _member_env(self, member_id: str) -> Dict[str, str]:
+        env = dict(os.environ)
+        # Members are plain daemons: no fleet recursion, no inherited
+        # fault injection (requests carry their own overlay), and an
+        # ephemeral loopback telemetry port so the router can probe
+        # /healthz without port bookkeeping.
+        env["SEMMERGE_FLEET"] = "off"
+        env["SEMMERGE_FLEET_MEMBER"] = member_id
+        env["SEMMERGE_METRICS_PORT"] = "0"
+        env.pop("SEMMERGE_FAULT", None)
+        env.pop("SEMMERGE_METRICS", None)
+        env.pop("SEMMERGE_SERVICE_SOCKET", None)
+        return env
+
+    def _bind(self) -> Optional[socket.socket]:
+        path = self._socket_path
+        if os.path.exists(path):
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            probe.settimeout(2.0)
+            try:
+                probe.connect(path)
+            except OSError:
+                logger.warning("replacing stale fleet socket %s", path)
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+            else:
+                probe.close()
+                return None
+            finally:
+                with contextlib.suppress(OSError):
+                    probe.close()
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(path)
+        with contextlib.suppress(OSError):
+            os.chmod(path, 0o600)
+        sock.listen(128)
+        return sock
+
+    def serve_forever(self) -> int:
+        sock = self._bind()
+        if sock is None:
+            print(f"semmerge fleet: something already listening on "
+                  f"{self._socket_path}")
+            return 0
+        try:
+            signal.signal(signal.SIGTERM, self._on_signal)
+            signal.signal(signal.SIGINT, self._on_signal)
+        except ValueError:
+            pass  # not the main thread (test embedding)
+        pending = self._wal.open()
+        for i in range(self._n):
+            self._reclaim_orphan(f"{self._socket_path}.m{i}")
+        for i in range(self._n):
+            member_id = f"m{i}"
+            member_sock = f"{self._socket_path}.{member_id}"
+            sup = MemberSupervisor(member_id,
+                                   self.member_argv(member_sock),
+                                   env=self._member_env(member_id))
+            self._members.append(_Member(member_id, member_sock, sup))
+        threading.Thread(target=self._health_loop, daemon=True,
+                         name="fleet-health").start()
+        if pending:
+            threading.Thread(target=self._replay, args=(pending,),
+                             daemon=True, name="fleet-replay").start()
+        obs_metrics.REGISTRY.gauge("fleet_members", _MEMBERS_HELP).set(0)
+        self._telemetry = telemetry.maybe_start(self.status)
+        if self._telemetry is not None:
+            logger.info("fleet telemetry on 127.0.0.1:%d",
+                        self._telemetry.port)
+        logger.info("fleet router listening on %s (%d members, wal %s)",
+                    self._socket_path, self._n, self._wal.directory)
+        sock.settimeout(0.5)
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _addr = sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                threading.Thread(target=self._handle_conn, args=(conn,),
+                                 daemon=True).start()
+        finally:
+            self._teardown(sock)
+        return 0
+
+    def _reclaim_orphan(self, path: str) -> None:
+        """Shut down a member left behind by a previous incarnation.
+
+        A SIGKILLed router orphans its member daemons; they keep their
+        sockets, so this incarnation's children would lose the bind
+        race forever (a daemon spawned onto a live socket exits
+        "already listening"). Members are stateless — the WAL and the
+        idempotency layers own the durable story — so the clean
+        reclaim is to shut the orphan down and let the fresh
+        supervisor respawn onto the path.
+        """
+        if not os.path.exists(path):
+            return
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(2.0)
+        try:
+            s.connect(path)
+        except OSError:
+            with contextlib.suppress(OSError):
+                s.close()
+            with contextlib.suppress(OSError):
+                os.unlink(path)  # dead member's leftover
+            return
+        try:
+            rfile = s.makefile("r", encoding="utf-8")
+            wfile = s.makefile("w", encoding="utf-8")
+            protocol.write_message(wfile, {"id": 0, "method": "shutdown",
+                                           "params": {}})
+            protocol.read_message(rfile)
+        except (OSError, ValueError, protocol.ProtocolError):
+            pass
+        finally:
+            with contextlib.suppress(OSError):
+                s.close()
+        logger.warning("reclaiming orphaned fleet member on %s", path)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and os.path.exists(path):
+            time.sleep(0.1)  # the daemon unlinks its socket on exit
+
+    def _on_signal(self, signum, frame) -> None:
+        logger.info("fleet signal %d: draining", signum)
+        self._draining = True
+        self._stop.set()
+
+    def _teardown(self, sock: socket.socket) -> None:
+        self._draining = True
+        with contextlib.suppress(OSError):
+            sock.close()
+        with contextlib.suppress(OSError):
+            os.unlink(self._socket_path)
+        drain = env_seconds("SEMMERGE_SERVICE_DRAIN_TIMEOUT", 30.0)
+        deadline = time.monotonic() + drain if drain > 0 else None
+        while True:
+            with self._state_lock:
+                busy = self._in_flight > 0
+            if not busy:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                logger.warning("fleet drain timeout: abandoning dispatches")
+                break
+            time.sleep(0.05)
+        for m in self._members:
+            m.sup.terminate()
+        child_deadline = time.monotonic() + (drain if drain > 0 else 30.0)
+        for m in self._members:
+            proc = m.sup.proc
+            if proc is None:
+                continue
+            remain = child_deadline - time.monotonic()
+            try:
+                proc.wait(timeout=max(0.1, remain))
+            except Exception:
+                m.sup.kill()
+                with contextlib.suppress(Exception):
+                    proc.wait(timeout=5)
+        self._wal.close()
+        if self._telemetry is not None:
+            self._telemetry.stop()
+        metrics_path = os.environ.get("SEMMERGE_METRICS")
+        if metrics_path:
+            with contextlib.suppress(OSError):
+                obs_metrics.dump(metrics_path)
+        if os.environ.get(obs_flight.ENV_DIR):
+            obs_flight.dump(None, "daemon-drain")
+        logger.info("fleet router stopped (%d requests routed)",
+                    self._served)
+
+    # ------------------------------------------------------------------
+    # membership / health
+
+    def _ring(self) -> List[str]:
+        with self._ring_lock:
+            return [m.id for m in self._members if m.in_ring]
+
+    def _member_by_id(self, member_id: str) -> Optional[_Member]:
+        for m in self._members:
+            if m.id == member_id:
+                return m
+        return None
+
+    def _set_ring(self, member: _Member, up: bool, reason: str) -> None:
+        with self._ring_lock:
+            if member.in_ring == up:
+                return
+            before = [m.id for m in self._members if m.in_ring]
+            member.in_ring = up
+            after = [m.id for m in self._members if m.in_ring]
+            seen = list(self._seen_set)
+        moved = hashring.moved_keys(seen, before, after)
+        gauge = obs_metrics.REGISTRY.gauge("fleet_members", _MEMBERS_HELP)
+        gauge.set(len(after))
+        if moved:
+            obs_metrics.REGISTRY.counter(
+                "fleet_rehash_moves_total", _REHASH_HELP).inc(len(moved))
+        if not up:
+            obs_metrics.REGISTRY.counter(
+                "fleet_failovers_total", _FAILOVERS_HELP).inc(
+                    1, reason=reason)
+            obs_spans.record("fleet.failover", 0.0, layer="fleet",
+                             reason=reason, member=member.id)
+            obs_flight.dump(
+                None, "fleet-failover",
+                extra={"fleet": {"member": member.id, "reason": reason,
+                                 "ring": after,
+                                 "rehash_moves": len(moved)}})
+            logger.warning("fleet member %s ejected (%s); ring=%s, "
+                           "%d keys rehashed", member.id, reason, after,
+                           len(moved))
+        else:
+            logger.info("fleet member %s joined; ring=%s", member.id,
+                        after)
+
+    def _probe(self, member: _Member) -> Tuple[bool, bool]:
+        """(alive, draining) — /healthz over the member's loopback
+        telemetry port when known, the socket hello handshake
+        otherwise. A degraded (503) health answer is still *alive*:
+        SLO burn is not a membership event."""
+        if member.metrics_port:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{member.metrics_port}/healthz")
+                with urllib.request.urlopen(req, timeout=2.0) as resp:
+                    body = json.loads(resp.read().decode("utf-8"))
+                return True, bool(body.get("draining"))
+            except urllib.error.HTTPError as exc:
+                if exc.code == 503:  # degraded-but-serving
+                    return True, False
+                member.metrics_port = None
+            except Exception:
+                member.metrics_port = None  # port gone: re-discover
+        hello = self._member_call(member, "hello", {}, timeout=2.0)
+        if hello is None:
+            return False, False
+        return True, bool(hello.get("draining"))
+
+    def _discover_port(self, member: _Member) -> None:
+        status = self._member_call(member, "status", {}, timeout=5.0)
+        if status and isinstance(status.get("metrics_port"), int):
+            member.metrics_port = status["metrics_port"]
+
+    def _member_call(self, member: _Member, method: str,
+                     params: Dict[str, Any],
+                     timeout: float) -> Optional[Dict[str, Any]]:
+        """One control round-trip to a member; ``None`` on any failure."""
+        try:
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.settimeout(timeout)
+            conn.connect(member.socket_path)
+        except OSError:
+            return None
+        try:
+            rfile = conn.makefile("r", encoding="utf-8")
+            wfile = conn.makefile("w", encoding="utf-8")
+            protocol.write_message(wfile, {"id": 0, "method": method,
+                                           "params": params})
+            resp = protocol.read_message(rfile)
+            if resp is None or "result" not in resp:
+                return None
+            return resp["result"]
+        except (OSError, ValueError, protocol.ProtocolError):
+            return None
+        finally:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self._health_interval):
+            for member in self._members:
+                if self._draining:
+                    return
+                event = member.sup.ensure()
+                if event == "died":
+                    member.metrics_port = None
+                    member.fail_streak = 0
+                    self._set_ring(member, False, "crash")
+                    continue
+                if event == "spawned":
+                    member.fail_streak = 0
+                    continue
+                if not member.sup.running():
+                    continue
+                alive, draining = self._probe(member)
+                if alive:
+                    member.fail_streak = 0
+                    if member.metrics_port is None:
+                        self._discover_port(member)
+                    member.draining = draining
+                    if draining:
+                        self._set_ring(member, False, "drain")
+                    else:
+                        self._set_ring(member, True, "join")
+                else:
+                    member.fail_streak += 1
+                    if member.in_ring and \
+                            member.fail_streak >= _EJECT_AFTER:
+                        self._set_ring(member, False, "health")
+
+    def _await_ring(self, timeout: float) -> List[str]:
+        deadline = time.monotonic() + timeout
+        while True:
+            ring = self._ring()
+            if ring or time.monotonic() > deadline or self._stop.is_set():
+                return ring
+            time.sleep(0.05)
+
+    # ------------------------------------------------------------------
+    # connection handling
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("r", encoding="utf-8")
+        wfile = conn.makefile("w", encoding="utf-8")
+        try:
+            while True:
+                msg = protocol.read_message(rfile)
+                if msg is None:
+                    break
+                req_id = msg.get("id")
+                method = msg.get("method")
+                params = msg.get("params") or {}
+                if method == "hello":
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": {"ok": True, "pid": os.getpid(),
+                                   "version": protocol.PROTOCOL_VERSION,
+                                   "fleet": True,
+                                   "members_up": len(self._ring()),
+                                   "draining": self._draining}})
+                    continue
+                if method == "status":
+                    protocol.write_message(wfile, {"id": req_id,
+                                                   "result": self.status()})
+                    continue
+                if method == "metrics":
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": {
+                            "prometheus":
+                                obs_metrics.REGISTRY.render_prometheus(),
+                            "metrics": obs_metrics.REGISTRY.to_dict(),
+                            "health": self.status(),
+                        }})
+                    continue
+                if method == "drain":
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": self._drain_verb(params)})
+                    continue
+                if method == "shutdown":
+                    protocol.write_message(wfile, {"id": req_id,
+                                                   "result": {"ok": True}})
+                    self._draining = True
+                    self._stop.set()
+                    break
+                if method == "profile":
+                    # Profiling is member work: forward to the first
+                    # ring member (traffic flows through all of them).
+                    ring = self._ring()
+                    target = self._member_by_id(ring[0]) if ring else None
+                    result = (self._member_call(target, "profile", params,
+                                                timeout=120.0)
+                              if target is not None else None)
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": result or
+                        {"ok": False, "error": "no fleet member available"}})
+                    continue
+                if method not in protocol.VERBS:
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "error": {"message": f"unknown method {method!r}"}})
+                    continue
+                self._serve_verb(req_id, method, params, wfile)
+        except (protocol.ProtocolError, OSError, ValueError):
+            pass  # client went away or spoke garbage
+        finally:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def _serve_verb(self, req_id, method: str, params: Dict[str, Any],
+                    wfile) -> None:
+        if self._draining:
+            fault = FleetFault("fleet router is draining",
+                               stage="fleet:route", cause="draining")
+            protocol.write_message(wfile, {
+                "id": req_id,
+                "error": protocol.fault_error(fault, retry_after_ms=500)})
+            return
+        with self._state_lock:
+            self._in_flight += 1
+        try:
+            response = self._dispatch(method, dict(params))
+        except MergeFault as fault:
+            response = {"error": protocol.fault_error(
+                fault, trace_id=params.get("trace_id"))}
+        finally:
+            with self._state_lock:
+                self._in_flight -= 1
+                self._served += 1
+        response["id"] = req_id
+        protocol.write_message(wfile, response)
+
+    # ------------------------------------------------------------------
+    # dispatch: WAL → route → failover/hedge
+
+    def _dispatch(self, method: str,
+                  params: Dict[str, Any]) -> Dict[str, Any]:
+        # The router mints missing idempotency/trace ids: the WAL entry
+        # and every retried dispatch must share one key for the member
+        # idempotency cache (and inplace journal) to collapse replays.
+        idem = str(params.get("idempotency_key") or os.urandom(16).hex())
+        params["idempotency_key"] = idem
+        trace_id = str(params.get("trace_id") or os.urandom(8).hex())
+        params["trace_id"] = trace_id
+        key = hashring.repo_key(str(params.get("cwd") or "/"))
+        with self._ring_lock:
+            if key not in self._seen_set:
+                if len(self._seen_keys) == self._seen_keys.maxlen:
+                    self._seen_set.discard(self._seen_keys[0])
+                self._seen_keys.append(key)
+                self._seen_set.add(key)
+        with fault_boundary("fleet:route"):
+            faults.check("fleet:route")
+            self._wal.record_request(idem, method, params, trace_id)
+            response = self._route(method, params, key, idem)
+        self._wal.ack(idem)
+        return response
+
+    def _route(self, method: str, params: Dict[str, Any], key: str,
+               idem: str) -> Dict[str, Any]:
+        """Rank → dispatch → failover until a member answers."""
+        hedge_ok = self._hedge_on and "--inplace" not in (
+            params.get("argv") or [])
+        tried: set = set()
+        attempts = 0
+        max_attempts = max(2 * self._n, 4)
+        while True:
+            ring = self._ring() or self._await_ring(self._ready_timeout)
+            candidates = [m for m in hashring.rank(key, ring)
+                          if m not in tried] or hashring.rank(key, ring)
+            if not candidates:
+                raise FleetFault(
+                    "no fleet member available for dispatch",
+                    stage="fleet:route", cause="no-members")
+            target = self._member_by_id(candidates[0])
+            hedge_target = (self._member_by_id(candidates[1])
+                            if hedge_ok and len(candidates) > 1 else None)
+            t0 = time.monotonic()
+            try:
+                response, winner, hedged_won = self._send(
+                    target, hedge_target, method, params)
+            except _MemberTransport:
+                attempts += 1
+                tried.add(target.id)
+                self._set_ring(target, False, "transport")
+                obs_metrics.REGISTRY.counter(
+                    "fleet_failovers_total", _FAILOVERS_HELP).inc(
+                        1, reason="transport")
+                obs_spans.record("fleet.failover",
+                                 time.monotonic() - t0, layer="fleet",
+                                 reason="transport", member=target.id)
+                if attempts >= max_attempts:
+                    raise FleetFault(
+                        f"dispatch failed on {attempts} members",
+                        stage="fleet:failover", cause="transport")
+                continue
+            dt = time.monotonic() - t0
+            self._latencies.append(dt)
+            winner.dispatches += 1
+            obs_spans.record("fleet.route", dt, layer="fleet",
+                             verb=method, member=winner.id)
+            if hedged_won:
+                obs_metrics.REGISTRY.counter(
+                    "fleet_hedge_wins_total", _HEDGE_WINS_HELP).inc(1)
+            return response
+
+    def _hedge_delay_s(self) -> float:
+        lat = sorted(self._latencies)
+        if len(lat) >= 20:
+            p99 = lat[min(len(lat) - 1, int(0.99 * (len(lat) - 1)))]
+            ms = p99 * 1000.0
+        else:
+            ms = float(self._hedge_default_ms)
+        return min(max(ms, float(self._hedge_min_ms)),
+                   float(self._hedge_cap_ms)) / 1000.0
+
+    def _send(self, target: _Member, hedge_target: Optional[_Member],
+              method: str, params: Dict[str, Any],
+              ) -> Tuple[Dict[str, Any], _Member, bool]:
+        """Dispatch to ``target``, optionally hedging to
+        ``hedge_target`` after the p99-derived delay. Returns
+        ``(response, winning member, hedge_won)``; raises
+        :class:`_MemberTransport` only when every attempted leg died."""
+        self._wal.record_dispatch(params["idempotency_key"], target.id)
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+        lock = threading.Lock()
+        conns: Dict[str, socket.socket] = {}
+
+        def leg(member: _Member, is_hedge: bool) -> None:
+            try:
+                resp = self._member_verb(member, method, params, conns)
+            except _MemberTransport:
+                with lock:
+                    box.setdefault("dead", []).append(member.id)
+                    if len(box.get("dead", [])) >= legs_total[0]:
+                        done.set()
+                return
+            with lock:
+                if "resp" not in box:
+                    box["resp"] = (resp, member, is_hedge)
+                    done.set()
+
+        legs_total = [1]
+        threading.Thread(target=leg, args=(target, False),
+                         daemon=True).start()
+        if hedge_target is not None:
+            if not done.wait(self._hedge_delay_s()):
+                with lock:
+                    launch_hedge = "resp" not in box and \
+                        len(box.get("dead", [])) == 0
+                if launch_hedge:
+                    legs_total[0] = 2
+                    obs_metrics.REGISTRY.counter(
+                        "fleet_hedges_total", _HEDGES_HELP).inc(1)
+                    self._wal.record_dispatch(params["idempotency_key"],
+                                              hedge_target.id)
+                    threading.Thread(target=leg,
+                                     args=(hedge_target, True),
+                                     daemon=True).start()
+        if not done.wait(self._request_timeout):
+            for c in conns.values():
+                with contextlib.suppress(OSError):
+                    c.close()
+            raise _MemberTransport("request timed out on every leg")
+        with lock:
+            if "resp" not in box:
+                raise _MemberTransport("all dispatch legs died")
+            resp, winner, is_hedge = box["resp"]
+        # Cancel the loser: closing its connection is the only
+        # cancellation the wire offers; the member's own admission/
+        # deadline machinery bounds the abandoned work.
+        for member_id, c in list(conns.items()):
+            if member_id != winner.id:
+                with contextlib.suppress(OSError):
+                    c.close()
+        if is_hedge:
+            obs_spans.record("fleet.hedge", 0.0, layer="fleet",
+                             member=winner.id, won=True)
+        return resp, winner, is_hedge
+
+    def _member_verb(self, member: _Member, method: str,
+                     params: Dict[str, Any],
+                     conns: Dict[str, socket.socket]) -> Dict[str, Any]:
+        """One verb round-trip; raises :class:`_MemberTransport` on any
+        transport-shaped failure. A well-formed ``result`` *or typed*
+        ``error`` frame is a final answer and passes through."""
+        try:
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.settimeout(self._request_timeout)
+            conn.connect(member.socket_path)
+        except OSError as exc:
+            raise _MemberTransport(str(exc)) from exc
+        conns[member.id] = conn
+        try:
+            rfile = conn.makefile("r", encoding="utf-8")
+            wfile = conn.makefile("w", encoding="utf-8")
+            protocol.write_message(wfile, {"id": 1, "method": method,
+                                           "params": params})
+            resp = protocol.read_message(rfile)
+        except (OSError, ValueError, protocol.ProtocolError) as exc:
+            raise _MemberTransport(str(exc)) from exc
+        finally:
+            conns.pop(member.id, None)
+            with contextlib.suppress(OSError):
+                conn.close()
+        if resp is None:
+            raise _MemberTransport("member closed the connection")
+        if "result" in resp:
+            return {"result": resp["result"]}
+        error = resp.get("error")
+        if isinstance(error, dict) and "exit_code" in error:
+            return {"error": error}  # typed: the member's final answer
+        raise _MemberTransport(f"malformed member response: {resp!r}")
+
+    # ------------------------------------------------------------------
+    # replay
+
+    def _replay(self, pending: List[Dict[str, Any]]) -> None:
+        """Re-dispatch entries journaled by a previous router
+        incarnation but never acked. The client that sent them saw a
+        transport failure and is retrying (or gave up); replay makes
+        the *effect* durable either way. Idempotency keys make the
+        collision of both paths harmless."""
+        if not self._await_ring(self._ready_timeout):
+            logger.warning("WAL replay: no members came up; %d entries "
+                           "stay open", len(pending))
+            return
+        for rec in pending:
+            if self._stop.is_set():
+                return
+            params = rec.get("params") or {}
+            verb = rec.get("verb")
+            key = hashring.repo_key(str(params.get("cwd") or "/"))
+            idem = rec.get("key")
+            try:
+                with fault_boundary("fleet:replay"):
+                    self._route(verb, dict(params), key, idem)
+            except MergeFault as fault:
+                logger.warning("WAL replay of %s failed: %s", idem,
+                               fault.describe())
+                continue
+            self._wal.ack(idem)
+            self._replayed += 1
+            obs_metrics.REGISTRY.counter(
+                "fleet_wal_replayed_total", _REPLAY_HELP).inc(1)
+            logger.info("WAL replay settled %s (%s)", idem, verb)
+
+    # ------------------------------------------------------------------
+    # control verbs
+
+    def _drain_verb(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        member_id = params.get("member")
+        if member_id:
+            member = self._member_by_id(str(member_id))
+            if member is None:
+                return {"ok": False,
+                        "error": f"unknown member {member_id!r}"}
+            member.draining = True
+            self._set_ring(member, False, "drain")
+            result = self._member_call(member, "drain", {}, timeout=5.0)
+            return {"ok": True, "member": member.id,
+                    "member_ack": result}
+        self._draining = True
+        self._stop.set()
+        return {"ok": True, "draining": True}
+
+    def status(self) -> Dict[str, Any]:
+        with self._state_lock:
+            in_flight, served = self._in_flight, self._served
+        return {
+            "ok": True,
+            "fleet": True,
+            "pid": os.getpid(),
+            "version": protocol.PROTOCOL_VERSION,
+            "socket": self._socket_path,
+            "uptime_s": round(time.time() - self._t0, 3),
+            "draining": self._draining,
+            "in_flight": in_flight,
+            "served_total": served,
+            "members": [m.view() for m in self._members],
+            "members_up": len(self._ring()),
+            "wal": {"dir": self._wal.directory,
+                    "open": self._wal.open_count(),
+                    "replayed": self._replayed},
+            "hedge": {"enabled": self._hedge_on,
+                      "delay_ms": round(self._hedge_delay_s() * 1000.0,
+                                        3)},
+            "metrics": obs_metrics.REGISTRY.to_dict(),
+        }

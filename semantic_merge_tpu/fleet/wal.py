@@ -1,0 +1,256 @@
+"""Per-router dispatch WAL — durable in-flight requests.
+
+The router journals every merge request *before* dispatching it to a
+member and acknowledges it after the response is written back to the
+client. The journal is what turns two crash windows into retries
+instead of losses:
+
+- **member crash mid-request** — the dispatching thread observes the
+  transport failure and retries on the rehashed owner; the WAL entry
+  just stays open a little longer.
+- **router crash** — on restart the router replays every journaled
+  entry without an ack to the entry's current owner. The client never
+  got an answer, so it is retrying anyway; replay makes the *effect*
+  happen even if every client gave up.
+
+Exactly-once effects come from the layers below, not the WAL itself:
+every journaled request carries the client's idempotency key (the
+router mints one when the client didn't), so a member that already
+executed the request replays its cached response, and a re-executed
+``--inplace`` merge is byte-safe under the PR 4 inplace journal +
+repo lockfile. The WAL only has to guarantee *at-least-once* dispatch
+with stable keys; the idempotency layer collapses that to
+exactly-once effects.
+
+Format: one append-only JSONL file (``wal.jsonl``) inside the router's
+WAL directory (default ``<socket>.semmerge-fleet-wal/``). Records:
+
+- ``{"kind": "request", "key", "verb", "params", "trace_id", "t"}``
+  — fsync'd before the first dispatch; ``params`` is the full wire
+  params dict so replay needs no other source.
+- ``{"kind": "dispatch", "key", "member", "t"}`` — one per attempt
+  (audit trail for the chaos harness; not fsync'd).
+- ``{"kind": "ack", "key", "t"}`` — the response reached (or was
+  written toward) the client; the entry is settled.
+
+Torn tails happen (SIGKILL mid-append): the reader skips undecodable
+lines, which can only lose the *last* record — a lost ``request`` was
+never dispatched (the client saw a transport error and retries), a
+lost ``ack`` causes one harmless idempotent replay.
+
+On :meth:`WriteAheadLog.open` the previous incarnation's file is
+archived as a numbered segment (``wal.<n>.jsonl``) and the open
+entries are carried into a fresh ``wal.jsonl`` — the active file stays
+bounded by the in-flight window while the segments preserve the full
+dispatch/ack history for the chaos harness's duplicate-commit audit.
+Only the most recent :data:`KEEP_SEGMENTS` segments are retained.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: Journal directory name suffix (appended to the router socket path).
+WAL_DIRNAME = ".semmerge-fleet-wal"
+#: Journal file inside the WAL directory.
+WAL_FILE = "wal.jsonl"
+#: Documented record kinds (``scripts/check_trace_schema.py
+#: validate_fleet`` pins the shapes).
+RECORD_KINDS = ("request", "dispatch", "ack")
+#: Archived segments kept after an open/compact cycle.
+KEEP_SEGMENTS = 16
+
+
+def default_dir(socket_path: str) -> str:
+    """The per-router WAL directory for a router socket path."""
+    return socket_path + WAL_DIRNAME
+
+
+class WriteAheadLog:
+    """Append-only, fsync'd-on-request dispatch journal.
+
+    Thread-safe: the router's per-connection threads append
+    concurrently under one lock. Every mutator is crash-tolerant in
+    the direction that matters — a ``request`` record is on disk
+    before the caller may dispatch, everything else is best-effort.
+    """
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+        self.path = os.path.join(directory, WAL_FILE)
+        self._lock = threading.Lock()
+        self._fh: Optional[Any] = None
+        self._open_keys: Dict[str, Dict[str, Any]] = {}
+        self.replayable: List[Dict[str, Any]] = []
+
+    # -- lifecycle ---------------------------------------------------
+
+    def open(self) -> List[Dict[str, Any]]:
+        """Open (creating the directory), archive + compact, and return
+        the entries journaled-but-unacked by a previous incarnation —
+        the replay set for this router start."""
+        os.makedirs(self.directory, exist_ok=True)
+        pending = self._read_pending()
+        if os.path.exists(self.path):
+            self._archive_current()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for rec in pending:
+                fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._open_keys = {rec["key"]: rec for rec in pending}
+        self.replayable = list(pending)
+        return list(pending)
+
+    def _archive_current(self) -> None:
+        nums = [0]
+        for name in os.listdir(self.directory):
+            if name.startswith("wal.") and name.endswith(".jsonl"):
+                mid = name[len("wal."):-len(".jsonl")]
+                if mid.isdigit():
+                    nums.append(int(mid))
+        nxt = max(nums) + 1
+        os.replace(self.path,
+                   os.path.join(self.directory, f"wal.{nxt}.jsonl"))
+        stale = sorted(n for n in nums if n)[:-KEEP_SEGMENTS]
+        for n in stale:
+            try:
+                os.unlink(os.path.join(self.directory,
+                                       f"wal.{n}.jsonl"))
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+    # -- mutators ----------------------------------------------------
+
+    def record_request(self, key: str, verb: str,
+                       params: Dict[str, Any],
+                       trace_id: Optional[str]) -> None:
+        """Journal a request durably (fsync) before first dispatch.
+
+        Re-journaling an already-open key is a no-op: a replayed entry
+        keeps its original record.
+        """
+        with self._lock:
+            if key in self._open_keys:
+                return
+            rec = {"kind": "request", "key": key, "verb": verb,
+                   "params": params, "trace_id": trace_id,
+                   "t": time.time()}
+            self._append(rec, durable=True)
+            self._open_keys[key] = rec
+
+    def record_dispatch(self, key: str, member: str) -> None:
+        """Audit one dispatch attempt (best-effort, not fsync'd)."""
+        with self._lock:
+            self._append({"kind": "dispatch", "key": key,
+                          "member": member, "t": time.time()},
+                         durable=False)
+
+    def ack(self, key: str) -> None:
+        """Settle an entry. A lost ack (crash right after the response)
+        costs one idempotent replay, never a wrong result."""
+        with self._lock:
+            if key not in self._open_keys:
+                return
+            self._append({"kind": "ack", "key": key,
+                          "t": time.time()}, durable=False)
+            del self._open_keys[key]
+
+    def open_count(self) -> int:
+        with self._lock:
+            return len(self._open_keys)
+
+    # -- internals ---------------------------------------------------
+
+    def _append(self, rec: Dict[str, Any], *, durable: bool) -> None:
+        if self._fh is None:  # closed (teardown race) — drop silently
+            return
+        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._fh.flush()
+        if durable:
+            os.fsync(self._fh.fileno())
+
+    def _read_pending(self) -> List[Dict[str, Any]]:
+        """Parse the existing journal into its unacked request records
+        (in journal order), skipping torn/undecodable lines."""
+        requests: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        try:
+            fh = open(self.path, "r", encoding="utf-8")
+        except OSError:
+            return []
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail from a SIGKILL mid-append
+                kind = rec.get("kind")
+                key = rec.get("key")
+                if not isinstance(key, str):
+                    continue
+                if kind == "request" and key not in requests:
+                    requests[key] = rec
+                    order.append(key)
+                elif kind == "ack":
+                    requests.pop(key, None)
+        return [requests[k] for k in order if k in requests]
+
+
+def read_records(directory: str) -> List[Dict[str, Any]]:
+    """All decodable records across every retained segment plus the
+    active file, oldest first.
+
+    The chaos harness's audit surface: it groups these by key to
+    assert every settled request was journaled and that re-journaling
+    after replay never happened (exactly-once dispatch accounting).
+    """
+    paths: List[str] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    nums = []
+    for name in names:
+        if name.startswith("wal.") and name.endswith(".jsonl"):
+            mid = name[len("wal."):-len(".jsonl")]
+            if mid.isdigit():
+                nums.append(int(mid))
+    for n in sorted(nums):
+        paths.append(os.path.join(directory, f"wal.{n}.jsonl"))
+    paths.append(os.path.join(directory, WAL_FILE))
+    out: List[Dict[str, Any]] = []
+    for path in paths:
+        try:
+            fh = open(path, "r", encoding="utf-8")
+        except OSError:
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    return out

@@ -55,7 +55,7 @@ POSTMORTEM_SCHEMA = 1
 #: Documented ``reason`` values a bundle may carry.
 REASONS = ("fault-escape", "degradation", "breaker-transition",
            "supervisor-restart", "daemon-drain", "slo-burn",
-           "resolver-fault")
+           "resolver-fault", "fleet-failover")
 
 _lock = threading.Lock()
 _ring: Optional[deque] = None

@@ -53,6 +53,13 @@ _HIGHER_BETTER_SUFFIXES = ("/sec", "/s")
 GUARDED_FIELDS = {
     "scaling_efficiency": "higher",
     "merges_per_sec_per_chip": "higher",
+    # Fleet preset: the single-member floor and the 3-member headline
+    # must not regress, and the rendezvous rehash quality (fraction of
+    # keys that move owners on a single-member loss; lower-better) must
+    # stay near 1/N rather than drifting toward a mod-N ring's ~1.0.
+    "fleet_merges_per_sec_m1": "higher",
+    "fleet_merges_per_sec_m3": "higher",
+    "fleet_rehash_miss_rate": "lower",
 }
 
 

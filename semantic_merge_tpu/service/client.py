@@ -20,6 +20,14 @@ is the result, exactly as a one-shot run with the same injected fault
 would have exited; falling back and re-running would turn a
 deterministic typed failure into a double execution.
 
+``SEMMERGE_FLEET`` layers fleet discovery on top: ``auto`` prefers an
+already-listening fleet router on the service socket (never spawns
+one) and falls back to the plain ``SEMMERGE_DAEMON`` posture;
+``require`` demands the socket answer *as a fleet router* (its hello
+carries ``fleet: true``) and fails with the ``FleetFault`` exit (19)
+otherwise. ``off`` (default) leaves this module byte-identical to the
+fleet-less client.
+
 :func:`delegate` is called from ``__main__`` BEFORE ``cli`` (and
 therefore jax) is imported — the client path costs milliseconds, which
 is the whole point of the warm daemon.
@@ -41,6 +49,10 @@ from . import protocol
 #: code (errors.EXIT_CODES), hardcoded so this module never imports
 #: the heavy package half.
 _REQUIRE_FAILED_EXIT = 12
+
+#: Exit for ``SEMMERGE_FLEET=require`` with no fleet router — the
+#: FleetFault code (errors.EXIT_CODES), hardcoded for the same reason.
+_FLEET_REQUIRE_EXIT = 19
 
 _Conn = Tuple[socket.socket, Any, Any]  # (sock, rfile, wfile)
 
@@ -66,6 +78,15 @@ def mode() -> str:
     return os.environ.get("SEMMERGE_DAEMON", "off").strip().lower()
 
 
+def fleet_mode() -> str:
+    """The ``SEMMERGE_FLEET`` posture. Parsed locally (not via
+    ``fleet.mode``) so the hot client path keeps its import set."""
+    raw = os.environ.get("SEMMERGE_FLEET", "").strip().lower()
+    if raw in ("auto", "require"):
+        return raw
+    return "off"
+
+
 def delegate(argv: Sequence[str]) -> Optional[int]:
     """Run ``argv`` (full CLI argv, ``argv[0]`` the subcommand) on the
     daemon. Returns the exit code, or ``None`` when the invocation
@@ -74,11 +95,28 @@ def delegate(argv: Sequence[str]) -> Optional[int]:
     argv = [str(a) for a in argv]
     if not argv or argv[0] not in protocol.VERBS:
         return None
+    if os.environ.get("_SEMMERGE_IN_DAEMON"):
+        return None  # belt and suspenders: the daemon never re-delegates
+    fm = fleet_mode()
+    if fm in ("auto", "require"):
+        # Fleet discovery: reach for a listening router first. Never
+        # spawns — a client-spawned daemon would not be a fleet — and
+        # only a socket that answers AS a fleet router counts; a plain
+        # daemon squatting the path routes via the daemon posture.
+        try:
+            return _run_on_daemon(argv[0], argv[1:], spawn=False,
+                                  require_fleet=True)
+        except DaemonUnavailable as exc:
+            if fm == "require":
+                sys.stderr.write(f"semmerge: fleet required but "
+                                 f"unavailable: {exc} "
+                                 f"(exit {_FLEET_REQUIRE_EXIT})\n")
+                return _FLEET_REQUIRE_EXIT
+            # fleet auto: no router listening — fall through to the
+            # plain daemon posture below, never worse than fleet-less.
     m = mode()
     if m not in ("auto", "require"):
         return None
-    if os.environ.get("_SEMMERGE_IN_DAEMON"):
-        return None  # belt and suspenders: the daemon never re-delegates
     try:
         return _run_on_daemon(argv[0], argv[1:])
     except DaemonUnavailable as exc:
@@ -89,7 +127,8 @@ def delegate(argv: Sequence[str]) -> Optional[int]:
         return None  # auto: warm path failed, run one-shot
 
 
-def _run_on_daemon(verb: str, rest: List[str]) -> int:
+def _run_on_daemon(verb: str, rest: List[str], *, spawn: bool = True,
+                   require_fleet: bool = False) -> int:
     """Delegate with bounded retries. Two retry-worthy outcomes exist:
 
     - a **transient admission rejection** (``retry_after_ms`` on the
@@ -115,10 +154,11 @@ def _run_on_daemon(verb: str, rest: List[str]) -> int:
     while True:
         try:
             return _attempt_on_daemon(verb, rest, deadline, idem_key,
-                                      trace_id)
+                                      trace_id, spawn=spawn,
+                                      require_fleet=require_fleet)
         except _RetryableRejection as rej:
             if attempt >= retries:
-                if mode() == "require":
+                if mode() == "require" or require_fleet:
                     if rej.message:
                         sys.stderr.write(f"semmerge: {rej.message} "
                                          f"(exit {rej.exit_code})\n")
@@ -137,8 +177,11 @@ def _run_on_daemon(verb: str, rest: List[str]) -> int:
 
 
 def _attempt_on_daemon(verb: str, rest: List[str], deadline: float,
-                       idem_key: str, trace_id: str) -> int:
-    sock, rfile, wfile = _connect_or_spawn()
+                       idem_key: str, trace_id: str, *,
+                       spawn: bool = True,
+                       require_fleet: bool = False) -> int:
+    sock, rfile, wfile = _connect_or_spawn(spawn=spawn,
+                                           require_fleet=require_fleet)
     try:
         params: Dict[str, Any] = {
             "argv": rest,
@@ -217,10 +260,13 @@ def _close(sock, rfile, wfile) -> None:
             pass
 
 
-def _try_connect(path: str, timeout: float = 5.0) -> Optional[_Conn]:
+def _try_connect(path: str, timeout: float = 5.0,
+                 require_fleet: bool = False) -> Optional[_Conn]:
     """Connect + ``hello`` handshake. ``None`` means nothing usable is
     listening (absent socket, stale socket, or a peer that cannot
-    complete the handshake)."""
+    complete the handshake). With ``require_fleet`` the peer must
+    answer as a fleet router (``fleet: true`` in its hello) — a plain
+    daemon on the path counts as unusable."""
     if not os.path.exists(path):
         return None
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -240,6 +286,9 @@ def _try_connect(path: str, timeout: float = 5.0) -> Optional[_Conn]:
     if not (isinstance(resp, dict) and resp.get("id") == 0
             and isinstance(resp.get("result"), dict)
             and resp["result"].get("ok")):
+        _close(sock, rfile, wfile)
+        return None
+    if require_fleet and not resp["result"].get("fleet"):
         _close(sock, rfile, wfile)
         return None
     sock.settimeout(None)
@@ -264,11 +313,16 @@ def _spawn_daemon(path: str) -> subprocess.Popen:
             cwd="/", env=env, start_new_session=True)
 
 
-def _connect_or_spawn() -> _Conn:
+def _connect_or_spawn(*, spawn: bool = True,
+                      require_fleet: bool = False) -> _Conn:
     path = protocol.socket_path()
-    conn = _try_connect(path)
+    conn = _try_connect(path, require_fleet=require_fleet)
     if conn is not None:
         return conn
+    if not spawn:
+        raise DaemonUnavailable(
+            f"no {'fleet router' if require_fleet else 'daemon'} "
+            f"listening on {path}")
     spawn_timeout = _env_float("SEMMERGE_SERVICE_SPAWN_TIMEOUT", 30.0)
     proc = _spawn_daemon(path)
     t0 = time.monotonic()
@@ -277,12 +331,19 @@ def _connect_or_spawn() -> _Conn:
         if conn is not None:
             return conn
         if proc.poll() is not None:
-            # The spawned process exited — either it lost a startup
-            # race to another daemon (which should now be connectable)
-            # or it failed to come up.
-            conn = _try_connect(path)
-            if conn is not None:
-                return conn
+            # The spawned process exited — usually because it lost the
+            # startup bind race to a concurrent spawner. The winner may
+            # still be warming up (it binds its socket well before it
+            # can answer the handshake), so a single probe here turned
+            # real winners into spurious cold-path fallbacks. Keep
+            # reconnecting for a bounded window instead.
+            reconnect = _env_float("SEMMERGE_SERVICE_RECONNECT", 2.0)
+            r0 = time.monotonic()
+            while time.monotonic() - r0 < reconnect:
+                conn = _try_connect(path)
+                if conn is not None:
+                    return conn
+                time.sleep(0.1)
             raise DaemonUnavailable(
                 f"daemon exited rc={proc.returncode} during startup "
                 f"(log: {path}.log)")

@@ -198,8 +198,19 @@ class Daemon:
         self._soft_mb, self._hard_mb = resilience.rss_watermarks()
         self._pressure = _PRESSURE_NONE
         self._idem_cap = max(0, _env_int("SEMMERGE_SERVICE_IDEM_CACHE", 256))
+        # Idempotency entries older than the TTL are dropped on lookup:
+        # a resend after that long is treated as a fresh request (safe —
+        # merges are deterministic and --inplace is journal-protected).
+        # 0 (the default) keeps the pre-TTL behavior: size-only LRU.
+        self._idem_ttl = max(0.0, env_seconds("SEMMERGE_SERVICE_IDEM_TTL",
+                                              0.0))
         self._idem_lock = threading.Lock()
         self._idem: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        # Draining: admission closed (new requests get a *retryable*
+        # typed rejection), in-flight work finishes. Set by the `drain`
+        # wire verb (fleet handoff) and by the signal handler.
+        self._draining = False
+        self._fleet_member = os.environ.get("SEMMERGE_FLEET_MEMBER") or None
         self._telemetry: Optional[telemetry.TelemetryServer] = None
         # SLO engine: SEMMERGE_SLO env wins, then the [slo] config
         # table found from the daemon's cwd; None = no objectives, no
@@ -331,6 +342,7 @@ class Daemon:
     def _install_signal_handlers(self) -> None:
         def _on_signal(signum, frame):
             logger.info("signal %d: draining and shutting down", signum)
+            self._draining = True
             self._stop.set()
         try:
             signal.signal(signal.SIGTERM, _on_signal)
@@ -425,10 +437,30 @@ class Daemon:
                 method = msg.get("method")
                 params = msg.get("params") or {}
                 if method == "hello":
+                    hello = {"ok": True, "pid": os.getpid(),
+                             "version": protocol.PROTOCOL_VERSION}
+                    if self._fleet_member is not None:
+                        # Membership announce: a router's health probe
+                        # learns from the handshake that this daemon is
+                        # the member it spawned (and whether it is
+                        # already draining toward handoff).
+                        hello["fleet_member"] = self._fleet_member
+                        hello["draining"] = self._draining
+                    protocol.write_message(wfile,
+                                           {"id": req_id, "result": hello})
+                    continue
+                if method == "drain":
+                    # Fleet handoff: close admission but keep serving
+                    # in-flight and queued work. New requests get a
+                    # retryable typed rejection so clients re-route.
+                    self._draining = True
+                    with self._state_lock:
+                        in_flight = self._in_flight
                     protocol.write_message(wfile, {
                         "id": req_id,
-                        "result": {"ok": True, "pid": os.getpid(),
-                                   "version": protocol.PROTOCOL_VERSION}})
+                        "result": {"ok": True, "draining": True,
+                                   "in_flight": in_flight,
+                                   "queue_depth": self._queue.qsize()}})
                     continue
                 if method == "status":
                     protocol.write_message(wfile,
@@ -515,7 +547,7 @@ class Daemon:
     #: ``retry_after_ms`` — transient overload, not request-shaped
     #: failures.
     _RETRYABLE_CAUSES = frozenset(
-        {"queue-full", "overload", "projected-deadline"})
+        {"queue-full", "overload", "projected-deadline", "draining"})
 
     def _admit(self, req: _Request) -> None:
         """Admission control, cheapest checks first: hard-watermark
@@ -524,6 +556,11 @@ class Daemon:
         pays full price at the worst time), a projected queue wait
         past the request deadline is rejected up front instead of
         timing out in the queue, and finally the bounded queue itself."""
+        if self._draining:
+            self._shed("draining")
+            raise WorkerFault(
+                "daemon is draining: admission closed",
+                stage="service:accept", cause="draining")
         if self._pressure >= _PRESSURE_HARD:
             self._shed("rss-hard")
             raise WorkerFault(
@@ -586,10 +623,19 @@ class Daemon:
         if not req.idem_key or not self._idem_cap:
             return None
         with self._idem_lock:
-            cached = self._idem.get(req.idem_key)
-            if cached is None:
+            entry = self._idem.get(req.idem_key)
+            if entry is None:
+                return None
+            if self._idem_ttl > 0 and \
+                    time.monotonic() - entry["t"] > self._idem_ttl:
+                # Expired: the resend re-executes as a fresh request —
+                # safe (deterministic merges; --inplace is protected by
+                # the commit journal + repo lockfile), and it frees the
+                # slot instead of replaying arbitrarily stale output.
+                del self._idem[req.idem_key]
                 return None
             self._idem.move_to_end(req.idem_key)
+            cached = entry["response"]
         obs_metrics.REGISTRY.counter(
             "service_idempotent_replays_total", _IDEM_HELP).inc(1)
         resp = dict(cached)
@@ -600,7 +646,8 @@ class Daemon:
         if not req.idem_key or not self._idem_cap or req.response is None:
             return
         with self._idem_lock:
-            self._idem[req.idem_key] = req.response
+            self._idem[req.idem_key] = {"response": req.response,
+                                        "t": time.monotonic()}
             self._idem.move_to_end(req.idem_key)
             while len(self._idem) > self._idem_cap:
                 self._idem.popitem(last=False)
@@ -928,6 +975,8 @@ class Daemon:
             "in_flight": in_flight,
             "served_total": served,
             "workers": self._workers_n,
+            "draining": self._draining,
+            "fleet_member": self._fleet_member,
             "repos_tracked": len(self._repo_locks),
             "rss_mb": round(_rss_mb(), 3),
             "metrics_port": (self._telemetry.port

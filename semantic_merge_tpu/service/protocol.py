@@ -46,7 +46,8 @@ VERBS = ("semdiff", "semmerge", "semrebase")
 #: process owns it, the service socket is connection metadata, and the
 #: SLO engine is daemon-lifetime state — a client's objectives must not
 #: reconfigure a shared daemon per request.
-_UNSHIPPED_PREFIXES = ("SEMMERGE_SERVICE_", "SEMMERGE_SLO")
+_UNSHIPPED_PREFIXES = ("SEMMERGE_SERVICE_", "SEMMERGE_SLO",
+                       "SEMMERGE_FLEET")
 _UNSHIPPED = frozenset({"SEMMERGE_DAEMON", "SEMMERGE_METRICS",
                         "SEMMERGE_METRICS_PORT"})
 

@@ -38,6 +38,29 @@ def get(name: str, default: Optional[str] = None) -> Optional[str]:
     return os.environ.get(name, default)
 
 
+def posture(name: str, default: str = "off",
+            choices: tuple = ("off", "auto", "require")) -> str:
+    """Parse a three-state ``off|auto|require`` posture variable.
+
+    The service postures (``SEMMERGE_DAEMON``, ``SEMMERGE_MESH``,
+    ``SEMMERGE_FLEET``) share one vocabulary; this is the one
+    overlay-aware parser for it. Unknown or empty values normalize to
+    ``default`` — a misspelled posture must degrade to the safe
+    default, never crash a merge. Common boolean spellings map onto
+    the vocabulary (``1/on/yes/true`` → ``auto``, ``0/no/false`` →
+    ``off``) so operators who treat the knob as a switch get the
+    conservative reading.
+    """
+    raw = (get(name) or "").strip().lower()
+    if raw in choices:
+        return raw
+    if raw in ("1", "on", "yes", "true"):
+        return "auto"
+    if raw in ("0", "no", "false"):
+        return "off"
+    return default
+
+
 def active() -> Optional[dict]:
     """The current overlay dict (request-scoped mutable state lives
     here), or ``None`` outside any request scope."""

@@ -83,6 +83,44 @@ def test_chaos_full_soak(chaos_soak, tmp_path):
     assert report["breaker_transitions"] >= 1
 
 
+def test_fleet_chaos_smoke(chaos_soak, tmp_path):
+    """The ISSUE 14 kill-drill: a 3-member fleet under byte-exact
+    traffic with one member SIGKILL and one router SIGKILL mid-stream.
+    The replacement router reclaims the orphaned members and replays
+    its WAL; every request settles byte-exact with documented exits,
+    and the full journal history accounts for each effect exactly
+    once."""
+    report = chaos_soak.run_fleet_soak(
+        tmp_path / "fleet", requests=24, repos=4, concurrency=4,
+        members=3, member_kills=1, router_kills=1, seed=3)
+    assert report["errors"] == [], "\n".join(report["errors"])
+    assert report["ok"] is True
+    total = sum(sum(per_code.values())
+                for per_code in report["outcomes"].values())
+    assert total == 24
+    # Kills landed and the fleet healed: failovers counted, a
+    # replacement router pid appeared, the ring refilled.
+    assert report["member_kills"] == 1
+    assert report["router_kills"] == 1
+    assert report["failovers_total"] >= 1
+    assert report["router_pids_seen"] >= 2
+    assert report["members_up"] == 3
+    # Exactly-once accounting: nothing left open in the journal.
+    assert report["wal_open"] == 0
+
+
+@pytest.mark.slow
+def test_fleet_chaos_full_drill(chaos_soak, tmp_path):
+    report = chaos_soak.run_fleet_soak(
+        tmp_path / "fleet", requests=120, repos=8, concurrency=8,
+        members=3, member_kills=3, router_kills=2, seed=11)
+    assert report["errors"] == [], "\n".join(report["errors"])
+    assert report["member_kills"] == 3
+    assert report["router_kills"] == 2
+    assert report["failovers_total"] >= 3
+    assert report["wal_open"] == 0
+
+
 def test_cli_entrypoint_smoke(chaos_soak, tmp_path, capsys):
     """The standalone CLI path: tiny run, human-readable summary."""
     rc = chaos_soak.main(["--requests", "8", "--repos", "2",

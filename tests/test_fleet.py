@@ -1,0 +1,458 @@
+"""Fault-tolerant daemon fleet (ISSUE 14): rendezvous-hash routing,
+the durable dispatch WAL, the client's fleet posture and spawn-race
+reconnect, and a live router fronting supervised members.
+
+The bar:
+
+- Rendezvous hashing is deterministic, roughly balanced, and — the
+  property the fleet exists for — removing a member moves *only* that
+  member's keys.
+- The WAL journals a request durably before dispatch, carries unacked
+  entries across restarts (replay set), archives history for the
+  chaos audit, and tolerates a torn tail from a SIGKILL mid-append.
+- ``SEMMERGE_FLEET=require`` with no router is the documented exit 19;
+  ``auto`` falls back through the daemon posture. A plain daemon on
+  the socket never satisfies a fleet connect (``fleet: true`` is
+  required in the hello).
+- A client that loses the daemon spawn race keeps reconnecting for a
+  bounded window instead of treating the winner's slow handshake as a
+  hard transport failure.
+- A live router announces itself, pins a repo to its rendezvous owner,
+  drains members on request, and hedges a slow member's read to a
+  second member (first response wins).
+"""
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from semantic_merge_tpu.fleet import FLEET_EXIT, hashring, mode
+from semantic_merge_tpu.fleet import wal as fleet_wal
+from semantic_merge_tpu.service import protocol
+
+from test_resilience import build_repo, raw_close, raw_conn, send_merge
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous hashing
+# ---------------------------------------------------------------------------
+
+MEMBERS = ["m0", "m1", "m2"]
+KEYS = [f"/repos/project-{i}" for i in range(300)]
+
+
+def test_rendezvous_owner_deterministic_and_balanced():
+    owners = {k: hashring.owner(k, MEMBERS) for k in KEYS}
+    assert owners == {k: hashring.owner(k, MEMBERS) for k in KEYS}
+    assert owners == {k: hashring.owner(k, list(reversed(MEMBERS)))
+                      for k in KEYS}, "owner must not depend on order"
+    counts = {m: 0 for m in MEMBERS}
+    for m in owners.values():
+        counts[m] += 1
+    # Rough balance: no member below a third of its fair share.
+    assert all(c >= len(KEYS) / len(MEMBERS) / 3 for c in counts.values()), \
+        counts
+
+
+def test_rendezvous_removal_moves_only_failed_members_keys():
+    owners = {k: hashring.owner(k, MEMBERS) for k in KEYS}
+    survivors = ["m0", "m2"]
+    moved = hashring.moved_keys(KEYS, MEMBERS, survivors)
+    assert set(moved) == {k for k, o in owners.items() if o == "m1"}
+    # Survivors keep every key they already owned.
+    for k, o in owners.items():
+        if o != "m1":
+            assert hashring.owner(k, survivors) == o
+    # And adding the member back restores the original assignment.
+    assert {k: hashring.owner(k, MEMBERS) for k in KEYS} == owners
+
+
+def test_rendezvous_rank_is_total_failover_order():
+    for k in KEYS[:20]:
+        rank = hashring.rank(k, MEMBERS)
+        assert sorted(rank) == sorted(MEMBERS)
+        assert rank[0] == hashring.owner(k, MEMBERS)
+        # Rank with the owner removed == the tail of the full rank:
+        # failover lands exactly where the rehash says it should.
+        assert hashring.rank(k, [m for m in MEMBERS if m != rank[0]]) \
+            == rank[1:]
+    with pytest.raises(ValueError):
+        hashring.owner("/k", [])
+
+
+def test_repo_key_is_realpath(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    link = tmp_path / "link"
+    link.symlink_to(repo)
+    assert hashring.repo_key(str(link)) == hashring.repo_key(str(repo))
+
+
+# ---------------------------------------------------------------------------
+# Write-ahead log
+# ---------------------------------------------------------------------------
+
+def test_wal_journal_ack_and_replay_cycle(tmp_path):
+    d = str(tmp_path / "wal")
+    w = fleet_wal.WriteAheadLog(d)
+    assert w.open() == []
+    w.record_request("k1", "semmerge", {"argv": ["a"]}, "t1")
+    w.record_request("k2", "semmerge", {"argv": ["b"]}, "t2")
+    w.record_dispatch("k1", "m0")
+    w.ack("k1")
+    assert w.open_count() == 1
+    # Re-journaling an open key is a no-op (replay keeps the original).
+    w.record_request("k2", "semmerge", {"argv": ["DIFFERENT"]}, "t2")
+    w.close()
+    # The next incarnation replays exactly the unacked entries.
+    w2 = fleet_wal.WriteAheadLog(d)
+    pending = w2.open()
+    assert [(r["key"], r["params"]) for r in pending] \
+        == [("k2", {"argv": ["b"]})]
+    w2.ack("k2")
+    w2.close()
+    w3 = fleet_wal.WriteAheadLog(d)
+    assert w3.open() == []
+    w3.close()
+
+
+def test_wal_tolerates_torn_tail_and_archives_history(tmp_path):
+    d = str(tmp_path / "wal")
+    w = fleet_wal.WriteAheadLog(d)
+    w.open()
+    w.record_request("k1", "semmerge", {"argv": []}, None)
+    w.close()
+    # SIGKILL mid-append: a torn half-record at the tail.
+    with open(os.path.join(d, fleet_wal.WAL_FILE), "a",
+              encoding="utf-8") as fh:
+        fh.write('{"kind": "ack", "key"')
+    w2 = fleet_wal.WriteAheadLog(d)
+    assert [r["key"] for r in w2.open()] == ["k1"], \
+        "torn ack must not settle the entry"
+    w2.ack("k1")
+    w2.close()
+    # The full history (including archived segments) remains readable
+    # for the chaos audit: the request and its eventual ack are there.
+    records = fleet_wal.read_records(d)
+    kinds = {r["kind"] for r in records}
+    assert kinds <= set(fleet_wal.RECORD_KINDS)
+    assert any(r["kind"] == "request" and r["key"] == "k1"
+               for r in records)
+    assert any(r["kind"] == "ack" and r["key"] == "k1" for r in records)
+    assert any(name.startswith("wal.") and name != fleet_wal.WAL_FILE
+               for name in os.listdir(d)), "expected archived segments"
+
+
+def test_wal_request_is_durable_before_dispatch(tmp_path):
+    """The fsync contract: after record_request returns, a fresh reader
+    of the *file* (not the in-memory state) sees the entry."""
+    d = str(tmp_path / "wal")
+    w = fleet_wal.WriteAheadLog(d)
+    w.open()
+    w.record_request("k-durable", "semmerge", {"argv": ["x"]}, "t")
+    path = os.path.join(d, fleet_wal.WAL_FILE)
+    rows = [json.loads(line) for line in
+            open(path, encoding="utf-8").read().splitlines() if line]
+    assert any(r["kind"] == "request" and r["key"] == "k-durable"
+               for r in rows)
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# Posture + client behavior
+# ---------------------------------------------------------------------------
+
+def test_fleet_posture_parsing(monkeypatch):
+    monkeypatch.delenv("SEMMERGE_FLEET", raising=False)
+    assert mode() == "off"
+    for raw, want in [("auto", "auto"), ("require", "require"),
+                      ("off", "off"), ("1", "auto"), ("on", "auto"),
+                      ("0", "off"), ("bogus", "off"),
+                      ("REQUIRE", "require")]:
+        monkeypatch.setenv("SEMMERGE_FLEET", raw)
+        assert mode() == want, raw
+    assert FLEET_EXIT == 19
+
+
+def test_fleet_require_without_router_exits_19(tmp_path):
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": str(REPO_ROOT),
+                "SEMMERGE_FLEET": "require",
+                "SEMMERGE_SERVICE_SOCKET": str(tmp_path / "none.sock")})
+    proc = subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+         "a", "b", "c"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=120)
+    assert proc.returncode == 19, proc.stderr
+    assert "fleet required" in proc.stderr
+
+
+def test_plain_daemon_does_not_satisfy_fleet_connect(service_daemon,
+                                                     monkeypatch):
+    """A fleet-postured connect demands ``fleet: true`` in the hello —
+    a plain daemon on the socket is unusable for the fleet branch (it
+    still serves the daemon posture)."""
+    from semantic_merge_tpu.service import client as service_client
+    monkeypatch.setenv("SEMMERGE_SERVICE_SOCKET", service_daemon)
+    assert service_client._try_connect(service_daemon) is not None \
+        and service_client._try_connect(
+            service_daemon, require_fleet=True) is None
+
+
+def test_client_reconnects_when_spawn_loses_bind_race(tmp_path,
+                                                      monkeypatch):
+    """The spawn-race fix: the spawned process exits (lost the bind
+    race) while the race winner is connectable but slow to answer —
+    the client must keep reconnecting for the bounded window instead
+    of failing hard on the first dead probe."""
+    from semantic_merge_tpu.service import client as service_client
+    sock_path = str(tmp_path / "race.sock")
+    monkeypatch.setenv("SEMMERGE_SERVICE_SOCKET", sock_path)
+    monkeypatch.setenv("SEMMERGE_SERVICE_RECONNECT", "5.0")
+
+    # The "race loser": a process that exits immediately.
+    loser = subprocess.Popen([sys.executable, "-c", "pass"])
+    loser.wait(timeout=30)
+    monkeypatch.setattr(service_client, "_spawn_daemon",
+                        lambda path: loser)
+
+    # The "race winner": binds late and then answers the handshake —
+    # the single-probe behavior this test pins against would give up
+    # before it comes up.
+    def winner():
+        time.sleep(1.0)
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(sock_path)
+        srv.listen(4)
+        srv.settimeout(10.0)
+        try:
+            conn, _ = srv.accept()
+            rfile = conn.makefile("r", encoding="utf-8")
+            wfile = conn.makefile("w", encoding="utf-8")
+            req = protocol.read_message(rfile)
+            protocol.write_message(wfile, {
+                "id": req["id"],
+                "result": {"ok": True, "pid": os.getpid(),
+                           "version": protocol.PROTOCOL_VERSION}})
+            time.sleep(0.5)  # hold until the client returns
+            conn.close()
+        finally:
+            srv.close()
+
+    t = threading.Thread(target=winner, daemon=True)
+    t.start()
+    conn = service_client._connect_or_spawn()
+    service_client._close(*conn)
+    t.join(timeout=15)
+
+
+# ---------------------------------------------------------------------------
+# Live router
+# ---------------------------------------------------------------------------
+
+def _spawn_router(sock_path, *, members=2, extra_env=None,
+                  timeout=120.0):
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+                "SEMMERGE_DAEMON": "off",
+                "SEMMERGE_FLEET_HEALTH_INTERVAL": "0.2",
+                "SEMMERGE_SUPERVISE_BACKOFF": "0.1",
+                "SEMMERGE_SERVICE_DRAIN_TIMEOUT": "2"})
+    for key in ("SEMMERGE_FAULT", "SEMMERGE_METRICS",
+                "SEMMERGE_SERVICE_SOCKET"):
+        env.pop(key, None)
+    env.update(extra_env or {})
+    log = open(sock_path + ".log", "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "semantic_merge_tpu", "fleet",
+         "--socket", sock_path, "--members", str(members)],
+        stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+        cwd="/", env=env, start_new_session=True)
+    log.close()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"router exited rc={proc.returncode} "
+                               f"(log: {sock_path}.log)")
+        status = _control(sock_path, "status")
+        if status and status.get("fleet") \
+                and status.get("members_up", 0) >= members:
+            return proc
+        time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError(f"fleet not up within {timeout:g}s "
+                       f"(log: {sock_path}.log)")
+
+
+def _control(sock_path, method, params=None):
+    try:
+        conn = raw_conn(sock_path, timeout=30.0)
+    except OSError:
+        return None
+    try:
+        protocol.write_message(conn[2], {"id": 1, "method": method,
+                                         "params": params or {}})
+        resp = protocol.read_message(conn[1])
+        return (resp or {}).get("result")
+    except (OSError, protocol.ProtocolError):
+        return None
+    finally:
+        raw_close(conn)
+
+
+def _stop_router(proc):
+    import signal as signal_mod
+    if proc.poll() is None:
+        proc.send_signal(signal_mod.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def _counter_total(status, name, **labels):
+    metric = (status.get("metrics") or {}).get("counters", {}) \
+        .get(name, {})
+    total = 0.0
+    for s in metric.get("series", []):
+        got = s.get("labels") or {}
+        if all(got.get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+def test_fleet_router_affinity_drain_and_posture(tmp_path):
+    """One live 2-member router: the hello announces the fleet, a
+    repo's requests pin to its rendezvous owner, a drained member
+    leaves the ring while its peer keeps serving, and the real client
+    in ``SEMMERGE_FLEET=require`` routes through the router."""
+    repo = build_repo(tmp_path / "repo")
+    sock = str(tmp_path / "fleet.sock")
+    router = _spawn_router(sock, members=2,
+                           extra_env={"SEMMERGE_FLEET_HEDGE": "off"})
+    try:
+        # Hello announce.
+        conn = raw_conn(sock)
+        try:
+            protocol.write_message(conn[2], {"id": 0, "method": "hello",
+                                             "params": {}})
+            hello = protocol.read_message(conn[1])["result"]
+        finally:
+            raw_close(conn)
+        assert hello["ok"] and hello["fleet"] is True
+        assert hello["members_up"] == 2
+
+        # Affinity: every request for one repo lands on its
+        # rendezvous owner (hedging disabled for this router).
+        owner = hashring.owner(hashring.repo_key(str(repo)),
+                               ["m0", "m1"])
+        for i in range(3):
+            conn = raw_conn(sock, timeout=300.0)
+            try:
+                send_merge(conn, str(repo), req_id=i,
+                           idem_key=f"aff-{i}")
+                resp = protocol.read_message(conn[1])
+            finally:
+                raw_close(conn)
+            assert resp.get("result", {}).get("exit_code") == 0, resp
+        status = _control(sock, "status")
+        by_id = {m["id"]: m for m in status["members"]}
+        assert by_id[owner]["dispatches"] == 3
+        other = "m1" if owner == "m0" else "m0"
+        assert by_id[other]["dispatches"] == 0
+
+        # Drain the owner: it leaves the ring (failover counted with
+        # reason=drain), acknowledges admission-closed, and the peer
+        # takes over its keyspace.
+        ack = _control(sock, "drain", {"member": owner})
+        assert ack["ok"] and ack["member_ack"]["draining"] is True
+        status = _control(sock, "status")
+        assert status["members_up"] == 1
+        assert _counter_total(status, "fleet_failovers_total",
+                              reason="drain") >= 1
+        conn = raw_conn(sock, timeout=300.0)
+        try:
+            send_merge(conn, str(repo), req_id=9, idem_key="aff-post")
+            resp = protocol.read_message(conn[1])
+        finally:
+            raw_close(conn)
+        assert resp.get("result", {}).get("exit_code") == 0, resp
+        status = _control(sock, "status")
+        assert {m["id"]: m for m in status["members"]}[other][
+            "dispatches"] == 1
+
+        # The real client, fleet-required, routes through the router.
+        env = dict(os.environ)
+        env.update({"PYTHONPATH": str(REPO_ROOT),
+                    "SEMMERGE_FLEET": "require",
+                    "SEMMERGE_SERVICE_SOCKET": sock})
+        env.pop("SEMMERGE_FAULT", None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+             "basebr", "brA", "brB", "--backend", "host"],
+            capture_output=True, text=True, env=env, cwd=str(repo),
+            timeout=300)
+        assert proc.returncode == 0, proc.stderr
+        # `semmerge fleet --status` sees the same router.
+        proc = subprocess.run(
+            [sys.executable, "-m", "semantic_merge_tpu", "fleet",
+             "--socket", sock, "--status"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert proc.returncode == 0, proc.stderr
+        assert json.loads(proc.stdout)["fleet"] is True
+    finally:
+        _stop_router(router)
+
+
+def test_fleet_router_hedges_slow_member(tmp_path):
+    """Hedged reads: wedge the owner member's single worker, then send
+    a non-inplace merge — after the hedge delay the router launches a
+    second leg on the other member, whose response wins."""
+    repo = build_repo(tmp_path / "repo")
+    sock = str(tmp_path / "fleet.sock")
+    router = _spawn_router(
+        sock, members=2,
+        extra_env={"SEMMERGE_FLEET_HEDGE_MS": "50",
+                   "SEMMERGE_SERVICE_WORKERS": "1"})
+    wedge = None
+    try:
+        owner = hashring.owner(hashring.repo_key(str(repo)),
+                               ["m0", "m1"])
+        # Wedge the owner: --inplace traffic never hedges, so this
+        # hang occupies exactly the owner's single worker.
+        wedge = raw_conn(sock, timeout=300.0)
+        send_merge(wedge, str(repo),
+                   env={"SEMMERGE_FAULT": "service:execute:hang=20"},
+                   argv=["basebr", "brA", "brB", "--inplace",
+                         "--backend", "host"],
+                   req_id=1, idem_key="wedge")
+        time.sleep(1.0)
+        # Non-inplace read for the same repo: the primary leg queues
+        # behind the wedge; the hedge leg answers first.
+        conn = raw_conn(sock, timeout=300.0)
+        try:
+            send_merge(conn, str(repo), req_id=2, idem_key="hedged")
+            resp = protocol.read_message(conn[1])
+        finally:
+            raw_close(conn)
+        assert resp.get("result", {}).get("exit_code") == 0, resp
+        status = _control(sock, "status")
+        assert _counter_total(status, "fleet_hedges_total") >= 1
+        assert _counter_total(status, "fleet_hedge_wins_total") >= 1
+        other = "m1" if owner == "m0" else "m0"
+        by_id = {m["id"]: m for m in status["members"]}
+        assert by_id[other]["dispatches"] >= 1
+    finally:
+        if wedge is not None:
+            raw_close(wedge)
+        _stop_router(router)

@@ -369,6 +369,66 @@ def _replay_total(status: dict) -> float:
     return sum(s["value"] for s in metric.get("series", []))
 
 
+def _idem_request(req_id, key):
+    from semantic_merge_tpu.service import daemon as daemon_mod
+    return daemon_mod._Request(req_id, "semmerge",
+                               {"idempotency_key": key})
+
+
+def test_idem_cache_ttl_expires_entry_and_frees_slot(monkeypatch):
+    """Replay-cache TTL semantics: a resend *within* the TTL replays
+    the cached response; a resend *after* it re-executes as a fresh
+    request (deterministic merges + the inplace journal make that
+    safe) and the expired entry's slot is freed, not just masked."""
+    from semantic_merge_tpu.service import daemon as daemon_mod
+    monkeypatch.setenv("SEMMERGE_SERVICE_IDEM_TTL", "0.15")
+    d = daemon_mod.Daemon(socket_path="/tmp/idem-ttl-unused.sock")
+    first = _idem_request(1, "ttl-key")
+    first.response = {"id": 1, "result": {"exit_code": 0, "stdout": "x"}}
+    d._idem_store(first)
+    replays0 = counter_series("service_idempotent_replays_total")
+    hit = d._idem_lookup(_idem_request(2, "ttl-key"))
+    assert hit == {"id": 2, "result": {"exit_code": 0, "stdout": "x"}}
+    assert counter_series("service_idempotent_replays_total") \
+        == replays0 + 1
+    time.sleep(0.2)
+    assert d._idem_lookup(_idem_request(3, "ttl-key")) is None
+    assert "ttl-key" not in d._idem  # slot freed, not replayed-stale
+    # The expired miss is NOT a replay: counter unchanged.
+    assert counter_series("service_idempotent_replays_total") \
+        == replays0 + 1
+
+
+def test_idem_cache_evict_then_resend_reexecutes(monkeypatch):
+    """LRU-cap/TTL interaction for a client resending after
+    ``retry_after_ms``: a key evicted by newer entries (or never cached
+    because the original attempt was *rejected*, not executed) simply
+    re-executes — a cache miss is never an error. The still-resident
+    key keeps replaying."""
+    from semantic_merge_tpu.service import daemon as daemon_mod
+    monkeypatch.setenv("SEMMERGE_SERVICE_IDEM_CACHE", "1")
+    monkeypatch.delenv("SEMMERGE_SERVICE_IDEM_TTL", raising=False)
+    d = daemon_mod.Daemon(socket_path="/tmp/idem-cap-unused.sock")
+    assert d._idem_ttl == 0.0  # default: size-only LRU, no expiry
+    r1 = _idem_request(1, "old-key")
+    r1.response = {"id": 1, "result": {"exit_code": 0}}
+    d._idem_store(r1)
+    r2 = _idem_request(2, "new-key")
+    r2.response = {"id": 2, "result": {"exit_code": 0}}
+    d._idem_store(r2)  # cap=1: evicts old-key
+    assert d._idem_lookup(_idem_request(3, "old-key")) is None
+    assert len(d._idem) == 1
+    hit = d._idem_lookup(_idem_request(4, "new-key"))
+    assert hit == {"id": 4, "result": {"exit_code": 0}}
+    # A request rejected at admission never reaches _idem_store: its
+    # key is absent, so the post-retry_after_ms resend is a fresh
+    # execution under the same key.
+    rejected = _idem_request(5, "rejected-key")
+    assert rejected.response is None
+    d._idem_store(rejected)
+    assert d._idem_lookup(_idem_request(6, "rejected-key")) is None
+
+
 # ---------------------------------------------------------------------------
 # Supervised restart
 # ---------------------------------------------------------------------------

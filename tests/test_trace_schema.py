@@ -671,3 +671,113 @@ def test_drifted_events_are_rejected(schema, artifacts):
     row.pop("thread")
     assert any("thread" in e
                for e in schema.validate_events([json.dumps(row)]))
+
+
+def test_fleet_records_validate(schema, tmp_path):
+    """A trace carrying the fleet-router layer's records — the three
+    ``fleet.*`` spans, the fleet metric series, and a WAL history —
+    must validate; drifted shapes (undocumented span/reason, labeled
+    gauge, malformed WAL record) are rejected field by field. The CLI
+    subcommand wires the same validator."""
+    from semantic_merge_tpu.fleet import wal as fleet_wal
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    tracer = trace_mod.Tracer(enabled=True)
+    with tracer.phase("route"):
+        obs_spans.record("fleet.route", 0.01, layer="fleet",
+                         verb="semmerge", member="m0")
+        obs_spans.record("fleet.failover", 0.0, layer="fleet",
+                         reason="transport", member="m1")
+        obs_spans.record("fleet.hedge", 0.0, layer="fleet",
+                         member="m2", won=True)
+    obs_metrics.REGISTRY.counter("fleet_failovers_total", "t").inc(
+        1, reason="crash")
+    obs_metrics.REGISTRY.counter("fleet_rehash_moves_total", "t").inc(2)
+    obs_metrics.REGISTRY.counter("fleet_hedges_total", "t").inc(1)
+    obs_metrics.REGISTRY.counter("fleet_hedge_wins_total", "t").inc(1)
+    obs_metrics.REGISTRY.counter("fleet_wal_replayed_total", "t").inc(1)
+    obs_metrics.REGISTRY.gauge("fleet_members", "t").set(3)
+    trace = tmp_path / ".semmerge-trace.json"
+    tracer.write(trace)
+    data = json.loads(trace.read_text())
+    # A REAL WAL history rides along (router status/chaos audit shape).
+    wal_dir = str(tmp_path / "wal")
+    w = fleet_wal.WriteAheadLog(wal_dir)
+    w.open()
+    w.record_request("k1", "semmerge", {"argv": ["a", "b", "c"]}, "t1")
+    w.record_dispatch("k1", "m0")
+    w.ack("k1")
+    w.close()
+    data["wal"] = fleet_wal.read_records(wal_dir)
+    assert data["wal"], "expected journal records"
+    assert schema.validate_trace(data) == []
+    assert schema.validate_fleet(data) == []
+
+    broken = json.loads(json.dumps(data))
+    for s in broken["spans"]:
+        if s["name"] == "fleet.route":
+            s["name"] = "fleet.rout3"
+    assert any("unknown fleet span" in e
+               for e in schema.validate_fleet(broken))
+
+    broken = json.loads(json.dumps(data))
+    for s in broken["spans"]:
+        if s["name"] == "fleet.failover":
+            s["meta"]["reason"] = "mystery"
+    assert any("mystery" in e for e in schema.validate_fleet(broken))
+
+    broken = json.loads(json.dumps(data))
+    for s in broken["spans"]:
+        if s["name"] == "fleet.hedge":
+            s["meta"]["won"] = "yes"
+    assert any("boolean" in e for e in schema.validate_fleet(broken))
+
+    broken = json.loads(json.dumps(data))
+    fo = broken["metrics"]["counters"]["fleet_failovers_total"]
+    fo["series"][0]["labels"] = {"reason": "crash", "member": "m0"}
+    assert any("fleet_failovers_total" in e
+               for e in schema.validate_fleet(broken))
+
+    broken = json.loads(json.dumps(data))
+    gauge = broken["metrics"]["gauges"]["fleet_members"]
+    gauge["series"][0]["labels"] = {"socket": "x"}
+    assert any("no labels" in e for e in schema.validate_fleet(broken))
+
+    broken = json.loads(json.dumps(data))
+    broken["wal"].append({"kind": "mystery", "key": "k2", "t": 1.0})
+    assert any("mystery" in e for e in schema.validate_fleet(broken))
+
+    broken = json.loads(json.dumps(data))
+    broken["wal"] = [{"kind": "request", "key": "k1", "t": 1.0}]
+    assert any("missing" in e for e in schema.validate_fleet(broken))
+
+    # The CLI subcommand wires the same validator.
+    good = tmp_path / "fleet.json"
+    good.write_text(json.dumps(data))
+    ok = subprocess.run([sys.executable, str(_SCRIPT), "validate_fleet",
+                         str(good)], capture_output=True, text=True,
+                        timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    bad = tmp_path / "fleet-bad.json"
+    bad.write_text(json.dumps(broken))
+    fail = subprocess.run([sys.executable, str(_SCRIPT),
+                           "validate_fleet", str(bad)],
+                          capture_output=True, text=True, timeout=60)
+    assert fail.returncode == 1
+    assert "missing" in fail.stderr
+
+
+def test_fleet_reasons_and_shed_draining_documented(schema):
+    """The fleet-era additions to the shared taxonomies: postmortem
+    reason ``fleet-failover`` (mirrored from obs/flight REASONS),
+    shed reason ``draining`` (a drained member's admission close),
+    and the documented failover-reason set."""
+    from semantic_merge_tpu.obs import flight as obs_flight
+    assert "fleet-failover" in schema.POSTMORTEM_REASONS
+    assert tuple(schema.POSTMORTEM_REASONS) == tuple(obs_flight.REASONS)
+    assert "draining" in schema.SHED_REASONS
+    assert set(schema.FLEET_SPAN_META) == set(schema.FLEET_SPANS)
+    assert schema.FLEET_METRIC_LABELS["fleet_failovers_total"] == \
+        ("reason",)
+    assert tuple(schema.FLEET_WAL_KINDS) == \
+        tuple(schema.FLEET_WAL_REQUIRED)

@@ -435,6 +435,192 @@ def run_strict_bench(record: dict, args, json_only: bool = False) -> int:
     return 0 if parity else 1
 
 
+def _tracecost_fleet_leg(record: dict, json_only: bool = False) -> bool:
+    """The fleet leg of the ``tracecost`` preset: what the stitched
+    observability plane costs a merge through a live 2-member fleet.
+    Dark = stitching off, no trace artifacts, no OTLP. On = the full
+    plane: members ship span trees, the router grafts and persists
+    stitched artifacts, and the OTLP exporter streams them to a local
+    collector sink. Both arms run the same fixed small workload (the
+    fleet preset's 24-file service repo — the leg measures a relative
+    overhead, not throughput), hedging off so every merge runs exactly
+    once. Both fleets stay up for the whole measurement and samples
+    are interleaved one-for-one (sequential arms read machine drift as
+    overhead); the compared statistic is the per-arm median latency.
+    Emits the additive ``fleet_trace_overhead_pct`` field and returns
+    whether it stayed under the 2% budget."""
+    import http.server
+    import shutil
+    import signal as signal_mod
+    import socketserver
+    import subprocess
+    import tempfile
+    import threading
+
+    from semantic_merge_tpu.service import client as svc_client
+
+    scratch = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-tracefleet-"))
+    repo = scratch / "repo"
+    _build_service_repo(repo, 24, 4)
+
+    child_env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    prior_pp = child_env.get("PYTHONPATH", "")
+    child_env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{prior_pp}"
+                               if prior_pp else pkg_root)
+    child_env.update({
+        "SEMMERGE_DAEMON": "off",
+        "SEMMERGE_FLEET_HEALTH_INTERVAL": "0.2",
+        "SEMMERGE_SUPERVISE_BACKOFF": "0.1",
+        "SEMMERGE_SERVICE_DRAIN_TIMEOUT": "2",
+        "SEMMERGE_FLEET_HEDGE": "off",
+    })
+    for key in ("SEMMERGE_FAULT", "SEMMERGE_METRICS",
+                "SEMMERGE_SERVICE_SOCKET", "SEMMERGE_FLEET",
+                "SEMMERGE_FLEET_MEMBERS", "SEMMERGE_FLEET_HEDGE_MS",
+                "SEMMERGE_FLEET_STITCH", "SEMMERGE_FLEET_TRACE_DIR",
+                "SEMMERGE_OTLP_ENDPOINT", "SEMMERGE_OTLP_QUEUE"):
+        child_env.pop(key, None)
+    if os.environ.get("SEMMERGE_BENCH_PLATFORM") == "cpu":
+        child_env["JAX_PLATFORMS"] = "cpu"
+
+    # A local collector sink so the on arm pays the real HTTP export
+    # path, not a connection-refused fast failure.
+    class _Sink(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+        daemon_threads = True
+
+    sink = _Server(("127.0.0.1", 0), _Sink)
+    sink_url = f"http://127.0.0.1:{sink.server_address[1]}"
+    threading.Thread(target=sink.serve_forever, daemon=True).start()
+
+    def teardown(proc):
+        if proc is None or proc.poll() is not None:
+            return
+        proc.send_signal(signal_mod.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def spawn(tag, extra_env):
+        sock = str(scratch / f"fleet-{tag}.sock")
+        env = dict(child_env)
+        env.update(extra_env)
+        log = open(sock + ".log", "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "semantic_merge_tpu", "fleet",
+             "--socket", sock, "--members", "2"],
+            stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+            cwd="/", env=env, start_new_session=True)
+        log.close()
+        return proc, sock
+
+    def wait_up(tag, proc, sock):
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                return (f"{tag} router exited rc={proc.returncode} "
+                        f"(log: {sock}.log)")
+            try:
+                status = svc_client.call_control("status", path=sock,
+                                                 timeout=10)
+            except Exception:
+                status = None
+            if status and status.get("members_up", 0) >= 2:
+                return None
+            time.sleep(0.2)
+        return f"{tag} fleet not up (log: {sock}.log)"
+
+    def merge(sock):
+        """One routed merge; returns its wall seconds or None."""
+        t0 = time.perf_counter()
+        frame = svc_client.call_verb(
+            "semmerge",
+            {"argv": ["basebr", "brA", "brB", "--backend", "host"],
+             "cwd": str(repo), "env": {},
+             "idempotency_key": f"tc-{os.urandom(8).hex()}"},
+            path=sock, timeout=180)
+        if (frame.get("result") or {}).get("exit_code") != 0:
+            return None
+        return time.perf_counter() - t0
+
+    def median(xs):
+        xs = sorted(xs)
+        mid = len(xs) // 2
+        return (xs[mid] if len(xs) % 2
+                else (xs[mid - 1] + xs[mid]) / 2.0)
+
+    samples = 64
+    arms = {"dark": {"SEMMERGE_FLEET_STITCH": "off"},
+            "on": {"SEMMERGE_FLEET_TRACE_DIR": str(scratch / "traces"),
+                   "SEMMERGE_OTLP_ENDPOINT": sink_url}}
+    procs = {}
+    try:
+        err = None
+        for tag, extra in arms.items():
+            procs[tag] = spawn(tag, extra)
+        for tag, (proc, sock) in procs.items():
+            err = err or wait_up(tag, proc, sock)
+        lat = {tag: [] for tag in arms}
+        if err is None:
+            for tag, (_, sock) in procs.items():
+                for _ in range(4):  # warm the owner's merge path
+                    if merge(sock) is None:
+                        err = f"{tag} warm-up merge failed"
+                        break
+        if err is None:
+            for _ in range(samples):
+                for tag, (_, sock) in procs.items():
+                    dt = merge(sock)
+                    if dt is None:
+                        err = f"{tag} timed merge failed"
+                        break
+                    lat[tag].append(dt)
+                if err:
+                    break
+        if err is None and not list((scratch / "traces").glob("*.json")):
+            err = "on arm produced no stitched trace artifacts"
+        if err:
+            prior = record.get("error")
+            msg = f"tracecost fleet leg: {err}"
+            record["error"] = f"{prior}; {msg}" if prior else msg
+            return False
+        dark_s, on_s = median(lat["dark"]), median(lat["on"])
+        overhead = ((on_s - dark_s) / dark_s * 100.0
+                    if dark_s > 0 else 0.0)
+        ok = overhead < 2.0
+        record["fleet_trace_overhead_pct"] = round(overhead, 3)
+        record["fleet_trace_dark_ms"] = round(dark_s * 1e3, 1)
+        record["fleet_trace_on_ms"] = round(on_s * 1e3, 1)
+        if not ok:
+            prior = record.get("error")
+            msg = (f"fleet trace overhead {overhead:.2f}% exceeds "
+                   f"the 2% budget")
+            record["error"] = f"{prior}; {msg}" if prior else msg
+        if not json_only:
+            print(f"# fleet dark: {dark_s*1e3:8.1f} ms/merge   "
+                  f"stitched+otlp: {on_s*1e3:8.1f} ms/merge   "
+                  f"overhead: {overhead:+.2f}% "
+                  f"(medians over {samples} interleaved merges/arm)",
+                  file=sys.stderr)
+        return ok
+    finally:
+        for proc, _sock in procs.values():
+            teardown(proc)
+        sink.shutdown()
+        sink.server_close()
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
 def run_tracecost_bench(record: dict, args, backend, base, left, right,
                         json_only: bool = False) -> int:
     """The ``tracecost`` preset: what always-on observability costs a
@@ -443,7 +629,10 @@ def run_tracecost_bench(record: dict, args, backend, base, left, right,
     posture: a request scope carrying a trace id and a (non-detailed)
     SpanRecorder, plus the flight ring at its default capacity. Asserts
     the overhead stays under 2% of dark wall time and emits the
-    additive ``trace_overhead_pct`` field."""
+    additive ``trace_overhead_pct`` field. A second, subprocess-shaped
+    leg measures the fleet plane (stitching + OTLP export) against a
+    dark fleet and emits ``fleet_trace_overhead_pct`` under the same
+    2% budget — see ``_tracecost_fleet_leg``."""
     from semantic_merge_tpu.obs import flight as obs_flight
 
     repeats = 5
@@ -484,8 +673,9 @@ def run_tracecost_bench(record: dict, args, backend, base, left, right,
     if not json_only:
         print(f"# dark: {dark_s*1e3:8.1f} ms   traced: {on_s*1e3:8.1f} ms   "
               f"overhead: {overhead_pct:+.2f}%", file=sys.stderr)
+    fleet_ok = _tracecost_fleet_leg(record, json_only=json_only)
     emit_record(record)
-    return 0 if ok else 1
+    return 0 if ok and fleet_ok else 1
 
 
 def run_slocost_bench(record: dict, args, backend, base, left, right,

@@ -21,13 +21,18 @@ Subcommand modes for the request-tracing artifacts::
         .semmerge-conflicts.json [...]
     python scripts/check_trace_schema.py validate_fleet \
         STATUS_OR_TRACE_JSON [...]
+    python scripts/check_trace_schema.py validate_fleet_trace \
+        SEMMERGE_FLEET_TRACE_DIR/<trace_id>.json [...]
+    python scripts/check_trace_schema.py validate_export \
+        OTLP_PAYLOAD_JSON [...]
 
 Exit 0 when everything conforms, 1 with one line per violation
 otherwise. The tier-1 suite imports :func:`validate_trace` /
 :func:`validate_events` / :func:`validate_bench` / :func:`validate_batch`
 / :func:`validate_request_traces` / :func:`validate_postmortem` /
 :func:`validate_slo` / :func:`validate_conflicts` /
-:func:`validate_fleet` directly (``tests/test_trace_schema.py``), so
+:func:`validate_fleet` / :func:`validate_fleet_trace` /
+:func:`validate_export` directly (``tests/test_trace_schema.py``), so
 trace-format drift fails CI before it reaches a consumer.
 
 Dependency-free on purpose: the schema IS this file plus the runbook
@@ -195,6 +200,8 @@ BENCH_NUMERIC_OPTIONAL = (
     "fleet_merges_per_sec_m1", "fleet_merges_per_sec_m2",
     "fleet_merges_per_sec_m3", "fleet_failover_recovery_s",
     "fleet_rehash_miss_rate", "fleet_hedge_win_rate",
+    "fleet_trace_overhead_pct", "fleet_trace_dark_ms",
+    "fleet_trace_on_ms",
 )
 
 #: Versions of the structured ``.semmerge-conflicts.json`` object form.
@@ -221,15 +228,27 @@ RESOLUTION_GATES = ("recompose", "parity", "typecheck", "format")
 #: Span names of the fleet router layer (``fleet/router.py``).
 #: ``fleet.route`` wraps one successfully dispatched request;
 #: ``fleet.failover`` records one member ejection/dispatch transfer;
-#: ``fleet.hedge`` fires only when the hedge leg won the race.
-FLEET_SPANS = ("fleet.route", "fleet.failover", "fleet.hedge")
+#: ``fleet.hedge`` records each hedge-race leg's outcome (won/lost);
+#: ``fleet.wal_fsync`` the pre-dispatch journal fsync;
+#: ``fleet.relay`` one member round-trip leg;
+#: ``fleet.hedge_wait`` the p99-derived delay before a hedge launch.
+FLEET_SPANS = ("fleet.route", "fleet.failover", "fleet.hedge",
+               "fleet.wal_fsync", "fleet.relay", "fleet.hedge_wait")
 
 #: Required meta keys per fleet span name.
 FLEET_SPAN_META = {
     "fleet.route": ("verb", "member"),
     "fleet.failover": ("reason", "member"),
     "fleet.hedge": ("member", "won"),
+    "fleet.wal_fsync": (),
+    "fleet.relay": ("member",),
+    "fleet.hedge_wait": (),
 }
+
+#: Documented ``fleet.relay`` outcomes: the leg answered first
+#: (``ok``), answered after another leg had already won (``late``), or
+#: died transport-style (``transport``).
+FLEET_RELAY_OUTCOMES = ("ok", "late", "transport")
 
 #: Documented ``fleet_failovers_total`` / ``fleet.failover`` reasons:
 #: supervisor reaped the child (``crash``), a dispatch hit a dead
@@ -246,6 +265,8 @@ FLEET_METRIC_LABELS = {
     "fleet_hedges_total": (),
     "fleet_hedge_wins_total": (),
     "fleet_wal_replayed_total": (),
+    "fleet_scrape_errors_total": ("member",),
+    "fleet_trace_dropped_total": (),
 }
 
 #: Documented WAL record kinds (``fleet/wal.py``).
@@ -329,6 +350,37 @@ def validate_metrics(data: Any, where: str = "metrics") -> List[str]:
             elif sum(counts) != s.get("count"):
                 errors.append(f"{where}.histograms.{name}[{i}]: counts do "
                               f"not sum to count")
+            if "exemplar" in s:
+                errors.append(f"{where}.histograms.{name}[{i}]: per-series "
+                              f"'exemplar' is the pre-OpenMetrics shape; "
+                              f"use per-bucket 'exemplars'")
+            ex = s.get("exemplars")
+            if ex is None:
+                continue
+            if not isinstance(ex, dict):
+                errors.append(f"{where}.histograms.{name}[{i}]: exemplars "
+                              f"must be an object keyed by bucket index")
+                continue
+            for key, e in ex.items():
+                w = f"{where}.histograms.{name}[{i}].exemplars[{key!r}]"
+                try:
+                    idx = int(key)
+                except (TypeError, ValueError):
+                    errors.append(f"{w}: key must be a stringified "
+                                  f"bucket index")
+                    continue
+                if not 0 <= idx <= len(buckets):
+                    errors.append(f"{w}: bucket index out of range "
+                                  f"0..{len(buckets)}")
+                if not isinstance(e, dict):
+                    errors.append(f"{w}: must be an object")
+                    continue
+                tid = e.get("trace_id")
+                if not isinstance(tid, str) or not tid:
+                    errors.append(f"{w}: trace_id must be a non-empty "
+                                  f"string")
+                if not _is_num(e.get("value")):
+                    errors.append(f"{w}: value must be a number")
     return errors
 
 
@@ -749,10 +801,20 @@ def validate_fleet(data: Any) -> List[str]:
                 errors.append(f"trace.spans[{i}]: fleet.failover reason "
                               f"{reason!r} not in "
                               f"{FLEET_FAILOVER_REASONS}")
-        if name == "fleet.hedge" and "won" in meta \
-                and not isinstance(meta["won"], bool):
-            errors.append(f"trace.spans[{i}]: fleet.hedge meta 'won' "
-                          f"must be a boolean")
+        if name == "fleet.hedge" and "won" in meta:
+            if not isinstance(meta["won"], bool):
+                errors.append(f"trace.spans[{i}]: fleet.hedge meta 'won' "
+                              f"must be a boolean")
+            elif "outcome" in meta and meta["outcome"] != \
+                    ("won" if meta["won"] else "lost"):
+                errors.append(f"trace.spans[{i}]: fleet.hedge outcome "
+                              f"{meta['outcome']!r} contradicts "
+                              f"won={meta['won']}")
+        if name == "fleet.relay" and "outcome" in meta \
+                and meta["outcome"] not in FLEET_RELAY_OUTCOMES:
+            errors.append(f"trace.spans[{i}]: fleet.relay outcome "
+                          f"{meta['outcome']!r} not in "
+                          f"{FLEET_RELAY_OUTCOMES}")
         if name == "fleet.route":
             verb = meta.get("verb")
             if "verb" in meta and (not isinstance(verb, str) or not verb):
@@ -823,6 +885,218 @@ def validate_fleet(data: Any) -> List[str]:
                               f"non-empty string")
     elif wal is not None:
         errors.append("fleet: wal must be an array of records")
+    return errors
+
+
+def validate_fleet_trace(data: Any) -> List[str]:
+    """Validate one *stitched* fleet-trace artifact
+    (``SEMMERGE_FLEET_TRACE_DIR/<trace_id>.json``): span rows conform,
+    the tree carries at least one router-layer ``fleet.*`` span AND at
+    least one grafted member span, and every grafted span (anything not
+    on the router's ``fleet`` layer) is stamped with the graft meta —
+    ``member`` id and an ``attempt`` int >= 1 — so failover retries and
+    hedge legs stay attributable after the graft."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["fleet-trace: top level must be a JSON object"]
+    if data.get("schema") != 1:
+        errors.append(f"fleet-trace: unknown schema version "
+                      f"{data.get('schema')!r}")
+    tid = data.get("trace_id")
+    if not isinstance(tid, str) or not tid:
+        errors.append("fleet-trace: trace_id must be a non-empty string")
+    spans = data.get("spans")
+    if not isinstance(spans, list) or not spans:
+        errors.append("fleet-trace: spans must be a non-empty array")
+        return errors
+    fleet_seen = grafted_seen = False
+    for i, row in enumerate(spans):
+        where = f"fleet-trace.spans[{i}]"
+        if not isinstance(row, dict):
+            errors.append(f"{where}: must be an object")
+            continue
+        errors.extend(validate_span(row, where))
+        name = row.get("name")
+        meta = row.get("meta") if isinstance(row.get("meta"), dict) else {}
+        if isinstance(name, str) and name.startswith("fleet."):
+            fleet_seen = True
+            continue
+        if row.get("layer") == "fleet":
+            continue
+        grafted_seen = True
+        member = meta.get("member")
+        if not isinstance(member, str) or not member:
+            errors.append(f"{where}: grafted span {name!r} missing "
+                          f"graft meta 'member'")
+        attempt = meta.get("attempt")
+        if not isinstance(attempt, int) or isinstance(attempt, bool) \
+                or attempt < 1:
+            errors.append(f"{where}: grafted span {name!r} needs graft "
+                          f"meta 'attempt' (int >= 1)")
+    if not fleet_seen:
+        errors.append("fleet-trace: no fleet.* router span in the tree")
+    if not grafted_seen:
+        errors.append("fleet-trace: no grafted member span in the tree")
+    errors.extend(validate_fleet(data))
+    return errors
+
+
+def _hex_id(v: Any, width: int) -> bool:
+    return isinstance(v, str) and len(v) == width and \
+        all(c in "0123456789abcdef" for c in v)
+
+
+def _unix_nano(v: Any) -> Any:
+    """OTLP JSON encodes uint64 nanos as strings (ints tolerated);
+    returns the int value or None when malformed."""
+    if isinstance(v, str) and v.isdigit():
+        return int(v)
+    if isinstance(v, int) and not isinstance(v, bool) and v >= 0:
+        return v
+    return None
+
+
+def validate_export(data: Any) -> List[str]:
+    """Validate an OTLP JSON export payload (``obs/export.py``): an
+    ``ExportTraceServiceRequest`` (``resourceSpans`` → ``scopeSpans`` →
+    spans with 32-hex traceId, 16-hex spanId, uint64 nano timestamps
+    with end >= start, attribute key/value lists) or an
+    ``ExportMetricsServiceRequest`` (``resourceMetrics`` with exactly
+    one of sum/gauge/histogram per metric)."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["export: top level must be a JSON object"]
+    has_spans = "resourceSpans" in data
+    has_metrics = "resourceMetrics" in data
+    if not has_spans and not has_metrics:
+        return ["export: need resourceSpans or resourceMetrics"]
+    if has_spans:
+        rss = data["resourceSpans"]
+        if not isinstance(rss, list) or not rss:
+            return ["export: resourceSpans must be a non-empty array"]
+        for ri, rs in enumerate(rss):
+            where = f"export.resourceSpans[{ri}]"
+            if not isinstance(rs, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            sss = rs.get("scopeSpans")
+            if not isinstance(sss, list) or not sss:
+                errors.append(f"{where}: scopeSpans must be a non-empty "
+                              f"array")
+                continue
+            for si, ss in enumerate(sss):
+                spans = ss.get("spans") if isinstance(ss, dict) else None
+                if not isinstance(spans, list):
+                    errors.append(f"{where}.scopeSpans[{si}]: spans must "
+                                  f"be an array")
+                    continue
+                for pi, span in enumerate(spans):
+                    w = f"{where}.scopeSpans[{si}].spans[{pi}]"
+                    if not isinstance(span, dict):
+                        errors.append(f"{w}: must be an object")
+                        continue
+                    if not _hex_id(span.get("traceId"), 32):
+                        errors.append(f"{w}: traceId must be 32 lowercase "
+                                      f"hex chars")
+                    if not _hex_id(span.get("spanId"), 16):
+                        errors.append(f"{w}: spanId must be 16 lowercase "
+                                      f"hex chars")
+                    if "parentSpanId" in span and \
+                            not _hex_id(span["parentSpanId"], 16):
+                        errors.append(f"{w}: parentSpanId must be 16 "
+                                      f"lowercase hex chars")
+                    if not isinstance(span.get("name"), str) \
+                            or not span.get("name"):
+                        errors.append(f"{w}: name must be a non-empty "
+                                      f"string")
+                    start = _unix_nano(span.get("startTimeUnixNano"))
+                    end = _unix_nano(span.get("endTimeUnixNano"))
+                    if start is None:
+                        errors.append(f"{w}: startTimeUnixNano must be "
+                                      f"uint64 nanos (string or int)")
+                    if end is None:
+                        errors.append(f"{w}: endTimeUnixNano must be "
+                                      f"uint64 nanos (string or int)")
+                    if start is not None and end is not None \
+                            and end < start:
+                        errors.append(f"{w}: endTimeUnixNano < "
+                                      f"startTimeUnixNano")
+                    attrs = span.get("attributes", [])
+                    if not isinstance(attrs, list):
+                        errors.append(f"{w}: attributes must be an array")
+                        attrs = []
+                    for ai, attr in enumerate(attrs):
+                        if not isinstance(attr, dict) \
+                                or not isinstance(attr.get("key"), str) \
+                                or not isinstance(attr.get("value"), dict):
+                            errors.append(f"{w}.attributes[{ai}]: must be "
+                                          f"{{key, value}} objects")
+                    status = span.get("status")
+                    if status is not None and (
+                            not isinstance(status, dict) or
+                            not isinstance(status.get("code"), int)):
+                        errors.append(f"{w}: status must carry an int code")
+    if has_metrics:
+        rms = data["resourceMetrics"]
+        if not isinstance(rms, list) or not rms:
+            return errors + ["export: resourceMetrics must be a non-empty "
+                             "array"]
+        for ri, rm in enumerate(rms):
+            where = f"export.resourceMetrics[{ri}]"
+            if not isinstance(rm, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            sms = rm.get("scopeMetrics")
+            if not isinstance(sms, list) or not sms:
+                errors.append(f"{where}: scopeMetrics must be a non-empty "
+                              f"array")
+                continue
+            for si, sm in enumerate(sms):
+                mlist = sm.get("metrics") if isinstance(sm, dict) else None
+                if not isinstance(mlist, list):
+                    errors.append(f"{where}.scopeMetrics[{si}]: metrics "
+                                  f"must be an array")
+                    continue
+                for mi, m in enumerate(mlist):
+                    w = f"{where}.scopeMetrics[{si}].metrics[{mi}]"
+                    if not isinstance(m, dict):
+                        errors.append(f"{w}: must be an object")
+                        continue
+                    if not isinstance(m.get("name"), str) \
+                            or not m.get("name"):
+                        errors.append(f"{w}: name must be a non-empty "
+                                      f"string")
+                    kinds = [k for k in ("sum", "gauge", "histogram")
+                             if k in m]
+                    if len(kinds) != 1:
+                        errors.append(f"{w}: need exactly one of "
+                                      f"sum/gauge/histogram, got {kinds}")
+                        continue
+                    points = m[kinds[0]].get("dataPoints") \
+                        if isinstance(m[kinds[0]], dict) else None
+                    if not isinstance(points, list):
+                        errors.append(f"{w}.{kinds[0]}: dataPoints must "
+                                      f"be an array")
+                        continue
+                    for pi, p in enumerate(points):
+                        if not isinstance(p, dict):
+                            errors.append(f"{w}.{kinds[0]}.dataPoints"
+                                          f"[{pi}]: must be an object")
+                            continue
+                        if _unix_nano(p.get("timeUnixNano")) is None:
+                            errors.append(f"{w}.{kinds[0]}.dataPoints"
+                                          f"[{pi}]: timeUnixNano must be "
+                                          f"uint64 nanos")
+                        if kinds[0] == "histogram":
+                            bc = p.get("bucketCounts")
+                            eb = p.get("explicitBounds")
+                            if not isinstance(bc, list) \
+                                    or not isinstance(eb, list) \
+                                    or len(bc) != len(eb) + 1:
+                                errors.append(
+                                    f"{w}.histogram.dataPoints[{pi}]: "
+                                    f"bucketCounts must have "
+                                    f"len(explicitBounds)+1 entries")
     return errors
 
 
@@ -1202,6 +1476,34 @@ def main(argv: List[str]) -> int:
                 with open(path, encoding="utf-8") as fh:
                     errors.extend(f"{path}: {e}" for e in
                                   validate_fleet(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
+    if argv and argv[0] == "validate_fleet_trace":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_fleet_trace "
+                  "STITCHED_TRACE_JSON [...]", file=sys.stderr)
+            return 2
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_fleet_trace(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
+    if argv and argv[0] == "validate_export":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_export "
+                  "OTLP_PAYLOAD_JSON [...]", file=sys.stderr)
+            return 2
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_export(json.load(fh)))
             except (OSError, json.JSONDecodeError) as exc:
                 errors.append(f"{path}: unreadable ({exc})")
         return _finish(errors)

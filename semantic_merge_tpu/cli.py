@@ -174,6 +174,10 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("--status", action="store_true",
                          help="Query a running daemon's status and exit "
                               "(does not start one)")
+    p_serve.add_argument("--fleet", action="store_true",
+                         help="With --status against a fleet router: "
+                              "include every member's status block, "
+                              "aggregated through the router")
     p_serve.add_argument("--supervise", action="store_true",
                          help="Run under a supervisor that respawns a "
                               "crashed daemon with capped backoff "
@@ -226,6 +230,10 @@ def build_parser() -> argparse.ArgumentParser:
     p_stats.add_argument("--daemon", action="store_true",
                          help="Query the live merge service daemon instead "
                               "of reading an artifact file")
+    p_stats.add_argument("--fleet", action="store_true",
+                         help="With --daemon against a fleet router: "
+                              "aggregate every member's status through the "
+                              "router (no per-member socket addresses)")
 
     p_trace = sub.add_parser("trace",
                              help="Trace-artifact tooling (see runbook: "
@@ -242,6 +250,11 @@ def build_parser() -> argparse.ArgumentParser:
                                 "directory of them")
     p_analyze.add_argument("--json", action="store_true",
                            help="Emit the breakdown as JSON")
+    p_analyze.add_argument("--fleet", action="store_true",
+                           help="Router-hop attribution for stitched fleet "
+                                "traces (SEMMERGE_FLEET_TRACE_DIR "
+                                "artifacts): route / wal_fsync / relay / "
+                                "hedge_wait / member_execute")
 
     p_profile = sub.add_parser(
         "profile",
@@ -895,8 +908,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
     """Start (or, with ``--status``, query) the merge service daemon."""
     from .service import client as service_client
     if args.status:
+        method = "member_status" if getattr(args, "fleet", False) \
+            else "status"
         try:
-            status = service_client.call_control("status", path=args.socket)
+            status = service_client.call_control(method, path=args.socket)
         except service_client.DaemonUnavailable as exc:
             print(f"semmerge serve: no daemon running ({exc})",
                   file=sys.stderr)
@@ -1070,6 +1085,66 @@ def cmd_perf(args: argparse.Namespace) -> int:
     return 0 if ok else 1
 
 
+def _stats_fleet(args: argparse.Namespace, service_client) -> int:
+    """``semmerge stats --daemon --fleet``: one router round-trip
+    (``member_status`` / federated ``metrics``) instead of N per-member
+    socket addresses."""
+    if getattr(args, "prometheus", False):
+        try:
+            result = service_client.call_control("metrics")
+        except service_client.DaemonUnavailable as exc:
+            print(f"error: no fleet router reachable ({exc})",
+                  file=sys.stderr)
+            return 1
+        print(result.get("prometheus", ""), end="")
+        return 0
+    try:
+        agg = service_client.call_control("member_status")
+    except service_client.DaemonUnavailable as exc:
+        print(f"error: no fleet router reachable ({exc})", file=sys.stderr)
+        return 1
+    if not isinstance(agg, dict) or "router" not in agg:
+        print("error: peer is not a fleet router (plain daemon? drop "
+              "--fleet)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(agg, indent=2, default=str))
+        return 0
+    router = agg.get("router") or {}
+    members = agg.get("members") or {}
+    up = router.get("members_up", 0)
+    print(f"fleet pid={router.get('pid')} "
+          f"uptime={router.get('uptime_s', 0.0):.1f}s "
+          f"socket={router.get('socket')} "
+          f"members_up={up}/{len(members)}")
+    wal = router.get("wal") or {}
+    print(f"requests: served={router.get('served_total', 0)} "
+          f"in_flight={router.get('in_flight', 0)} "
+          f"wal_open={wal.get('open', 0)} "
+          f"wal_replayed={wal.get('replayed', 0)}")
+    slo = router.get("slo")
+    if slo:
+        print(f"slo: {'healthy' if slo.get('healthy') else 'BURNING'}")
+        for row in slo.get("objectives", ()):
+            mark = "TRIPPED" if row.get("tripped") else "ok"
+            print(f"  {mark:8s} {row.get('objective')}: "
+                  f"burn fast={row.get('burn_fast', 0.0):.2f}x "
+                  f"slow={row.get('burn_slow', 0.0):.2f}x")
+    for member_id in sorted(members):
+        st = members[member_id]
+        if not isinstance(st, dict):
+            print(f"member {member_id}: unreachable")
+            continue
+        decl_rate = st.get("declcache_hit_rate", 0.0) or 0.0
+        print(f"member {member_id}: pid={st.get('pid')} "
+              f"served={st.get('served_total', 0)} "
+              f"queue_depth={st.get('queue_depth', 0)} "
+              f"in_flight={st.get('in_flight', 0)} "
+              f"rss_mb={st.get('rss_mb', 0.0):.1f} "
+              f"declcache_hit_rate={decl_rate:.3f}")
+    return 0
+
+
 def cmd_stats(args: argparse.Namespace) -> int:
     """Pretty-print an observability artifact: a ``.semmerge-trace.json``
     trace, a ``.semmerge-events.jsonl`` span/event stream, or a metrics
@@ -1078,6 +1153,8 @@ def cmd_stats(args: argparse.Namespace) -> int:
     ``--daemon`` the data comes from the live merge service instead."""
     if getattr(args, "daemon", False):
         from .service import client as service_client
+        if getattr(args, "fleet", False):
+            return _stats_fleet(args, service_client)
         try:
             status = service_client.call_control("status")
         except service_client.DaemonUnavailable as exc:
@@ -1248,6 +1325,11 @@ def _render_stats(data: dict) -> List[str]:
 CRITICAL_PATH_BUCKETS = ("queue_wait", "batch_window", "pack", "kernel",
                          "host_tail", "apply")
 
+#: Router-hop buckets of ``semmerge trace analyze --fleet`` — where one
+#: routed request's wall time went across the fleet, in hop order.
+FLEET_PATH_BUCKETS = ("route", "wal_fsync", "relay", "hedge_wait",
+                      "member_execute")
+
 
 def _bucket_span(name: str, layer) -> str | None:
     """Map one span to its critical-path bucket (None = unattributed).
@@ -1312,6 +1394,64 @@ def _analyze_artifact(path: pathlib.Path) -> dict | None:
     }
 
 
+def _analyze_fleet_artifact(path: pathlib.Path) -> dict | None:
+    """One *stitched* fleet-trace artifact's router-hop breakdown, or
+    None when the file is not span-shaped. Buckets are non-overlapping:
+    member execute time is carved out of the relay legs that carried
+    it, relay out of the route spans that contain them — so the shares
+    attribute rather than double count."""
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    if not isinstance(data, dict) or not isinstance(data.get("spans"), list):
+        return None
+    wal = hedge_wait = relay_ok = route_like = 0.0
+    member_exec = member_queue = 0.0
+    for row in data["spans"]:
+        if not isinstance(row, dict):
+            continue
+        name = row.get("name") or ""
+        meta = row.get("meta") if isinstance(row.get("meta"), dict) else {}
+        try:
+            secs = float(row.get("seconds") or 0.0)
+        except (TypeError, ValueError):
+            continue
+        if name == "fleet.wal_fsync":
+            wal += secs
+        elif name == "fleet.hedge_wait":
+            hedge_wait += secs
+        elif name == "fleet.relay" and meta.get("outcome") == "ok":
+            relay_ok += secs
+        elif name in ("fleet.route", "fleet.failover"):
+            route_like += secs
+        elif name == "service.execute" and "member" in meta:
+            member_exec += secs
+        elif name == "service.queue_wait" and "member" in meta:
+            member_queue += secs
+    buckets = {
+        # Router-side routing overhead: the route/failover windows
+        # minus the relay legs and hedge wait nested inside them.
+        "route": max(route_like - relay_ok - hedge_wait, 0.0),
+        "wal_fsync": wal,
+        # Wire + framing overhead of the winning legs, net of the
+        # member-side work the legs carried.
+        "relay": max(relay_ok - member_exec - member_queue, 0.0),
+        "hedge_wait": hedge_wait,
+        "member_execute": member_exec,
+    }
+    total = wal + route_like
+    accounted = sum(buckets.values())
+    return {
+        "artifact": str(path),
+        "trace_id": data.get("trace_id"),
+        "reason": data.get("reason"),
+        "total_seconds": round(total, 6),
+        "buckets": {b: round(v, 6) for b, v in buckets.items()},
+        "other_seconds": round(max(total - accounted, 0.0), 6),
+    }
+
+
 def _pctl(values: List[float], q: float) -> float:
     if not values:
         return 0.0
@@ -1329,9 +1469,12 @@ def cmd_trace_analyze(args: argparse.Namespace) -> int:
     """Per-request latency attribution from trace/postmortem artifacts:
     one file → its critical-path breakdown; a directory → p50/p99 per
     bucket over every span-shaped artifact in it."""
+    fleet = bool(getattr(args, "fleet", False))
+    analyze = _analyze_fleet_artifact if fleet else _analyze_artifact
+    order = FLEET_PATH_BUCKETS if fleet else CRITICAL_PATH_BUCKETS
     path = pathlib.Path(args.artifact)
     if path.is_dir():
-        results = [r for r in (_analyze_artifact(p)
+        results = [r for r in (analyze(p)
                                for p in sorted(path.glob("*.json")))
                    if r is not None]
         if not results:
@@ -1343,20 +1486,19 @@ def cmd_trace_analyze(args: argparse.Namespace) -> int:
             "p50": {}, "p99": {},
             "results": results,
         }
-        for bucket in CRITICAL_PATH_BUCKETS + ("other_seconds",
-                                               "total_seconds"):
+        for bucket in order + ("other_seconds", "total_seconds"):
             vals = [r["buckets"].get(bucket, r.get(bucket, 0.0))
-                    if bucket in CRITICAL_PATH_BUCKETS else r.get(bucket, 0.0)
+                    if bucket in order else r.get(bucket, 0.0)
                     for r in results]
             summary["p50"][bucket] = round(_pctl(vals, 0.50), 6)
             summary["p99"][bucket] = round(_pctl(vals, 0.99), 6)
         if args.json:
             print(json.dumps(summary, indent=2))
             return 0
-        print(f"critical path over {len(results)} request artifact(s):")
+        what = "router-hop path" if fleet else "critical path"
+        print(f"{what} over {len(results)} request artifact(s):")
         print(f"{'bucket':<14} {'p50 ms':>10} {'p99 ms':>10}")
-        for bucket in CRITICAL_PATH_BUCKETS + ("other_seconds",
-                                               "total_seconds"):
+        for bucket in order + ("other_seconds", "total_seconds"):
             label = bucket.replace("_seconds", "")
             print(f"{label:<14} {summary['p50'][bucket] * 1e3:>10.1f} "
                   f"{summary['p99'][bucket] * 1e3:>10.1f}")
@@ -1364,7 +1506,7 @@ def cmd_trace_analyze(args: argparse.Namespace) -> int:
     if not path.is_file():
         print(f"error: no artifact at {path}", file=sys.stderr)
         return 1
-    result = _analyze_artifact(path)
+    result = analyze(path)
     if result is None:
         print(f"error: {path} is not a span-shaped trace or postmortem "
               f"artifact", file=sys.stderr)
@@ -1376,7 +1518,7 @@ def cmd_trace_analyze(args: argparse.Namespace) -> int:
     print(f"trace {tid}: total {result['total_seconds'] * 1e3:.1f} ms")
     print(f"{'bucket':<14} {'ms':>10} {'share':>7}")
     total = result["total_seconds"] or 1.0
-    for bucket in CRITICAL_PATH_BUCKETS:
+    for bucket in order:
         v = result["buckets"][bucket]
         print(f"{bucket:<14} {v * 1e3:>10.1f} {v / total:>6.1%}")
     v = result["other_seconds"]

@@ -48,6 +48,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import queue
 import signal
 import socket
 import sys
@@ -59,8 +60,10 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..errors import FleetFault, MergeFault, fault_boundary
+from ..obs import export as obs_export
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
 from ..obs import spans as obs_spans
 from ..service import protocol, telemetry
 from ..service.supervisor import MemberSupervisor
@@ -78,6 +81,42 @@ _REPLAY_HELP = "WAL entries replayed after a router restart"
 
 #: Health-probe failures before a member is ejected from the ring.
 _EJECT_AFTER = 3
+
+
+def _label_member(exposition: str, member: str) -> str:
+    """Inject ``member="<id>"`` into every sample line of a Prometheus
+    text exposition (comments pass through; lines that already carry a
+    ``member`` label — the fleet rollups — are left alone)."""
+    out = []
+    for line in exposition.splitlines():
+        if not line or line.startswith("#") or 'member="' in line:
+            out.append(line)
+            continue
+        brace = line.rfind("}")
+        if brace != -1 and "{" in line:
+            out.append(f'{line[:brace]},member="{member}"{line[brace:]}')
+        else:
+            space = line.find(" ")
+            if space == -1:
+                out.append(line)
+            else:
+                out.append(f'{line[:space]}{{member="{member}"}}'
+                           f'{line[space:]}')
+    return "\n".join(out)
+
+
+def _dedupe_comments(exposition: str) -> str:
+    """Drop repeated ``# HELP``/``# TYPE`` lines — concatenating N
+    member scrapes repeats them, and strict parsers reject that."""
+    seen: set = set()
+    out = []
+    for line in exposition.splitlines():
+        if line.startswith("#"):
+            if line in seen:
+                continue
+            seen.add(line)
+        out.append(line)
+    return "\n".join(out)
 
 
 def _env_int(name: str, default: int) -> int:
@@ -167,6 +206,29 @@ class FleetRouter:
             "SEMMERGE_FLEET_HEALTH_INTERVAL", 0.5)
         self._request_timeout = env_seconds("SEMMERGE_FLEET_TIMEOUT", 600.0)
         self._telemetry: Optional[telemetry.TelemetryServer] = None
+        # Trace stitching: one router-side recorder per request grafts
+        # the router's own fleet spans together with the span trees the
+        # members ship back (SEMMERGE_FLEET_STITCH=off goes dark — the
+        # tracecost bench's control arm).
+        self._stitch = os.environ.get(
+            "SEMMERGE_FLEET_STITCH", "on").strip().lower() != "off"
+        self._trace_dir = os.environ.get(
+            "SEMMERGE_FLEET_TRACE_DIR", "").strip() or None
+        # Sealing a stitched trace (artifact write + OTLP serialize)
+        # happens off the response path: requests hand their recorder
+        # to a bounded background queue; a full queue drops the trace
+        # (counted) rather than stall the reply.
+        self._trace_q: "queue.Queue[Optional[Tuple[str, Any]]]" = \
+            queue.Queue(maxsize=256)
+        self._sealer: Optional[threading.Thread] = None
+        if self._stitch:
+            self._sealer = threading.Thread(target=self._trace_sealer,
+                                            daemon=True,
+                                            name="fleet-trace-sealer")
+            self._sealer.start()
+        # Router-level SLOs: same engine/knobs as the member daemons,
+        # observed over routed (end-to-end) latencies.
+        self._slo = obs_slo.from_env()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -248,7 +310,8 @@ class FleetRouter:
             threading.Thread(target=self._replay, args=(pending,),
                              daemon=True, name="fleet-replay").start()
         obs_metrics.REGISTRY.gauge("fleet_members", _MEMBERS_HELP).set(0)
-        self._telemetry = telemetry.maybe_start(self.status)
+        self._telemetry = telemetry.maybe_start(self.status,
+                                                self._federated_metrics)
         if self._telemetry is not None:
             logger.info("fleet telemetry on 127.0.0.1:%d",
                         self._telemetry.port)
@@ -344,6 +407,15 @@ class FleetRouter:
                 m.sup.kill()
                 with contextlib.suppress(Exception):
                     proc.wait(timeout=5)
+        if self._sealer is not None:
+            # Flush queued traces (FIFO ahead of the sentinel), then
+            # give the OTLP exporter its drain window.
+            with contextlib.suppress(queue.Full):
+                self._trace_q.put_nowait(None)
+            self._sealer.join(timeout=10.0)
+            exporter = obs_export.maybe_exporter()
+            if exporter is not None:
+                exporter.close()
         self._wal.close()
         if self._telemetry is not None:
             self._telemetry.stop()
@@ -455,7 +527,25 @@ class FleetRouter:
                 conn.close()
 
     def _health_loop(self) -> None:
+        metrics_interval = env_seconds("SEMMERGE_OTLP_METRICS_INTERVAL",
+                                       10.0)
+        last_export = time.monotonic()
         while not self._stop.wait(self._health_interval):
+            exporter = obs_export.maybe_exporter()
+            if exporter is not None and \
+                    time.monotonic() - last_export >= metrics_interval:
+                last_export = time.monotonic()
+                exporter.export_metrics(obs_metrics.REGISTRY.to_dict())
+            if self._slo is not None:
+                try:
+                    verdict = self._slo.evaluate(consume_edges=True)
+                except Exception:
+                    verdict = {}
+                for r in verdict.get("newly_tripped") or []:
+                    logger.warning(
+                        "fleet SLO burn: %s (fast %sx, slow %sx)",
+                        r.get("objective"), r.get("burn_fast"),
+                        r.get("burn_slow"))
             for member in self._members:
                 if self._draining:
                     return
@@ -525,10 +615,25 @@ class FleetRouter:
                     protocol.write_message(wfile, {
                         "id": req_id,
                         "result": {
-                            "prometheus":
-                                obs_metrics.REGISTRY.render_prometheus(),
+                            "prometheus": self._federated_metrics(),
                             "metrics": obs_metrics.REGISTRY.to_dict(),
                             "health": self.status(),
+                            "federated": True,
+                        }})
+                    continue
+                if method == "member_status":
+                    # The fleet aggregation surface behind `semmerge
+                    # stats --fleet` / `serve --status --fleet`: router
+                    # status plus every member's own status block, one
+                    # round-trip, no per-member socket bookkeeping.
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": {
+                            "router": self.status(),
+                            "members": {
+                                m.id: self._member_call(m, "status", {},
+                                                        timeout=5.0)
+                                for m in self._members},
                         }})
                     continue
                 if method == "drain":
@@ -609,15 +714,32 @@ class FleetRouter:
                     self._seen_set.discard(self._seen_keys[0])
                 self._seen_keys.append(key)
                 self._seen_set.add(key)
-        with fault_boundary("fleet:route"):
-            faults.check("fleet:route")
-            self._wal.record_request(idem, method, params, trace_id)
-            response = self._route(method, params, key, idem)
+        rec = obs_spans.SpanRecorder(detailed=False) if self._stitch \
+            else None
+        with obs_spans.request_scope(trace_id, rec):
+            with fault_boundary("fleet:route"):
+                faults.check("fleet:route")
+                t0 = time.perf_counter()
+                self._wal.record_request(idem, method, params, trace_id)
+                obs_spans.record("fleet.wal_fsync",
+                                 time.perf_counter() - t0, layer="fleet",
+                                 t_start=t0)
+                response = self._route(method, params, key, idem, rec)
         self._wal.ack(idem)
+        if rec is not None:
+            try:
+                self._trace_q.put_nowait((trace_id, rec))
+            except queue.Full:
+                obs_metrics.REGISTRY.counter(
+                    "fleet_trace_dropped_total",
+                    "Stitched traces dropped on a full sealer queue."
+                ).inc(1)
         return response
 
     def _route(self, method: str, params: Dict[str, Any], key: str,
-               idem: str) -> Dict[str, Any]:
+               idem: str,
+               rec: Optional[obs_spans.SpanRecorder] = None
+               ) -> Dict[str, Any]:
         """Rank → dispatch → failover until a member answers."""
         hedge_ok = self._hedge_on and "--inplace" not in (
             params.get("argv") or [])
@@ -636,9 +758,11 @@ class FleetRouter:
             hedge_target = (self._member_by_id(candidates[1])
                             if hedge_ok and len(candidates) > 1 else None)
             t0 = time.monotonic()
+            t0_pc = time.perf_counter()
             try:
                 response, winner, hedged_won = self._send(
-                    target, hedge_target, method, params)
+                    target, hedge_target, method, params, rec,
+                    attempts + 1)
             except _MemberTransport:
                 attempts += 1
                 tried.add(target.id)
@@ -648,7 +772,8 @@ class FleetRouter:
                         1, reason="transport")
                 obs_spans.record("fleet.failover",
                                  time.monotonic() - t0, layer="fleet",
-                                 reason="transport", member=target.id)
+                                 t_start=t0_pc, reason="transport",
+                                 member=target.id, attempt=attempts)
                 if attempts >= max_attempts:
                     raise FleetFault(
                         f"dispatch failed on {attempts} members",
@@ -658,10 +783,14 @@ class FleetRouter:
             self._latencies.append(dt)
             winner.dispatches += 1
             obs_spans.record("fleet.route", dt, layer="fleet",
-                             verb=method, member=winner.id)
+                             t_start=t0_pc, verb=method, member=winner.id,
+                             attempt=attempts + 1)
             if hedged_won:
                 obs_metrics.REGISTRY.counter(
                     "fleet_hedge_wins_total", _HEDGE_WINS_HELP).inc(1)
+            if self._slo is not None:
+                self._slo.observe(method, dt,
+                                  error="error" in response)
             return response
 
     def _hedge_delay_s(self) -> float:
@@ -676,11 +805,20 @@ class FleetRouter:
 
     def _send(self, target: _Member, hedge_target: Optional[_Member],
               method: str, params: Dict[str, Any],
+              rec: Optional[obs_spans.SpanRecorder] = None,
+              attempt: int = 1,
               ) -> Tuple[Dict[str, Any], _Member, bool]:
         """Dispatch to ``target``, optionally hedging to
         ``hedge_target`` after the p99-derived delay. Returns
         ``(response, winning member, hedge_won)``; raises
-        :class:`_MemberTransport` only when every attempted leg died."""
+        :class:`_MemberTransport` only when every attempted leg died.
+
+        When ``rec`` is set (stitching on), each leg records a
+        ``fleet.relay`` span directly into it (``record_into`` — leg
+        threads don't inherit the request scope) and the *winning* leg
+        grafts the member-shipped span tree (``result.meta.spans``)
+        under its relay anchor before releasing the dispatch — so the
+        stitched tree is complete the moment ``done`` fires."""
         self._wal.record_dispatch(params["idempotency_key"], target.id)
         box: Dict[str, Any] = {}
         done = threading.Event()
@@ -688,28 +826,54 @@ class FleetRouter:
         conns: Dict[str, socket.socket] = {}
 
         def leg(member: _Member, is_hedge: bool) -> None:
+            t0 = time.perf_counter()
             try:
                 resp = self._member_verb(member, method, params, conns)
             except _MemberTransport:
+                if rec is not None:
+                    obs_spans.record_into(
+                        rec, "fleet.relay", time.perf_counter() - t0,
+                        t_start=t0, layer="fleet", member=member.id,
+                        attempt=attempt, outcome="transport")
                 with lock:
                     box.setdefault("dead", []).append(member.id)
                     if len(box.get("dead", [])) >= legs_total[0]:
                         done.set()
                 return
+            dt = time.perf_counter() - t0
             with lock:
-                if "resp" not in box:
+                won = "resp" not in box
+                if won:
                     box["resp"] = (resp, member, is_hedge)
-                    done.set()
+            if rec is not None:
+                obs_spans.record_into(
+                    rec, "fleet.relay", dt, t_start=t0, layer="fleet",
+                    member=member.id, attempt=attempt,
+                    outcome="ok" if won else "late")
+                if won:
+                    self._graft_member_spans(rec, resp, member, attempt,
+                                             t0)
+            if won:
+                done.set()
 
         legs_total = [1]
         threading.Thread(target=leg, args=(target, False),
                          daemon=True).start()
+        hedge_launched = False
         if hedge_target is not None:
-            if not done.wait(self._hedge_delay_s()):
+            t_hw = time.perf_counter()
+            primary_done = done.wait(self._hedge_delay_s())
+            if rec is not None:
+                obs_spans.record_into(
+                    rec, "fleet.hedge_wait",
+                    time.perf_counter() - t_hw, t_start=t_hw,
+                    layer="fleet")
+            if not primary_done:
                 with lock:
                     launch_hedge = "resp" not in box and \
                         len(box.get("dead", [])) == 0
                 if launch_hedge:
+                    hedge_launched = True
                     legs_total[0] = 2
                     obs_metrics.REGISTRY.counter(
                         "fleet_hedges_total", _HEDGES_HELP).inc(1)
@@ -734,10 +898,30 @@ class FleetRouter:
             if member_id != winner.id:
                 with contextlib.suppress(OSError):
                     c.close()
+        if hedge_launched:
+            loser = target if is_hedge else hedge_target
+            obs_spans.record("fleet.hedge", 0.0, layer="fleet",
+                             member=loser.id, won=False, outcome="lost")
         if is_hedge:
             obs_spans.record("fleet.hedge", 0.0, layer="fleet",
-                             member=winner.id, won=True)
+                             member=winner.id, won=True, outcome="won")
         return resp, winner, is_hedge
+
+    def _graft_member_spans(self, rec: obs_spans.SpanRecorder,
+                            resp: Dict[str, Any], member: _Member,
+                            attempt: int, t0: float) -> None:
+        """Pull the member-shipped span tree off the wire response and
+        graft it into the stitched recorder, anchored at the relay
+        start (member ``perf_counter`` epochs mean nothing here) and
+        stamped with member id + attempt. The rows are *moved* out of
+        ``result.meta`` — the client gets the lean response it always
+        got; the stitched artifact owns the tree."""
+        result = resp.get("result")
+        meta = result.get("meta") if isinstance(result, dict) else None
+        rows = meta.pop("spans", None) if isinstance(meta, dict) else None
+        if rows:
+            rec.absorb_dicts(rows, t_base=max(t0 - rec.epoch, 0.0),
+                             member=member.id, attempt=attempt)
 
     def _member_verb(self, member: _Member, method: str,
                      params: Dict[str, Any],
@@ -807,6 +991,79 @@ class FleetRouter:
             logger.info("WAL replay settled %s (%s)", idem, verb)
 
     # ------------------------------------------------------------------
+    # observability plane: stitched traces + federated telemetry
+
+    def _trace_sealer(self) -> None:
+        """Drain the sealing queue: one stitched trace at a time, off
+        the response path. A ``None`` sentinel (teardown) stops the
+        thread after everything queued ahead of it is sealed."""
+        while True:
+            item = self._trace_q.get()
+            if item is None:
+                return
+            trace_id, rec = item
+            try:
+                self._finish_trace(trace_id, rec)
+            except Exception:
+                logger.exception("trace seal failed for %s", trace_id)
+
+    def _finish_trace(self, trace_id: str,
+                      rec: obs_spans.SpanRecorder) -> None:
+        """Seal one stitched trace: persist the artifact when
+        ``SEMMERGE_FLEET_TRACE_DIR`` is set, ship it OTLP-ward when an
+        exporter is configured. Best-effort on both paths — a full disk
+        or a dead collector must never fail a routed merge."""
+        rows = rec.span_dicts()
+        if not rows:
+            return
+        if self._trace_dir:
+            artifact = {"schema": 1, "kind": "fleet-trace",
+                        "trace_id": trace_id, "router_pid": os.getpid(),
+                        "socket": self._socket_path, "spans": rows}
+            try:
+                os.makedirs(self._trace_dir, exist_ok=True)
+                path = os.path.join(self._trace_dir, f"{trace_id}.json")
+                tmp = f"{path}.tmp"
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump(artifact, fh, default=str)
+                os.replace(tmp, path)
+            except OSError:
+                pass
+        exporter = obs_export.maybe_exporter()
+        if exporter is not None:
+            exporter.export_trace(trace_id, rows)
+
+    def _federated_metrics(self) -> str:
+        """The fleet's one scrape surface: the router's own registry
+        (re-labelled ``member="router"``) concatenated with every
+        live member's ``/metrics`` scrape re-labelled by member id,
+        plus ``fleet_member_up`` rollups. Scrape failures count in
+        ``fleet_scrape_errors_total`` and drop that member's block —
+        a wedged member must not wedge the fleet scrape."""
+        up = obs_metrics.REGISTRY.gauge(
+            "fleet_member_up", "Ring membership by member (1=in ring)")
+        for m in self._members:
+            up.set(1.0 if m.in_ring else 0.0, member=m.id)
+        parts = [_label_member(
+            obs_metrics.REGISTRY.render_prometheus(), "router")]
+        for m in self._members:
+            port = m.metrics_port
+            if not port:
+                continue
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/metrics")
+                with urllib.request.urlopen(req, timeout=2.0) as resp:
+                    text = resp.read().decode("utf-8")
+            except Exception:
+                obs_metrics.REGISTRY.counter(
+                    "fleet_scrape_errors_total",
+                    "Failed member /metrics scrapes").inc(1, member=m.id)
+                continue
+            parts.append(_label_member(text, m.id))
+        return _dedupe_comments("\n".join(p for p in parts if p)) + "\n"
+
+    # ------------------------------------------------------------------
     # control verbs
 
     def _drain_verb(self, params: Dict[str, Any]) -> Dict[str, Any]:
@@ -846,5 +1103,7 @@ class FleetRouter:
             "hedge": {"enabled": self._hedge_on,
                       "delay_ms": round(self._hedge_delay_s() * 1000.0,
                                         3)},
+            "stitch": self._stitch,
+            "slo": self._slo.status() if self._slo is not None else None,
             "metrics": obs_metrics.REGISTRY.to_dict(),
         }

@@ -25,7 +25,7 @@ Import cost is intentionally trivial (stdlib only — no JAX, no numpy),
 so every layer can import ``obs`` at module top without touching the
 host path's cold-start budget.
 """
-from . import device, flight, metrics, spans  # noqa: F401
+from . import device, export, flight, metrics, spans  # noqa: F401
 from .metrics import REGISTRY, registry  # noqa: F401
 from .spans import (SpanRecorder, activate, activated, active,  # noqa: F401
                     current, deactivate, event, record, record_into,

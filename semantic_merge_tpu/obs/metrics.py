@@ -116,15 +116,16 @@ class Histogram(_Metric):
             # Cumulative-upper-bound semantics: the first bucket whose
             # bound is >= value owns the observation (bisect_left puts a
             # value exactly on a bound INTO that bound's bucket).
-            series["counts"][bisect_left(self.buckets, value)] += 1
+            idx = bisect_left(self.buckets, value)
+            series["counts"][idx] += 1
             series["sum"] += value
             series["count"] += 1
             if exemplar is not None:
-                # Last-write-wins exemplar: one representative trace_id
-                # per series, so a latency histogram stays joinable to
-                # an actual request trace.
-                series["exemplar"] = {"trace_id": str(exemplar),
-                                      "value": float(value)}
+                # Per-bucket exemplars (OpenMetrics): each bucket keeps
+                # its own most recent trace_id, so a p99 outlier's id
+                # survives the stream of p50 observations that follows.
+                series.setdefault("exemplars", {})[idx] = {
+                    "trace_id": str(exemplar), "value": float(value)}
 
     def sum(self, **labels: object) -> float:
         with self._lock:
@@ -210,8 +211,10 @@ class Registry:
                         dict({"labels": dict(key),
                               "counts": list(s["counts"]),
                               "sum": s["sum"], "count": s["count"]},
-                             **({"exemplar": dict(s["exemplar"])}
-                                if "exemplar" in s else {}))
+                             **({"exemplars": {str(i): dict(e)
+                                               for i, e in
+                                               sorted(s["exemplars"].items())}}
+                                if s.get("exemplars") else {}))
                         for key, s in m._labelled()
                     ],
                 }

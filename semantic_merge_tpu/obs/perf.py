@@ -60,6 +60,13 @@ GUARDED_FIELDS = {
     "fleet_merges_per_sec_m1": "higher",
     "fleet_merges_per_sec_m3": "higher",
     "fleet_rehash_miss_rate": "lower",
+    # Tracecost preset, fleet leg: what the stitched observability
+    # plane (member span shipping + router grafting + artifact/OTLP
+    # sealing) costs a routed merge, as a percent of the dark fleet's
+    # median latency. The baseline entry anchors this at the 2%
+    # budget rather than a (noise-floor) measurement, so the guard
+    # trips exactly when the budget does.
+    "fleet_trace_overhead_pct": "lower",
 }
 
 

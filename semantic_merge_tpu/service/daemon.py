@@ -47,6 +47,7 @@ from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 from ..errors import DeadlineFault, MergeFault, WorkerFault, fault_boundary
+from ..obs import export as obs_export
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import spans as obs_spans
@@ -715,6 +716,14 @@ class Daemon:
                         },
                     },
                 }
+                if self._fleet_member is not None and os.environ.get(
+                        "SEMMERGE_FLEET_STITCH", "on").strip() != "off":
+                    # Fleet member: ship this request's span tree (the
+                    # member's service/engine/worker spans) back over
+                    # the wire so the router can graft it into the one
+                    # stitched tree per trace_id.
+                    req.response["result"]["meta"]["spans"] = \
+                        req.recorder.span_dicts()
                 obs_metrics.REGISTRY.histogram(
                     "service_request_seconds", _LATENCY_HELP).observe(
                         queue_wait + duration, exemplar=req.trace_id,
@@ -745,6 +754,14 @@ class Daemon:
                     # so the events artifact still covers everything.
                     self._recorder.absorb(req.recorder,
                                           trace_id=req.trace_id)
+                if self._fleet_member is None:
+                    # Standalone daemon: export this request's trace
+                    # directly (fleet members ship spans to the router
+                    # instead — the stitched tree is exported once).
+                    exporter = obs_export.maybe_exporter()
+                    if exporter is not None:
+                        exporter.export_trace(req.trace_id,
+                                              req.recorder.span_dicts())
 
     def _run_cli(self, req: _Request):
         """The actual CLI invocation: ``service.execute`` span, request

@@ -45,9 +45,10 @@ VERBS = ("semdiff", "semmerge", "semrebase")
 #: recurse, SEMMERGE_METRICS is a process-atexit artifact of whichever
 #: process owns it, the service socket is connection metadata, and the
 #: SLO engine is daemon-lifetime state — a client's objectives must not
-#: reconfigure a shared daemon per request.
+#: reconfigure a shared daemon per request (the OTLP exporter is a
+#: process-lifetime background shipper with the same ownership rule).
 _UNSHIPPED_PREFIXES = ("SEMMERGE_SERVICE_", "SEMMERGE_SLO",
-                       "SEMMERGE_FLEET")
+                       "SEMMERGE_FLEET", "SEMMERGE_OTLP")
 _UNSHIPPED = frozenset({"SEMMERGE_DAEMON", "SEMMERGE_METRICS",
                         "SEMMERGE_METRICS_PORT"})
 

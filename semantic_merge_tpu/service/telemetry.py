@@ -47,7 +47,9 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path == "/metrics":
-                text = obs_metrics.REGISTRY.render_prometheus()
+                metrics_fn = getattr(self.server, "semmerge_metrics", None)
+                text = metrics_fn() if metrics_fn is not None \
+                    else obs_metrics.REGISTRY.render_prometheus()
                 self._send(200, "text/plain; version=0.0.4; charset=utf-8",
                            text.encode("utf-8"))
             elif path in ("/healthz", "/health"):
@@ -76,10 +78,15 @@ class TelemetryServer:
     daemon's serve/teardown lifecycle."""
 
     def __init__(self, port: int,
-                 health_fn: Callable[[], dict]) -> None:
+                 health_fn: Callable[[], dict],
+                 metrics_fn: Optional[Callable[[], str]] = None) -> None:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.semmerge_health = health_fn  # type: ignore[attr-defined]
+        # Optional exposition override: the fleet router serves its
+        # *federated* view (member scrapes + rollups) instead of the
+        # process-local registry.
+        self._httpd.semmerge_metrics = metrics_fn  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -102,7 +109,9 @@ class TelemetryServer:
             self._thread.join(timeout=5)
 
 
-def maybe_start(health_fn: Callable[[], dict]) -> Optional[TelemetryServer]:
+def maybe_start(health_fn: Callable[[], dict],
+                metrics_fn: Optional[Callable[[], str]] = None
+                ) -> Optional[TelemetryServer]:
     """Start the listener when ``SEMMERGE_METRICS_PORT`` is set; return
     ``None`` (and stay dark) when unset, unparsable, or unbindable —
     telemetry must never stop the daemon from serving merges."""
@@ -114,6 +123,6 @@ def maybe_start(health_fn: Callable[[], dict]) -> Optional[TelemetryServer]:
     except ValueError:
         return None
     try:
-        return TelemetryServer(port, health_fn).start()
+        return TelemetryServer(port, health_fn, metrics_fn).start()
     except OSError:
         return None

@@ -1,0 +1,542 @@
+"""Fleet-wide observability plane (ISSUE 15): stitched cross-process
+traces, federated telemetry, and OTLP export.
+
+The bar:
+
+- A fleet member ships its per-request span tree over the wire; the
+  router grafts it (``SpanRecorder.absorb_dicts``) into one tree per
+  trace id with ``seconds`` carried byte-for-byte and parent links
+  preserved.
+- One merge through a live 2-member fleet yields a single stitched
+  artifact spanning three processes — router (``fleet`` layer), member
+  daemon (``service`` layer), and the member's subprocess worker
+  (``worker`` layer) — that ``validate_fleet_trace`` accepts and
+  ``semmerge trace analyze --fleet`` attributes across router hops.
+- A hedged request's loser leg is annotated ``outcome=lost`` in the
+  stitched tree; a member SIGKILLed mid-request leaves ONE tree
+  carrying both the failed attempt and the failover retry.
+- Histogram exemplars are per-bucket (OpenMetrics): a p99 outlier's
+  trace id survives later p50 traffic.
+- ``spans_to_otlp`` / ``metrics_to_otlp`` payloads pass
+  ``validate_export``; the background exporter delivers to a local
+  collector and *drops* (never blocks) on a full queue.
+"""
+import importlib.util
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from semantic_merge_tpu.fleet import hashring
+from semantic_merge_tpu.obs import export as obs_export
+from semantic_merge_tpu.obs import metrics as obs_metrics
+from semantic_merge_tpu.obs import spans as obs_spans
+from semantic_merge_tpu.service import protocol
+
+from test_fleet import _control, _spawn_router, _stop_router
+from test_resilience import build_repo, raw_close, raw_conn, send_merge
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+_SCHEMA_SCRIPT = REPO_ROOT / "scripts" / "check_trace_schema.py"
+
+
+@pytest.fixture(scope="module")
+def schema():
+    spec = importlib.util.spec_from_file_location("check_trace_schema",
+                                                  _SCHEMA_SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Per-bucket exemplars (OpenMetrics)
+# ---------------------------------------------------------------------------
+
+def test_histogram_exemplars_are_per_bucket():
+    reg = obs_metrics.Registry()
+    h = reg.histogram("x_seconds", "h", buckets=(0.01, 1.0))
+    h.observe(5.0, exemplar="outlier1")       # +Inf bucket (idx 2)
+    h.observe(0.001, exemplar="fast1")        # bucket 0
+    for i in range(50):                       # p50 stream, same bucket
+        h.observe(0.002, exemplar=f"fast{i + 2}")
+    data = reg.to_dict()["histograms"]["x_seconds"]["series"][0]
+    ex = data["exemplars"]
+    # The outlier's id survived the fast-bucket stream — the property
+    # last-write-wins per series could not provide.
+    assert ex["2"] == {"trace_id": "outlier1", "value": 5.0}
+    # Within a bucket the most recent observation wins.
+    assert ex["0"] == {"trace_id": "fast51", "value": 0.002}
+    assert set(ex) == {"0", "2"}
+    # Series without exemplars don't grow the key (wire compat).
+    h2 = reg.histogram("y_seconds", "h", buckets=(1.0,))
+    h2.observe(0.5)
+    assert "exemplars" not in \
+        reg.to_dict()["histograms"]["y_seconds"]["series"][0]
+
+
+def test_exemplar_schema_round_trip(schema):
+    reg = obs_metrics.Registry()
+    h = reg.histogram("z_seconds", "h", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="aabb", verb="semmerge")
+    assert schema.validate_metrics(reg.to_dict()) == []
+    # The pre-OpenMetrics per-series shape is rejected as drift.
+    bad = reg.to_dict()
+    series = bad["histograms"]["z_seconds"]["series"][0]
+    series["exemplar"] = series.pop("exemplars")["0"]
+    assert any("per-bucket" in e for e in schema.validate_metrics(bad))
+
+
+# ---------------------------------------------------------------------------
+# Cross-process graft: absorb_dicts
+# ---------------------------------------------------------------------------
+
+def _member_tree():
+    rec = obs_spans.SpanRecorder(detailed=True)
+    with obs_spans.request_scope("t1", rec):
+        with obs_spans.span("service.execute", layer="service"):
+            with obs_spans.span("worker.diff", layer="worker"):
+                time.sleep(0.001)
+    return rec
+
+
+def test_absorb_dicts_preserves_seconds_byte_for_byte():
+    shipped = _member_tree().span_dicts()
+    router = obs_spans.SpanRecorder(detailed=False)
+    anchor = router._new_id()
+    obs_spans.record_into(router, "fleet.relay", 0.5, t_start=0.0,
+                          layer="fleet", member="m0", attempt=1,
+                          outcome="ok")
+    router.absorb_dicts(shipped, t_base=0.25, member="m0", attempt=1)
+    rows = router.span_dicts()
+    grafted = {r["name"]: r for r in rows if r["layer"] != "fleet"}
+    assert set(grafted) == {"service.execute", "worker.diff"}
+    # The phase totals of the grafted subtree equal the shipped tree
+    # byte-for-byte: seconds are carried untouched through the graft.
+    assert [grafted[r["name"]]["seconds"] for r in shipped] \
+        == [r["seconds"] for r in shipped]
+    # Start times re-anchor at t_base; graft meta stamps every row.
+    for row in shipped:
+        g = grafted[row["name"]]
+        assert g["t_start"] == round(row["t_start"] + 0.25, 6)
+        assert g["meta"]["member"] == "m0" and g["meta"]["attempt"] == 1
+    # Parent links survive the id remap: the worker span still hangs
+    # off the execute span, and ids never collide with the router's.
+    ex, wk = grafted["service.execute"], grafted["worker.diff"]
+    assert wk["parent_id"] == ex["span_id"]
+    assert ex["span_id"] > anchor and wk["span_id"] > anchor
+    assert ex["depth"] == 0 and wk["depth"] == 1
+
+
+def test_absorb_dicts_reparents_under_caller_span():
+    shipped = _member_tree().span_dicts()
+    router = obs_spans.SpanRecorder(detailed=False)
+    router.absorb_dicts(shipped, parent_id=77, depth=2, member="m1",
+                        attempt=3)
+    rows = {r["name"]: r for r in router.span_dicts()}
+    assert rows["service.execute"]["parent_id"] == 77
+    assert rows["service.execute"]["depth"] == 2
+    assert rows["worker.diff"]["depth"] == 3
+
+
+# ---------------------------------------------------------------------------
+# OTLP mapping + exporter
+# ---------------------------------------------------------------------------
+
+def test_spans_to_otlp_validates_and_anchors(schema):
+    rec = _member_tree()
+    payload = obs_export.spans_to_otlp("ab12cd34ab12cd34",
+                                       rec.span_dicts(),
+                                       epoch_unix_nano=1_000_000_000)
+    assert schema.validate_export(payload) == []
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    by_name = {s["name"]: s for s in spans}
+    # Our 16-hex ids left-pad to OTLP's 32 so they stay greppable.
+    assert by_name["worker.diff"]["traceId"] \
+        == "0000000000000000ab12cd34ab12cd34"
+    assert by_name["worker.diff"]["parentSpanId"] \
+        == by_name["service.execute"]["spanId"]
+    for s in spans:
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"]) \
+            >= 1_000_000_000
+
+
+def test_spans_to_otlp_error_status(schema):
+    rows = [{"name": "fleet.route", "layer": "fleet", "t_start": 0.0,
+             "seconds": 0.1, "depth": 0, "span_id": 1, "parent_id": -1,
+             "thread": "t", "status": "error", "error": "boom",
+             "meta": {"member": "m0"}}]
+    payload = obs_export.spans_to_otlp("ff", rows)
+    assert schema.validate_export(payload) == []
+    span = payload["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert span["status"] == {"code": 2, "message": "boom"}
+
+
+def test_metrics_to_otlp_validates(schema):
+    reg = obs_metrics.Registry()
+    reg.counter("fleet_requests_total", "h").inc(verb="semmerge")
+    reg.gauge("fleet_members", "h").set(2)
+    h = reg.histogram("service_request_seconds", "h", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="cafe")
+    h.observe(9.0, exemplar="beef")
+    payload = obs_export.metrics_to_otlp(reg.to_dict(),
+                                         time_unix_nano=123)
+    assert schema.validate_export(payload) == []
+    metrics = {m["name"]: m for m in
+               payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]}
+    assert metrics["fleet_requests_total"]["sum"]["isMonotonic"] is True
+    point = metrics["service_request_seconds"]["histogram"]["dataPoints"][0]
+    assert point["bucketCounts"] == ["1", "0", "1"]
+    assert point["explicitBounds"] == [0.1, 1.0]
+    assert {e["traceId"][-4:] for e in point["exemplars"]} \
+        == {"cafe", "beef"}
+
+
+class _CollectorSink(ThreadingHTTPServer):
+    """Minimal OTLP collector: records every POST body by path."""
+
+    daemon_threads = True
+
+    def __init__(self):
+        self.received = []
+        self.lock = threading.Lock()
+        self.release = threading.Event()
+        self.release.set()
+        super().__init__(("127.0.0.1", 0), _SinkHandler)
+
+
+class _SinkHandler(BaseHTTPRequestHandler):
+    def do_POST(self):  # noqa: N802 (http.server contract)
+        self.server.release.wait(timeout=30)
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        with self.server.lock:
+            self.server.received.append((self.path, json.loads(body)))
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+@pytest.fixture
+def collector():
+    sink = _CollectorSink()
+    t = threading.Thread(target=sink.serve_forever, daemon=True)
+    t.start()
+    yield sink
+    sink.shutdown()
+    sink.server_close()
+
+
+def _wait(predicate, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_exporter_ships_both_kinds(schema, collector):
+    endpoint = f"http://127.0.0.1:{collector.server_address[1]}"
+    exporter = obs_export.Exporter(endpoint, queue_size=8)
+    exporter.export_trace("dead", _member_tree().span_dicts())
+    reg = obs_metrics.Registry()
+    reg.counter("c_total", "h").inc()
+    exporter.export_metrics(reg.to_dict())
+    assert _wait(lambda: len(collector.received) >= 2), \
+        "exporter never delivered"
+    exporter.close()
+    by_path = dict(collector.received)
+    assert set(by_path) == {"/v1/traces", "/v1/metrics"}
+    assert schema.validate_export(by_path["/v1/traces"]) == []
+    assert schema.validate_export(by_path["/v1/metrics"]) == []
+
+
+def test_exporter_drops_on_full_queue_without_blocking(collector):
+    endpoint = f"http://127.0.0.1:{collector.server_address[1]}"
+    dropped = obs_metrics.REGISTRY.counter(
+        "otlp_dropped_total", "").value(kind="traces")
+    collector.release.clear()  # wedge the collector
+    exporter = obs_export.Exporter(endpoint, queue_size=1, timeout_s=0.3)
+    rows = _member_tree().span_dicts()
+    t0 = time.monotonic()
+    for i in range(8):
+        exporter.export_trace(f"{i:016x}", rows)
+    enqueue_s = time.monotonic() - t0
+    assert enqueue_s < 1.0, "a wedged collector must not backpressure"
+    assert obs_metrics.REGISTRY.counter(
+        "otlp_dropped_total", "").value(kind="traces") > dropped
+    collector.release.set()
+    exporter.close()
+
+
+def test_maybe_exporter_off_by_default(monkeypatch):
+    monkeypatch.delenv(obs_export.ENV_ENDPOINT, raising=False)
+    assert obs_export.maybe_exporter() is None
+
+
+# ---------------------------------------------------------------------------
+# Member daemon ships its span tree (direct, no router)
+# ---------------------------------------------------------------------------
+
+def test_member_daemon_ships_span_tree(tmp_path, daemon_factory, schema):
+    """A daemon in fleet-member posture returns its request span tree in
+    the response meta; grafting those dicts reproduces the member's
+    phase totals byte-for-byte. A plain daemon ships nothing."""
+    repo = build_repo(tmp_path / "repo")
+    sock = str(tmp_path / "member.sock")
+    daemon_factory(sock, extra_env={"SEMMERGE_FLEET_MEMBER": "m9"},
+                   timeout=120)
+    conn = raw_conn(sock, timeout=300.0)
+    try:
+        send_merge(conn, str(repo), req_id=1, idem_key="ship-1",
+                   argv=["basebr", "brA", "brB",
+                         "--backend", "subprocess"])
+        resp = protocol.read_message(conn[1])
+    finally:
+        raw_close(conn)
+    assert resp.get("result", {}).get("exit_code") == 0, resp
+    meta = resp["result"]["meta"]
+    shipped = meta["spans"]
+    names = {r["name"] for r in shipped}
+    assert "service.execute" in names
+    assert any(r.get("layer") == "worker" for r in shipped), \
+        "subprocess-backend merge must carry worker-process spans"
+    for row in shipped:
+        assert not schema.validate_span(row, row["name"])
+    # The graft reproduces the member tree byte-for-byte.
+    rec = obs_spans.SpanRecorder(detailed=False)
+    rec.absorb_dicts(shipped, t_base=1.0, member="m9", attempt=1)
+    assert sorted((r["name"], r["seconds"]) for r in rec.span_dicts()) \
+        == sorted((r["name"], r["seconds"]) for r in shipped)
+
+
+def test_plain_daemon_ships_no_spans(tmp_path, service_daemon):
+    repo = build_repo(tmp_path / "repo")
+    conn = raw_conn(service_daemon, timeout=300.0)
+    try:
+        send_merge(conn, str(repo), req_id=1, idem_key="noship-1")
+        resp = protocol.read_message(conn[1])
+    finally:
+        raw_close(conn)
+    assert resp.get("result", {}).get("exit_code") == 0, resp
+    assert "spans" not in resp["result"]["meta"]
+
+
+# ---------------------------------------------------------------------------
+# Live fleet: stitched traces, federation, failover, hedging
+# ---------------------------------------------------------------------------
+
+def _read_artifact(trace_dir, trace_id, timeout=30.0):
+    path = pathlib.Path(trace_dir) / f"{trace_id}.json"
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if path.is_file():
+            return json.loads(path.read_text(encoding="utf-8"))
+        time.sleep(0.1)
+    raise AssertionError(f"no stitched artifact at {path}")
+
+
+def _cli(argv, env_extra, cwd, timeout=300):
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu"})
+    env.pop("SEMMERGE_FAULT", None)
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu", *argv],
+        capture_output=True, text=True, env=env, cwd=cwd, timeout=timeout)
+
+
+def test_fleet_stitched_trace_and_failover(tmp_path, schema):
+    """The tentpole, end to end: one merge through a 2-member fleet
+    leaves one stitched tree spanning router + member + subprocess
+    worker; the fleet surfaces (analyze --fleet, stats --fleet,
+    federated metrics) read it back; and a member SIGKILLed mid-request
+    still yields ONE tree carrying the failed attempt and the failover
+    retry."""
+    repo = build_repo(tmp_path / "repo")
+    trace_dir = tmp_path / "traces"
+    sock = str(tmp_path / "fleet.sock")
+    router = _spawn_router(
+        sock, members=2,
+        extra_env={"SEMMERGE_FLEET_HEDGE": "off",
+                   "SEMMERGE_FLEET_TRACE_DIR": str(trace_dir)})
+    try:
+        conn = raw_conn(sock, timeout=600.0)
+        try:
+            send_merge(conn, str(repo), req_id=1, idem_key="stitch-1",
+                       argv=["basebr", "brA", "brB",
+                             "--backend", "subprocess"])
+            resp = protocol.read_message(conn[1])
+        finally:
+            raw_close(conn)
+        assert resp.get("result", {}).get("exit_code") == 0, resp
+        trace_id = resp["result"]["meta"]["trace_id"]
+
+        artifact = _read_artifact(trace_dir, trace_id)
+        assert schema.validate_fleet_trace(artifact) == []
+        rows = artifact["spans"]
+        layers = {r.get("layer") for r in rows}
+        # Three processes in one tree: router / member daemon /
+        # subprocess worker.
+        assert {"fleet", "service", "worker"} <= layers
+        names = {r["name"] for r in rows}
+        assert {"fleet.wal_fsync", "fleet.route", "fleet.relay",
+                "service.execute"} <= names
+        owner = hashring.owner(hashring.repo_key(str(repo)),
+                               ["m0", "m1"])
+        relays = [r for r in rows if r["name"] == "fleet.relay"]
+        assert [r["meta"]["outcome"] for r in relays] == ["ok"]
+        assert relays[0]["meta"]["member"] == owner
+        for r in rows:
+            if r.get("layer") != "fleet":
+                assert r["meta"]["member"] == owner
+                assert r["meta"]["attempt"] == 1
+        assert len(list(trace_dir.glob("*.json"))) == 1
+
+        # Router-hop attribution through the CLI.
+        proc = _cli(["trace", "analyze", "--fleet", "--json",
+                     str(trace_dir / f"{trace_id}.json")], {},
+                    str(tmp_path))
+        assert proc.returncode == 0, proc.stderr
+        analysis = json.loads(proc.stdout)
+        assert set(analysis["buckets"]) == {
+            "route", "wal_fsync", "relay", "hedge_wait",
+            "member_execute"}
+        assert analysis["trace_id"] == trace_id
+        assert analysis["buckets"]["member_execute"] > 0
+        assert analysis["total_seconds"] >= \
+            analysis["buckets"]["member_execute"]
+
+        # Federated telemetry over the wire verb: every sample labeled
+        # by origin, rollup gauges present.
+        metrics = _control(sock, "metrics")
+        assert metrics["federated"] is True
+        text = metrics["prometheus"]
+        for member in ("router", "m0", "m1"):
+            assert f'member="{member}"' in text, member
+        assert "fleet_member_up" in text
+
+        # stats --fleet and serve --status --fleet aggregate through
+        # the router in one round-trip.
+        env = {"SEMMERGE_SERVICE_SOCKET": sock, "SEMMERGE_DAEMON": "off"}
+        proc = _cli(["stats", "--daemon", "--fleet", "--json"], env,
+                    str(tmp_path))
+        assert proc.returncode == 0, proc.stderr
+        agg = json.loads(proc.stdout)
+        assert agg["router"]["fleet"] is True
+        assert set(agg["members"]) == {"m0", "m1"}
+        assert all(isinstance(m, dict) and m.get("fleet_member") == mid
+                   for mid, m in agg["members"].items())
+        proc = _cli(["serve", "--status", "--fleet",
+                     "--socket", sock], env, str(tmp_path))
+        assert proc.returncode == 0, proc.stderr
+        assert set(json.loads(proc.stdout)["members"]) == {"m0", "m1"}
+
+        # Mid-request member SIGKILL: the hang fault holds the request
+        # inside the owner's execute window; killing the owner turns
+        # that leg into a transport failure and the failover retry
+        # lands on the peer — all inside ONE stitched tree.
+        status = _control(sock, "status")
+        pids = {m["id"]: m["pid"] for m in status["members"]}
+        conn = raw_conn(sock, timeout=600.0)
+        try:
+            send_merge(conn, str(repo), req_id=2, idem_key="kill-1",
+                       env={"SEMMERGE_FAULT": "service:execute:hang=2"})
+            time.sleep(0.8)
+            os.kill(pids[owner], signal.SIGKILL)
+            resp = protocol.read_message(conn[1])
+        finally:
+            raw_close(conn)
+        assert resp.get("result", {}).get("exit_code") == 0, resp
+        kill_tid = resp["result"]["meta"]["trace_id"]
+        assert kill_tid != trace_id
+        artifact = _read_artifact(trace_dir, kill_tid)
+        assert schema.validate_fleet_trace(artifact) == []
+        rows = artifact["spans"]
+        dead = [r for r in rows if r["name"] == "fleet.relay"
+                and r["meta"]["outcome"] == "transport"]
+        assert dead and dead[0]["meta"]["member"] == owner
+        assert dead[0]["meta"]["attempt"] == 1
+        assert any(r["name"] == "fleet.failover" and
+                   r["meta"].get("reason") == "transport" for r in rows)
+        other = "m1" if owner == "m0" else "m0"
+        winners = [r for r in rows if r["name"] == "fleet.relay"
+                   and r["meta"]["outcome"] == "ok"]
+        assert winners and winners[0]["meta"]["member"] == other
+        assert winners[0]["meta"]["attempt"] >= 2
+        grafted = [r for r in rows if r.get("layer") != "fleet"]
+        assert grafted
+        assert all(r["meta"]["member"] == other and
+                   r["meta"]["attempt"] >= 2 for r in grafted)
+        route = [r for r in rows if r["name"] == "fleet.route"]
+        assert route and route[0]["meta"]["attempt"] >= 2
+    finally:
+        _stop_router(router)
+
+
+def test_fleet_hedged_loser_annotated_in_stitched_trace(tmp_path,
+                                                        schema):
+    """The hedge pair in the stitched tree: the winner's ``fleet.hedge``
+    carries ``won=true/outcome=won``, the loser's ``won=false/
+    outcome=lost``, and the ``fleet.hedge_wait`` window is attributed
+    separately from the relay."""
+    repo = build_repo(tmp_path / "repo")
+    trace_dir = tmp_path / "traces"
+    sock = str(tmp_path / "fleet.sock")
+    router = _spawn_router(
+        sock, members=2,
+        extra_env={"SEMMERGE_FLEET_HEDGE_MS": "50",
+                   "SEMMERGE_SERVICE_WORKERS": "1",
+                   "SEMMERGE_SERVICE_DRAIN_TIMEOUT": "1",
+                   "SEMMERGE_FLEET_TRACE_DIR": str(trace_dir)})
+    wedge = None
+    try:
+        owner = hashring.owner(hashring.repo_key(str(repo)),
+                               ["m0", "m1"])
+        # Wedge the owner's single worker (--inplace never hedges).
+        wedge = raw_conn(sock, timeout=600.0)
+        send_merge(wedge, str(repo),
+                   env={"SEMMERGE_FAULT": "service:execute:hang=20"},
+                   argv=["basebr", "brA", "brB", "--inplace",
+                         "--backend", "host"],
+                   req_id=1, idem_key="wedge")
+        time.sleep(0.8)
+        conn = raw_conn(sock, timeout=600.0)
+        try:
+            send_merge(conn, str(repo), req_id=2, idem_key="hedged")
+            resp = protocol.read_message(conn[1])
+        finally:
+            raw_close(conn)
+        assert resp.get("result", {}).get("exit_code") == 0, resp
+        trace_id = resp["result"]["meta"]["trace_id"]
+        artifact = _read_artifact(trace_dir, trace_id)
+        assert schema.validate_fleet_trace(artifact) == []
+        rows = artifact["spans"]
+        hedges = {r["meta"]["member"]: r["meta"] for r in rows
+                  if r["name"] == "fleet.hedge"}
+        other = "m1" if owner == "m0" else "m0"
+        assert hedges[owner] == dict(hedges[owner], won=False,
+                                     outcome="lost")
+        assert hedges[other] == dict(hedges[other], won=True,
+                                     outcome="won")
+        assert any(r["name"] == "fleet.hedge_wait" for r in rows)
+        winners = [r for r in rows if r["name"] == "fleet.relay"
+                   and r["meta"]["outcome"] == "ok"]
+        assert winners and winners[0]["meta"]["member"] == other
+        grafted = [r for r in rows if r.get("layer") != "fleet"]
+        assert grafted
+        assert all(r["meta"]["member"] == other for r in grafted)
+    finally:
+        if wedge is not None:
+            raw_close(wedge)
+        _stop_router(router)

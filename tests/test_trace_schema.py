@@ -781,3 +781,163 @@ def test_fleet_reasons_and_shed_draining_documented(schema):
         ("reason",)
     assert tuple(schema.FLEET_WAL_KINDS) == \
         tuple(schema.FLEET_WAL_REQUIRED)
+    assert tuple(schema.FLEET_RELAY_OUTCOMES) == ("ok", "late",
+                                                  "transport")
+
+
+def _stitched_artifact():
+    """A real stitched tree: member-side recorder shipped as dicts and
+    grafted into a router-side recorder, the router.py code path."""
+    member = obs_spans.SpanRecorder(detailed=True)
+    with obs_spans.request_scope("ab12cd34ab12cd34", member):
+        with obs_spans.span("service.execute", layer="service"):
+            with obs_spans.span("worker.diff", layer="worker"):
+                pass
+    router = obs_spans.SpanRecorder(detailed=False)
+    obs_spans.record_into(router, "fleet.wal_fsync", 0.001, t_start=0.0,
+                          layer="fleet")
+    obs_spans.record_into(router, "fleet.relay", 0.4, t_start=0.001,
+                          layer="fleet", member="m0", attempt=1,
+                          outcome="ok")
+    obs_spans.record_into(router, "fleet.route", 0.5, t_start=0.001,
+                          layer="fleet", verb="semmerge", member="m0",
+                          attempt=1)
+    router.absorb_dicts(member.span_dicts(), t_base=0.05, member="m0",
+                        attempt=1)
+    return {"schema": 1, "kind": "fleet-trace",
+            "trace_id": "ab12cd34ab12cd34", "router_pid": 1234,
+            "socket": "/tmp/fleet.sock",
+            "spans": router.span_dicts()}
+
+
+def test_fleet_trace_validator(schema, tmp_path):
+    """The stitched-artifact tier: a grafted tree validates; trees
+    missing the graft meta (member/attempt on grafted spans), the
+    router spans, or the member spans are rejected; hedged-loser and
+    relay outcomes stay in the documented sets. The CLI subcommand
+    wires the same validator."""
+    data = _stitched_artifact()
+    assert schema.validate_fleet_trace(data) == []
+
+    broken = json.loads(json.dumps(data))
+    for s in broken["spans"]:
+        if s["name"] == "service.execute":
+            del s["meta"]["member"]
+    assert any("graft meta 'member'" in e
+               for e in schema.validate_fleet_trace(broken))
+
+    broken = json.loads(json.dumps(data))
+    for s in broken["spans"]:
+        if s["layer"] == "worker":
+            s["meta"]["attempt"] = 0
+    assert any("attempt" in e
+               for e in schema.validate_fleet_trace(broken))
+
+    broken = json.loads(json.dumps(data))
+    broken["spans"] = [s for s in broken["spans"]
+                       if s["layer"] == "fleet"]
+    assert any("no grafted member span" in e
+               for e in schema.validate_fleet_trace(broken))
+
+    broken = json.loads(json.dumps(data))
+    broken["spans"] = [s for s in broken["spans"]
+                       if s["layer"] != "fleet"]
+    assert any("no fleet." in e
+               for e in schema.validate_fleet_trace(broken))
+
+    broken = json.loads(json.dumps(data))
+    for s in broken["spans"]:
+        if s["name"] == "fleet.relay":
+            s["meta"]["outcome"] = "mystery"
+    assert any("mystery" in e for e in schema.validate_fleet_trace(broken))
+
+    # A hedged loser whose outcome contradicts ``won`` is drift.
+    broken = json.loads(json.dumps(data))
+    broken["spans"].append(dict(broken["spans"][0],
+                                name="fleet.hedge", layer="fleet",
+                                meta={"member": "m1", "won": False,
+                                      "outcome": "won"}))
+    assert any("contradicts" in e
+               for e in schema.validate_fleet_trace(broken))
+
+    assert schema.validate_fleet_trace([]) \
+        == ["fleet-trace: top level must be a JSON object"]
+    assert any("schema" in e
+               for e in schema.validate_fleet_trace({"schema": 7}))
+
+    good = tmp_path / "stitched.json"
+    good.write_text(json.dumps(data))
+    ok = subprocess.run([sys.executable, str(_SCRIPT),
+                         "validate_fleet_trace", str(good)],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    bad = tmp_path / "stitched-bad.json"
+    bad.write_text(json.dumps(broken))
+    fail = subprocess.run([sys.executable, str(_SCRIPT),
+                           "validate_fleet_trace", str(bad)],
+                          capture_output=True, text=True, timeout=60)
+    assert fail.returncode == 1
+    usage = subprocess.run([sys.executable, str(_SCRIPT),
+                            "validate_fleet_trace"],
+                           capture_output=True, text=True, timeout=60)
+    assert usage.returncode == 2
+
+
+def test_export_validator(schema, tmp_path):
+    """The OTLP tier: real ``obs.export`` payloads (traces and metrics)
+    validate; malformed ids, reversed timestamps, and kind-less metrics
+    are rejected. The CLI subcommand wires the same validator."""
+    from semantic_merge_tpu.obs import export as obs_export
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    data = _stitched_artifact()
+    traces = obs_export.spans_to_otlp(data["trace_id"], data["spans"])
+    assert schema.validate_export(traces) == []
+
+    reg = obs_metrics.Registry()
+    reg.counter("otlp_exported_total", "t").inc(kind="traces")
+    reg.histogram("service_request_seconds", "t",
+                  buckets=(0.1, 1.0)).observe(0.5, exemplar="abcd")
+    metrics = obs_export.metrics_to_otlp(reg.to_dict())
+    assert schema.validate_export(metrics) == []
+
+    broken = json.loads(json.dumps(traces))
+    broken["resourceSpans"][0]["scopeSpans"][0]["spans"][0]["traceId"] \
+        = "xyz"
+    assert any("traceId" in e for e in schema.validate_export(broken))
+
+    broken = json.loads(json.dumps(traces))
+    span = broken["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    span["endTimeUnixNano"] = str(int(span["startTimeUnixNano"]) - 1)
+    assert any("endTimeUnixNano" in e
+               for e in schema.validate_export(broken))
+
+    broken = json.loads(json.dumps(metrics))
+    m = broken["resourceMetrics"][0]["scopeMetrics"][0]["metrics"][0]
+    for kind in ("sum", "gauge", "histogram"):
+        m.pop(kind, None)
+    assert any("exactly one of" in e
+               for e in schema.validate_export(broken))
+
+    broken = json.loads(json.dumps(metrics))
+    for m in broken["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]:
+        hist = m.get("histogram")
+        if hist:
+            hist["dataPoints"][0]["bucketCounts"].append("0")
+    assert any("bucketCounts" in e
+               for e in schema.validate_export(broken))
+
+    assert schema.validate_export({}) \
+        == ["export: need resourceSpans or resourceMetrics"]
+
+    good = tmp_path / "otlp.json"
+    good.write_text(json.dumps(traces))
+    ok = subprocess.run([sys.executable, str(_SCRIPT),
+                         "validate_export", str(good)],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    bad = tmp_path / "otlp-bad.json"
+    bad.write_text("[]")
+    fail = subprocess.run([sys.executable, str(_SCRIPT),
+                           "validate_export", str(bad)],
+                          capture_output=True, text=True, timeout=60)
+    assert fail.returncode == 1

@@ -159,6 +159,25 @@ BREAKER_STATES = (0, 1, 2)  # closed / open / half-open
 #: Breaker transition targets (``breaker_transitions_total{to=…}``).
 BREAKER_TARGETS = ("closed", "open", "half-open")
 
+#: Label keys of the device-render / snapshot-residency metric series
+#: (ops/render.py, service/residency.py). Series of these names
+#: carrying other label sets are schema drift.
+RENDER_METRIC_LABELS = {
+    "snapshot_residency_hits_total": ("outcome",),
+    "snapshot_residency_evictions_total": ("reason",),
+}
+
+#: Documented residency lookup outcomes (service/residency.py):
+#: a validated hit, a cold miss, and the three invalidation classes
+#: (repo GC'd the tree, fleet-failover epoch bump, interner replaced).
+RESIDENCY_OUTCOMES = ("hit", "miss", "stale-tree", "stale-epoch",
+                      "stale-interner")
+
+#: Documented residency eviction reasons: LRU byte-budget pressure,
+#: the daemon's RSS hard watermark, an explicit clear, and lookup-time
+#: invalidation of a stale entry.
+RESIDENCY_EVICTION_REASONS = ("lru", "rss-hard", "clear", "stale")
+
 #: Required keys of a postmortem bundle (``obs/flight.py`` dump).
 POSTMORTEM_REQUIRED = ("schema", "trace_id", "reason", "ts", "spans",
                        "fault", "fault_chain", "breakers", "metrics", "env")
@@ -202,6 +221,8 @@ BENCH_NUMERIC_OPTIONAL = (
     "fleet_rehash_miss_rate", "fleet_hedge_win_rate",
     "fleet_trace_overhead_pct", "fleet_trace_dark_ms",
     "fleet_trace_on_ms",
+    "host_tail_cold_ms", "host_tail_resident_ms", "resident_merge_ms",
+    "residency_hit_rate", "residency_entries", "d2h_bytes",
 )
 
 #: Versions of the structured ``.semmerge-conflicts.json`` object form.
@@ -681,6 +702,94 @@ def validate_resilience(data: Any) -> List[str]:
             if not _is_num(s.get("value")) or s.get("value") < 0:
                 errors.append(f"metrics.gauges.service_rss_mb[{j}]: value "
                               f"must be a number >= 0")
+    return errors
+
+
+def validate_device_render(data: Any) -> List[str]:
+    """Validate the device-render / residency records of a trace or
+    events-shaped artifact (or a daemon status payload's ``metrics``
+    block): every ``render.d2h`` span carries the ``ops`` layer and
+    its transfer meta (``rows``/``width`` ints >= 0), every
+    ``residency.hit`` / ``residency.encode_delta`` span carries the
+    ``frontend`` layer and a non-empty ``repo`` meta, residency metric
+    series carry their documented label sets with documented
+    ``outcome``/``reason`` values, and ``snapshot_residency_bytes`` is
+    an unlabeled non-negative gauge."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["device_render: top level must be a JSON object"]
+    for i, row in enumerate(data.get("spans", [])):
+        if not isinstance(row, dict):
+            continue
+        name = row.get("name")
+        where = f"trace.spans[{i}]"
+        if name == "render.d2h":
+            if row.get("layer") != "ops":
+                errors.append(f"{where}: render.d2h span layer must be "
+                              f"'ops'")
+            meta = row.get("meta")
+            if not isinstance(meta, dict):
+                errors.append(f"{where}: render.d2h span needs meta")
+                continue
+            for key in ("rows", "width"):
+                v = meta.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    errors.append(f"{where}: render.d2h meta {key!r} must "
+                                  f"be an int >= 0")
+        elif name in ("residency.hit", "residency.encode_delta"):
+            if row.get("layer") != "frontend":
+                errors.append(f"{where}: {name} span layer must be "
+                              f"'frontend'")
+            meta = row.get("meta")
+            if not isinstance(meta, dict) \
+                    or not isinstance(meta.get("repo"), str) \
+                    or not meta.get("repo"):
+                errors.append(f"{where}: {name} span needs a non-empty "
+                              f"'repo' meta")
+    metrics = data.get("metrics", data)
+    if not isinstance(metrics, dict):
+        return errors
+    counters = metrics.get("counters", {})
+    if not isinstance(counters, dict):
+        counters = {}
+    for name, labels in RENDER_METRIC_LABELS.items():
+        m = counters.get(name)
+        if not isinstance(m, dict):
+            continue
+        for j, s in enumerate(m.get("series", [])):
+            got = tuple(sorted((s.get("labels") or {}).keys()))
+            if got != tuple(sorted(labels)):
+                errors.append(f"metrics.counters.{name}[{j}]: labels {got} "
+                              f"!= documented {tuple(sorted(labels))}")
+    hits = counters.get("snapshot_residency_hits_total")
+    if isinstance(hits, dict):
+        for j, s in enumerate(hits.get("series", [])):
+            outcome = (s.get("labels") or {}).get("outcome")
+            if outcome not in RESIDENCY_OUTCOMES:
+                errors.append(
+                    f"metrics.counters.snapshot_residency_hits_total[{j}]: "
+                    f"outcome {outcome!r} not in {RESIDENCY_OUTCOMES}")
+    evs = counters.get("snapshot_residency_evictions_total")
+    if isinstance(evs, dict):
+        for j, s in enumerate(evs.get("series", [])):
+            reason = (s.get("labels") or {}).get("reason")
+            if reason not in RESIDENCY_EVICTION_REASONS:
+                errors.append(
+                    f"metrics.counters."
+                    f"snapshot_residency_evictions_total[{j}]: reason "
+                    f"{reason!r} not in {RESIDENCY_EVICTION_REASONS}")
+    gauges = metrics.get("gauges", {})
+    if not isinstance(gauges, dict):
+        gauges = {}
+    res_bytes = gauges.get("snapshot_residency_bytes")
+    if isinstance(res_bytes, dict):
+        for j, s in enumerate(res_bytes.get("series", [])):
+            if (s.get("labels") or {}) != {}:
+                errors.append(f"metrics.gauges.snapshot_residency_bytes"
+                              f"[{j}]: must carry no labels")
+            if not _is_num(s.get("value")) or s.get("value") < 0:
+                errors.append(f"metrics.gauges.snapshot_residency_bytes"
+                              f"[{j}]: value must be a number >= 0")
     return errors
 
 
@@ -1356,7 +1465,7 @@ def validate_bench(data: Any) -> List[str]:
     for key in ("value", "vs_baseline"):
         if key in data and not _is_num(data[key]):
             errors.append(f"bench: {key} must be a number")
-    for key in ("phases_ms", "host_phases_ms"):
+    for key in ("phases_ms", "host_phases_ms", "phases_cold_ms"):
         block = data.get(key)
         if block is None:
             continue
@@ -1462,6 +1571,20 @@ def main(argv: List[str]) -> int:
                 with open(path, encoding="utf-8") as fh:
                     errors.extend(f"{path}: {e}" for e in
                                   validate_slo(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
+    if argv and argv[0] == "validate_device_render":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_device_render "
+                  "STATUS_OR_TRACE_JSON [...]", file=sys.stderr)
+            return 2
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_device_render(json.load(fh)))
             except (OSError, json.JSONDecodeError) as exc:
                 errors.append(f"{path}: unreadable ({exc})")
         return _finish(errors)

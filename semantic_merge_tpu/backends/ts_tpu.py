@@ -15,6 +15,8 @@ applies to both backends identically.
 """
 from __future__ import annotations
 
+import threading
+import time
 from collections import OrderedDict
 from functools import partial
 from typing import Dict, List
@@ -33,6 +35,30 @@ from .ts_host import ts_files
 from ..ops.diff import (KIND_ADD, KIND_DELETE, KIND_MOVE, KIND_RENAME,
                         DiffOpsTensor, diff_lift_device, diff_lift_device_pair)
 from .base import BuildAndDiffResult, register_backend, symbol_map
+
+
+#: Process-shared interner for warm-residency deployments. The daemon
+#: constructs a fresh backend per request (``get_backend`` is not
+#: memoized; backend instances hold unlocked per-merge caches that are
+#: unsafe to share across concurrent worker threads), but residency
+#: entries store tensors of *interned ids* — a lookup can only hit when
+#: the requesting backend speaks the same id space. So under
+#: ``SEMMERGE_RESIDENCY_CACHE`` every backend in the process adopts this
+#: one Interner (thread-safe by construction, see core/encode.py) and
+#: residency survives backend lifetimes. Replaced only by the growth
+#: guard (:meth:`TpuTSBackend._maybe_reset_interner`).
+_SHARED_INTERNER: Interner | None = None
+_SHARED_LOCK = threading.Lock()
+
+
+def _shared_interner() -> Interner:
+    global _SHARED_INTERNER
+    with _SHARED_LOCK:
+        if _SHARED_INTERNER is None:
+            it = Interner()
+            it.shared = True
+            _SHARED_INTERNER = it
+        return _SHARED_INTERNER
 
 
 class TpuTSBackend:
@@ -58,7 +84,15 @@ class TpuTSBackend:
         # Persistent across merges: encoded ids are stable for the
         # interner's lifetime, so per-file encoded columns cache in the
         # shared decl cache (keyed by scan identity + interner token).
-        self._interner = Interner()
+        # With warm residency on, adopt the process-shared interner so
+        # residency entries written by an earlier request's backend are
+        # still in this backend's id space (the daemon builds a fresh
+        # backend per request).
+        from ..service import residency
+        if residency.residency_enabled():
+            self._interner = _shared_interner()
+        else:
+            self._interner = Interner()
         self._fused = None
         # [engine] host_workers — host-tail pipeline width for the
         # fused path (None until configure(); the engine resolves the
@@ -152,7 +186,19 @@ class TpuTSBackend:
         *between* merges — never between the three snapshot scans of
         one merge, whose interned ids must share one id space."""
         if len(self._interner) > 4_000_000:
-            self._interner = Interner()
+            if self._interner.shared:
+                # Swap the process-shared instance so later backends
+                # adopt the replacement too; first resetter wins —
+                # concurrent callers adopt whatever is current.
+                global _SHARED_INTERNER
+                with _SHARED_LOCK:
+                    if _SHARED_INTERNER is self._interner:
+                        it = Interner()
+                        it.shared = True
+                        _SHARED_INTERNER = it
+                    self._interner = _SHARED_INTERNER
+            else:
+                self._interner = Interner()
             # Every snapshot-cache entry is keyed by the dead token and
             # can never hit again — drop them now, not by LRU attrition.
             self._snap_cache.clear()
@@ -185,6 +231,29 @@ class TpuTSBackend:
                     if hit is not None:
                         self._snap_cache.move_to_end(cident)
                         return hit[0], hit[1], cident
+        # Warm residency (service/residency.py): an annotated snapshot
+        # — the base tree of a repeat merge, keyed by (repo, tree_oid,
+        # scope) — may already be resident from an earlier request in
+        # this process. A hit hands back the encoded tensor AND the
+        # decl-cache identity, so the fused engine's device columns are
+        # reused too (scan, encode, and h2d all skipped); only the
+        # changed side of the merge pays residency.encode_delta below.
+        from ..service import residency
+        res_key = residency.resident_key(snapshot) \
+            if residency.residency_enabled() else None
+        if res_key is not None:
+            t0 = time.perf_counter()
+            rhit = residency.cache().lookup(res_key, token=tok)
+            if rhit is not None:
+                obs_spans.record("residency.hit",
+                                 time.perf_counter() - t0, layer="frontend",
+                                 t_start=t0, repo=res_key[0] or "synthetic")
+                self._snap_cache[rhit.identity] = (rhit.t, rhit.nodes)
+                while len(self._snap_cache) > 4:
+                    self._snap_cache.popitem(last=False)
+                _store_identity(snapshot, rhit.identity, fp)
+                return rhit.t, rhit.nodes, rhit.identity
+        t0 = time.perf_counter()
         keyed = scan_snapshot_keyed(ts_files(snapshot))
         identity = None
         keys = [k for k, _ in keyed]
@@ -200,8 +269,15 @@ class TpuTSBackend:
                 # side equal to base) get the object-level fast path
                 # too, not just the one that populated the cache.
                 _store_identity(snapshot, identity, fp)
+                if res_key is not None:
+                    residency.cache().put(res_key, hit[0], hit[1], identity)
                 return hit[0], hit[1], identity
         t, nodes = encode_decls_keyed(keyed, self._interner, global_cache())
+        if res_key is not None:
+            obs_spans.record("residency.encode_delta",
+                             time.perf_counter() - t0, layer="frontend",
+                             t_start=t0, repo=res_key[0] or "synthetic")
+            residency.cache().put(res_key, t, nodes, identity)
         if identity is not None:
             self._snap_cache[identity] = (t, nodes)
             while len(self._snap_cache) > 4:

@@ -453,6 +453,13 @@ def cmd_semdiff(args: argparse.Namespace) -> int:
                 scope = None
                 base_snap = snapshot_from_bytes(rev1_tar)
                 right_snap = snapshot_from_bytes(rev2_tar)
+            from .service import residency
+            if residency.residency_enabled():
+                from .frontend.snapshot import annotate_residency
+                from .runtime.git import tree_oid
+                from .utils import workdir
+                annotate_residency(base_snap, str(workdir.current()),
+                                   tree_oid(args.rev1), scope=scope)
         with tracer.phase("diff"):
             ops = backend.diff(base_snap, right_snap,
                                base_rev=resolve_rev(args.rev1),
@@ -665,6 +672,18 @@ def _semantic_attempt(args: argparse.Namespace, config, backend,
                 right_snap = snapshot_from_bytes(right_tar)
             if scope is not None:
                 tracer.count("scope_files", len(scope))
+            # The base tree repeats across merges of one repo (every
+            # feature branch merges against the same main) — key it
+            # into the warm residency cache so a daemon serving repeat
+            # requests skips scan+encode+h2d for it. Enabled-check
+            # first: one-shot runs skip the extra rev-parse.
+            from .service import residency
+            if residency.residency_enabled():
+                from .frontend.snapshot import annotate_residency
+                from .runtime.git import tree_oid
+                from .utils import workdir
+                annotate_residency(base_snap, str(workdir.current()),
+                                   tree_oid(args.base), scope=scope)
         base_rev = resolve_rev(args.base)
         seed = args.seed or config.core.deterministic_seed
         if seed == "auto":
@@ -1136,12 +1155,14 @@ def _stats_fleet(args: argparse.Namespace, service_client) -> int:
             print(f"member {member_id}: unreachable")
             continue
         decl_rate = st.get("declcache_hit_rate", 0.0) or 0.0
+        res_rate = (st.get("residency") or {}).get("hit_rate", 0.0) or 0.0
         print(f"member {member_id}: pid={st.get('pid')} "
               f"served={st.get('served_total', 0)} "
               f"queue_depth={st.get('queue_depth', 0)} "
               f"in_flight={st.get('in_flight', 0)} "
               f"rss_mb={st.get('rss_mb', 0.0):.1f} "
-              f"declcache_hit_rate={decl_rate:.3f}")
+              f"declcache_hit_rate={decl_rate:.3f} "
+              f"residency_hit_rate={res_rate:.3f}")
     return 0
 
 
@@ -1181,6 +1202,17 @@ def cmd_stats(args: argparse.Namespace) -> int:
               f"hits={decl.get('hits', 0)} misses={decl.get('misses', 0)} "
               f"evictions={decl.get('evictions', 0)} "
               f"entries={decl.get('entries', 0)}")
+        res = status.get("residency")
+        if res:
+            ev = res.get("evictions") or {}
+            print(f"residency: {'on' if res.get('enabled') else 'off'} "
+                  f"hit_rate={res.get('hit_rate', 0.0):.3f} "
+                  f"entries={res.get('entries', 0)} "
+                  f"bytes={res.get('bytes', 0)}/"
+                  f"{res.get('budget_bytes', 0)} "
+                  f"evictions={sum(ev.values())}"
+                  + ("".join(f" {k}={v}" for k, v in sorted(ev.items()))
+                     if ev else ""))
         print(f"memory: rss_mb={status.get('rss_mb', 0.0):.1f} "
               f"repos_tracked={status.get('repos_tracked', 0)}")
         port = status.get("metrics_port")

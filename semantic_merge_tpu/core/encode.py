@@ -20,6 +20,8 @@ VariableStatement's null name).
 """
 from __future__ import annotations
 
+import itertools
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Sequence
 
@@ -36,17 +38,27 @@ class Interner:
 
     ``token`` is a process-unique id for *this* interner instance —
     cache keys that embed encoded ids must include it, since ids are
-    only meaningful relative to one interner's history."""
+    only meaningful relative to one interner's history.
 
-    _next_token = 0
+    Interning is thread-safe: the hit path is a lock-free dict read
+    (GIL-atomic), a miss takes the instance lock with a re-check.
+    Published ids are always valid ``strings`` indices (the append
+    happens before the id becomes visible). ``shared = True`` marks a
+    process-shared instance (the warm-residency backend interner):
+    :meth:`object_table` then returns a defensive copy, because a view
+    handed to one thread is invalidated when another thread's later
+    call syncs new strings over the view's trailing ``None`` slot."""
+
+    _token_counter = itertools.count()
 
     def __init__(self) -> None:
         self._ids: Dict[str, int] = {}
         self.strings: List[str] = []
         self._obj: np.ndarray | None = None
         self._obj_n = 0
-        self.token = Interner._next_token
-        Interner._next_token += 1
+        self.token = next(Interner._token_counter)
+        self.shared = False
+        self._lock = threading.Lock()
 
     def object_table(self) -> np.ndarray:
         """Numpy object-array mirror ``[*strings, None]`` with amortized
@@ -60,20 +72,24 @@ class Interner:
         ``intern()`` may overwrite its trailing ``None`` slot (and
         later slots). Gather from it immediately; never hold it across
         interning. Writes through the view raise — callers that need a
-        mutable decode must copy."""
-        n = len(self.strings)
-        if self._obj is None or n + 1 > len(self._obj):
-            grown = np.empty((max(64, 2 * (n + 1)),), dtype=object)
-            grown[:n] = self.strings
-            self._obj = grown
-            self._obj_n = n
-        elif n > self._obj_n:
-            self._obj[self._obj_n:n] = self.strings[self._obj_n:n]
-            self._obj_n = n
-        self._obj[n] = None  # reset: growth may have written a string here
-        view = self._obj[:n + 1]
-        view.flags.writeable = False
-        return view
+        mutable decode must copy. A ``shared`` interner returns a copy
+        instead (another thread's call may re-sync under the view)."""
+        with self._lock:
+            n = len(self.strings)
+            if self._obj is None or n + 1 > len(self._obj):
+                grown = np.empty((max(64, 2 * (n + 1)),), dtype=object)
+                grown[:n] = self.strings
+                self._obj = grown
+                self._obj_n = n
+            elif n > self._obj_n:
+                self._obj[self._obj_n:n] = self.strings[self._obj_n:n]
+                self._obj_n = n
+            self._obj[n] = None  # reset: growth may have written here
+            view = self._obj[:n + 1]
+            if self.shared:
+                return view.copy()
+            view.flags.writeable = False
+            return view
 
     def intern(self, s: str | None) -> int:
         if s is None:
@@ -81,10 +97,16 @@ class Interner:
         got = self._ids.get(s)
         if got is not None:
             return got
-        new_id = len(self.strings)
-        self._ids[s] = new_id
-        self.strings.append(s)
-        return new_id
+        with self._lock:
+            got = self._ids.get(s)
+            if got is not None:
+                return got
+            new_id = len(self.strings)
+            # Append BEFORE publishing the id: any thread that can see
+            # the id can index ``strings`` with it.
+            self.strings.append(s)
+            self._ids[s] = new_id
+            return new_id
 
     def lookup(self, idx: int) -> str | None:
         if idx == NULL_ID:

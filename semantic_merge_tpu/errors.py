@@ -34,6 +34,8 @@ code  meaning
       ``SEMMERGE_MESH=require``
 19    ``FleetFault`` — the daemon fleet router could not route/serve a
       request under ``SEMMERGE_FLEET=require``
+20    ``RenderFault`` — device-side op-log rendering failed under
+      ``SEMMERGE_DEVICE_RENDER=require``
 ====  =============================================================
 
 Codes 10-17 are only ever *exit* codes in strict mode (or, for
@@ -166,6 +168,19 @@ class FleetFault(MergeFault):
     default_stage = "fleet"
 
 
+class RenderFault(MergeFault):
+    """Device-side op-log rendering (``ops/render.py``) failed — the
+    render program could not be built, the rendered bytes failed the
+    eligibility contract, or the posture could not be satisfied. Under
+    the default ``auto`` posture every render failure falls back to the
+    PR-2 host tail pipeline — byte-identical output — so this fault
+    only surfaces as an exit under ``SEMMERGE_DEVICE_RENDER=require``,
+    where device rendering is the contract."""
+
+    exit_code = 20
+    default_stage = "render"
+
+
 #: Fault class each pipeline stage wraps *unexpected* exceptions into.
 STAGE_FAULTS = {
     "snapshot": ParseFault,
@@ -206,6 +221,10 @@ STAGE_FAULTS = {
     # Conflict-resolution tier (resolve/): propose/verify classify as
     # ResolveFault so the CLI's containment (auto → conflict-as-result,
     # require → exit 17) sees one fault type for the whole tier.
+    # Device-side op-log rendering (ops/render.py): build/dispatch/d2h
+    # failures classify as RenderFault so the posture seam (auto →
+    # host-tail fallback, require → exit 20) sees one fault type.
+    "render": RenderFault,
     "resolve": ResolveFault,
     "resolver:propose": ResolveFault,
     "resolver:verify": ResolveFault,
@@ -221,7 +240,7 @@ STAGE_FAULTS = {
 EXIT_CODES = {cls.__name__: cls.exit_code for cls in
               (ParseFault, KernelFault, WorkerFault, ApplyFault,
                FormatFault, DeadlineFault, BatchFault, ResolveFault,
-               MeshFault, FleetFault)}
+               MeshFault, FleetFault, RenderFault)}
 
 
 def fault_for_stage(stage: str) -> type:

@@ -461,6 +461,15 @@ class FleetRouter:
                     1, reason=reason)
             obs_spans.record("fleet.failover", 0.0, layer="fleet",
                              reason=reason, member=member.id)
+            if moved:
+                # Keys moved owners: any resident encoded snapshot this
+                # process holds (co-located router+member deployments,
+                # in-process test fleets) may now belong to a repo it no
+                # longer serves authoritatively — invalidate them all
+                # (lazy stale-epoch eviction on next lookup) so rehashed
+                # owners re-encode from the repository of record.
+                from ..service import residency
+                residency.cache().bump_epoch()
             obs_flight.dump(
                 None, "fleet-failover",
                 extra={"fleet": {"member": member.id, "reason": reason,

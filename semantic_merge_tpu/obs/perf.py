@@ -67,6 +67,13 @@ GUARDED_FIELDS = {
     # budget rather than a (noise-floor) measurement, so the guard
     # trips exactly when the budget does.
     "fleet_trace_overhead_pct": "lower",
+    # Devtail preset (PR-18): the post-kernel host tail
+    # (compose_materialize + serialize, disjoint accounting) must not
+    # creep back up once the device-render path owns serialization, and
+    # the repeat-base leg's residency hit rate must stay warm — a cold
+    # cache means scan_encode+h2d are back on the critical path.
+    "host_tail_ms": "lower",
+    "residency_hit_rate": "higher",
 }
 
 

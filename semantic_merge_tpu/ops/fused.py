@@ -65,7 +65,7 @@ import numpy as np
 from ..core.conflict import Conflict, divergent_rename_conflict
 from ..core.encode import (NULL_ID, PAD_ID, DeclTensor, Interner,
                            bucket_size, pad_to, shard_ranges)
-from ..core.ops import Op
+from ..core.ops import Op, dumps_canonical
 from ..obs import device as obs_device
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
@@ -852,6 +852,10 @@ class FusedMergeEngine:
             self._decl_sharding = NamedSharding(mesh, P(None, AXIS))
             self._repl_sharding = NamedSharding(mesh, P())
         self.strings = DeviceStrings(interner, sharding=self._repl_sharding)
+        #: Device op-log renderer (ops/render.py), built lazily on the
+        #: first eligible merge — single-device only (the rendered
+        #: byte pool gathers are not mesh-sharded).
+        self._renderer = None
         self._decl_cache: "OrderedDict" = OrderedDict()
         # Per-snapshot node string tables for the native op-log
         # serializer, keyed by the same scan identity as _decl_cache.
@@ -1121,6 +1125,49 @@ class FusedMergeEngine:
             obs_spans.record("materialize", time.perf_counter() - t0,
                              layer="ops", t_start=t0)
             t0 = time.perf_counter()
+
+        # Device-side op-log rendering (ops/render.py): launch the
+        # render programs for both streams now — they gather over the
+        # decl tables already resident from _device_decl — so the
+        # caller's to_json_bytes costs one d2h copy + mask-concat
+        # instead of a host serialization pass. Async like the kernel
+        # dispatch; the detailed-mode fence keeps the phase split
+        # honest (otherwise render time would hide inside whatever
+        # phase first touches the payload).
+        from .render import render_posture
+        posture = render_posture()
+        if posture != "off":
+            if self.mesh is not None:
+                if posture == "require":
+                    from ..errors import RenderFault
+                    raise RenderFault(
+                        "device render is single-device only (mesh "
+                        "sharding active)", stage="render", cause="mesh")
+            else:
+                renderer = self._renderer
+                if renderer is None:
+                    from .render import DeviceRenderer
+                    renderer = self._renderer = DeviceRenderer(
+                        self.interner)
+                if renderer.eligible(max(n_l, n_r), posture=posture):
+                    t_r = time.perf_counter()
+                    require = posture == "require"
+                    prov_json = dumps_canonical(prov)
+                    ops_l.render = renderer.dispatch(
+                        kL[:n_l], aL[:n_l], bL[:n_l], wL[:n_l],
+                        dev_b, dev_l, base_t, left_t, prov_json,
+                        require=require)
+                    ops_r.render = renderer.dispatch(
+                        kR[:n_r], aR[:n_r], bR[:n_r], wR[:n_r],
+                        dev_b, dev_r, base_t, right_t, prov_json,
+                        require=require)
+                    if detailed:
+                        for h in (ops_l.render, ops_r.render):
+                            if h is not None:
+                                h.block_until_ready()
+                        obs_spans.record("render",
+                                         time.perf_counter() - t_r,
+                                         layer="ops", t_start=t_r)
 
         if split:
             # The mid buffer's device→host copy overlapped the head

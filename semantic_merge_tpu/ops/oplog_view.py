@@ -212,7 +212,7 @@ class OpStreamView(Sequence):
     __slots__ = ("kind", "a_slot", "b_slot", "words",
                  "base_nodes", "side_nodes", "prov",
                  "base_tbl_ref", "side_tbl_ref", "pipeline",
-                 "_ids", "_ops", "_all_done")
+                 "render", "_ids", "_ops", "_all_done")
 
     def __init__(self, kind: np.ndarray, a_slot: np.ndarray,
                  b_slot: np.ndarray, words: np.ndarray,
@@ -232,6 +232,9 @@ class OpStreamView(Sequence):
         self.base_tbl_ref = base_tbl_ref
         self.side_tbl_ref = side_tbl_ref
         self.pipeline = pipeline
+        # Optional ops.render.RenderedStream handle attached by the
+        # fused engine when the device rendered this stream's JSON.
+        self.render = None
         self._ids: Optional[List[str]] = None
         self._ops: Optional[List[Optional[Op]]] = None
         self._all_done = False
@@ -418,6 +421,15 @@ class OpStreamView(Sequence):
         n = len(self)
         if n == 0:
             return b"[]"
+        rh = self.render
+        if rh is not None:
+            # Device-rendered payload: one d2h copy + mask-concat
+            # (ops/render.py). A None return is the degradable-posture
+            # containment — fall through to the host serializers.
+            raw = rh.json_bytes()
+            if raw is not None:
+                return raw
+            self.render = None
         pipe = self.pipeline
         # Sharded serialization only buys time when shards can actually
         # run concurrently (multi-worker AND multi-core — the pipeline's
@@ -770,6 +782,58 @@ class ComposedOpView(Sequence):
 
     def __iter__(self):
         return iter(self.materialize())
+
+    # -- columnar serialization --------------------------------------------
+    def to_json(self) -> str:
+        return self.to_json_bytes().decode("utf-8")
+
+    def to_json_bytes(self) -> bytes:
+        """The composed op-log as canonical JSON bytes — identical to
+        ``dumps_canonical([op.to_dict() for op in self])``.
+
+        Device-rendered variant: when both source streams carry a
+        :class:`~semantic_merge_tpu.ops.render.RenderedStream` handle,
+        the composed payload splices the device-rendered row bytes in
+        composed ``(side, idx)`` order; only rows with chain overrides
+        (a changed address/file or an appended renameContext — the
+        :func:`_materialize_decoded` cases) materialize an ``Op`` and
+        re-serialize on the host. Everything else falls back to the
+        object path."""
+        if len(self) == 0:
+            return b"[]"
+        if self.supports_columns:
+            raw = self._rendered_bytes()
+            if raw is not None:
+                return raw
+        return dumps_canonical(
+            [op.to_dict() for op in self.materialize()]).encode("utf-8")
+
+    def _rendered_bytes(self) -> Optional[bytes]:
+        lh = getattr(self.left, "render", None)
+        rh = getattr(self.right, "render", None)
+        if lh is None or rh is None:
+            return None
+        lrows = lh.row_bytes()
+        rrows = rh.row_bytes()
+        if lrows is None or rrows is None:
+            return None
+        self._force_chains()
+        addr_s, file_s, name_s = self.addr_s, self.file_s, self.name_s
+        lkind, rkind = self.left.kind, self.right.kind
+        parts: List[bytes] = []
+        for i, (side, idx) in enumerate(zip(self.sides, self.idxs)):
+            na, nf, nn = addr_s[i], file_s[i], name_s[i]
+            left = side == 0
+            if na is None and nf is None and (
+                    nn is None
+                    or int((lkind if left else rkind)[idx]) == KIND_RENAME):
+                parts.append((lrows if left else rrows)[int(idx)])
+            else:
+                op = _materialize_decoded(
+                    (self.left if left else self.right)[int(idx)],
+                    na, nf, nn)
+                parts.append(dumps_canonical(op.to_dict()).encode("utf-8"))
+        return b"[" + b",".join(parts) + b"]"
 
 
 def _materialize_decoded(op: Op, new_addr: Optional[str],

@@ -1,0 +1,584 @@
+"""Device-side op-log rendering — the serialize phase as a gather.
+
+Rung-5 profiling (PR-17's ``BENCH_tpu_r5_rung5.json``) left the fused
+merge with a 102 ms kernel wrapped in a ~931 ms host tail, ~305 ms of
+which is op-log JSON serialization: even the vectorized row serializer
+(``oplog_view._json_rows``) and the native C renderer fundamentally
+walk ~46k rows on the host, formatting strings one row at a time.
+
+But an op-log row is not *text* the host has to compute — it is a
+fixed **segment program** over data the device already holds:
+
+- the row template literals (per kind, known at merge time once the
+  provenance JSON is fixed),
+- the snapshot field strings (symbolId/addressId/name/file), already
+  resident device-side as interner-id columns (the engine's decl
+  cache ships ``[4, bucket]`` int32 tables per snapshot),
+- the op id, a hex rendering of digest words the device *computed*.
+
+So this module renders the whole payload on device: every interned
+string's **escaped JSON body** lives in an append-only device blob
+(:class:`EscapedStrings`, the delta-shipped twin of
+``fused.DeviceStrings``); a jitted program expands each row's segment
+spec — literal / field / uuid — into per-byte source offsets over a
+byte pool ``tmpl ‖ escaped-bodies ‖ uuid36(words)`` and gathers them
+into a fixed-width ``uint8 [n, W]`` buffer. The host then does ONE
+d2h copy plus a mask-concat instead of ~46k Python row formats; byte
+parity with ``OpStreamView.to_json_bytes()`` (and therefore with
+``dumps_canonical([op.to_dict() ...])``, the reference surface) is
+fuzz-tested in ``tests/test_device_render.py``.
+
+Posture (``SEMMERGE_DEVICE_RENDER``, consistent with mesh/batch/
+fleet): ``off`` — never render; ``auto`` (default) — render eligible
+streams, fall back to the PR-2 host tail pipeline on any failure;
+``require`` — a render failure raises :class:`~semantic_merge_tpu.
+errors.RenderFault` (exit 20 strict) instead of degrading.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.encode import bucket_size
+from ..errors import RenderFault
+from ..obs import device as obs_device
+from ..obs import spans as obs_spans
+from .oplog_view import (_TMPL_ADD, _TMPL_DELETE, _TMPL_MOVE, _TMPL_RENAME,
+                         _esc_body)
+
+ENV_POSTURE = "SEMMERGE_DEVICE_RENDER"
+ENV_MIN_ROWS = "SEMMERGE_RENDER_MIN_ROWS"
+ENV_MAX_WIDTH = "SEMMERGE_RENDER_MAX_WIDTH"
+
+#: Below this row count the dispatch overhead outweighs the host
+#: serializer (auto posture only; ``require`` renders any n > 0).
+DEFAULT_MIN_ROWS = 4096
+#: Rows wider than this (one giant file path blows up W for the whole
+#: buffer) make the fixed-width buffer a memory hazard — fall back.
+DEFAULT_MAX_WIDTH = 4096
+
+#: Segment selector codes (static per-kind spec tables).
+_SEL_PAD, _SEL_LIT, _SEL_UUID = 0, 1, 2
+#: Field codes 3.. index the stacked per-row field-id gather:
+#: base sym/addr/name, side sym/addr/name, side file, base file.
+(_F_BSYM, _F_BADDR, _F_BNAME,
+ _F_SSYM, _F_SADDR, _F_SNAME, _F_SFILE, _F_BFILE) = range(3, 11)
+
+#: Per-kind field sequences, in template ``%s``-slot order (matching
+#: ``oplog_view._json_rows`` zip orders; the leading uuid slot is
+#: implicit). KIND_RENAME=0, MOVE=1, ADD=2, DELETE=3 — pinned by
+#: tests against ``ops/diff.py``.
+_KIND_FIELDS = (
+    (_F_BSYM, _F_BADDR, _F_BNAME, _F_SNAME, _F_SFILE,
+     _F_BADDR, _F_BNAME, _F_SNAME),                          # rename
+    (_F_BSYM, _F_BADDR, _F_BADDR, _F_SADDR, _F_BFILE, _F_SFILE,
+     _F_BADDR, _F_BADDR, _F_SADDR),                          # move
+    (_F_SSYM, _F_SADDR, _F_SFILE),                           # add
+    (_F_BSYM, _F_BADDR, _F_BFILE),                           # delete
+)
+_KIND_TMPLS = (_TMPL_RENAME, _TMPL_MOVE, _TMPL_ADD, _TMPL_DELETE)
+
+#: Max segments per row: ``len(fields)+2`` literals interleaved with
+#: the uuid segment and ``len(fields)`` field segments. Move: 21.
+_S = max(2 * len(f) + 3 for f in _KIND_FIELDS)
+
+#: Rows render in fixed chunks under ``lax.map`` so the [chunk, W]
+#: int32 offset intermediates stay ~16 MB instead of O(n*W).
+_CHUNK = 4096
+
+#: uuid36 byte positions of the 32 hex chars (dashes at 8/13/18/23).
+_HEXPOS = np.asarray([i for i in range(36) if i not in (8, 13, 18, 23)],
+                     np.int32)
+
+
+def render_posture() -> str:
+    """``off`` / ``auto`` / ``require`` from ``SEMMERGE_DEVICE_RENDER``
+    (unknown values → ``auto``, the degradable default — consistent
+    with the mesh/batch/fleet posture knobs)."""
+    raw = os.environ.get(ENV_POSTURE, "auto").strip().lower()
+    if raw in ("off", "0", "no", "false"):
+        return "off"
+    if raw in ("require", "required"):
+        return "require"
+    return "auto"
+
+
+def _min_rows() -> int:
+    try:
+        return int(os.environ.get(ENV_MIN_ROWS, DEFAULT_MIN_ROWS))
+    except ValueError:
+        return DEFAULT_MIN_ROWS
+
+
+def _max_width() -> int:
+    try:
+        return int(os.environ.get(ENV_MAX_WIDTH, DEFAULT_MAX_WIDTH))
+    except ValueError:
+        return DEFAULT_MAX_WIDTH
+
+
+class EscapedStrings:
+    """Device-resident escaped-JSON-body table for an interner.
+
+    One variable-length UTF-8 body per interned string — exactly the
+    bytes ``oplog_view._esc_body`` emits, so device-gathered field
+    segments concatenate into the same payload the host serializer
+    builds. Append-only like ``fused.DeviceStrings``: interner ids are
+    stable, so warm merges ship only the new strings' bodies (blob
+    delta) and offset/length rows; a capacity growth reships the full
+    table once at the new geometry.
+    """
+
+    def __init__(self, interner, sharding=None) -> None:
+        self.interner = interner
+        self.sharding = sharding
+        self.blob_cap = 4096
+        self.id_cap = 1024
+        self._blob = np.zeros(self.blob_cap, np.uint8)
+        self._offs = np.zeros(self.id_cap, np.int32)
+        self._lens = np.zeros(self.id_cap, np.int32)
+        self._n = 0          # ids escaped into the host arrays
+        self._blob_n = 0     # blob bytes used
+        self._dev = None     # (blob, offs, lens) device triple
+        self._n_dev = 0
+        self._blob_dev_n = 0
+
+    def _put(self, arr):
+        import jax
+        return (jax.device_put(arr, self.sharding)
+                if self.sharding is not None else jax.device_put(arr))
+
+    def lens_host(self) -> np.ndarray:
+        return self._lens
+
+    def _append_host(self, n: int) -> None:
+        strings = self.interner.strings
+        if n > self.id_cap:
+            cap = self.id_cap
+            while n > cap:
+                cap *= 2
+            offs = np.zeros(cap, np.int32)
+            lens = np.zeros(cap, np.int32)
+            offs[:self._n] = self._offs[:self._n]
+            lens[:self._n] = self._lens[:self._n]
+            self._offs, self._lens, self.id_cap = offs, lens, cap
+            self._dev = None
+        for i in range(self._n, n):
+            s = strings[i]
+            body = _esc_body(s).encode("utf-8") if isinstance(s, str) else b""
+            end = self._blob_n + len(body)
+            if end > self.blob_cap:
+                cap = self.blob_cap
+                while end > cap:
+                    cap *= 2
+                blob = np.zeros(cap, np.uint8)
+                blob[:self._blob_n] = self._blob[:self._blob_n]
+                self._blob, self.blob_cap = blob, cap
+                self._dev = None
+            if body:
+                self._blob[self._blob_n:end] = np.frombuffer(body, np.uint8)
+            self._offs[i] = self._blob_n
+            self._lens[i] = len(body)
+            self._blob_n = end
+        self._n = n
+
+    def sync(self):
+        """Bring the device triple up to date with the interner;
+        returns ``(blob, offs, lens)`` device arrays (rows beyond the
+        interned count are zeros, never gathered by valid ids)."""
+        import jax
+        import jax.numpy as jnp
+
+        n = len(self.interner.strings)
+        if n > self._n:
+            self._append_host(n)
+        if self._dev is None:
+            triple = (self._put(self._blob), self._put(self._offs),
+                      self._put(self._lens))
+            obs_device.record_transfer(
+                "h2d", self._blob.nbytes + self._offs.nbytes
+                + self._lens.nbytes)
+            self._dev, self._n_dev = triple, n
+            self._blob_dev_n = self._blob_n
+            return triple
+        if n > self._n_dev:
+            blob, offs, lens = self._dev
+            db = bucket_size(self._blob_n - self._blob_dev_n, minimum=64)
+            dn = bucket_size(n - self._n_dev, minimum=8)
+            if (self._blob_dev_n + db > self.blob_cap
+                    or self._n_dev + dn > self.id_cap):
+                return self._reship(n)
+            upd_b = self._blob[self._blob_dev_n:self._blob_dev_n + db]
+            upd_o = self._offs[self._n_dev:self._n_dev + dn]
+            upd_l = self._lens[self._n_dev:self._n_dev + dn]
+            blob = _dev_update1(blob, upd_b, np.int32(self._blob_dev_n))
+            offs = _dev_update1(offs, upd_o, np.int32(self._n_dev))
+            lens = _dev_update1(lens, upd_l, np.int32(self._n_dev))
+            obs_device.record_transfer(
+                "h2d", upd_b.nbytes + upd_o.nbytes + upd_l.nbytes)
+            self._dev = (blob, offs, lens)
+            self._n_dev, self._blob_dev_n = n, self._blob_n
+        return self._dev
+
+    def _reship(self, n: int):
+        triple = (self._put(self._blob), self._put(self._offs),
+                  self._put(self._lens))
+        obs_device.record_transfer(
+            "h2d", self._blob.nbytes + self._offs.nbytes + self._lens.nbytes)
+        self._dev, self._n_dev = triple, n
+        self._blob_dev_n = self._blob_n
+        return triple
+
+
+_dev_update1_jit = None
+
+
+def _dev_update1(buf, upd, start):
+    global _dev_update1_jit
+    if _dev_update1_jit is None:
+        import jax
+        _dev_update1_jit = jax.jit(
+            lambda b, u, s: jax.lax.dynamic_update_slice(b, u, (s,)))
+    return _dev_update1_jit(buf, upd, start)
+
+
+class _KindSpec:
+    """Per-provenance static render spec: the template blob plus the
+    ``[4, S]`` selector / literal-offset / literal-length tables the
+    device program gathers by kind."""
+
+    __slots__ = ("blob", "sel", "lit", "litlen", "lit_total")
+
+    def __init__(self, prov_json: str) -> None:
+        blob = bytearray()
+        sel = np.zeros((4, _S), np.int32)
+        lit = np.zeros((4, _S), np.int32)
+        litlen = np.zeros((4, _S), np.int32)
+        self.lit_total = np.zeros(4, np.int64)
+        for k, (tmpl, fields) in enumerate(zip(_KIND_TMPLS, _KIND_FIELDS)):
+            lits = tmpl.split("%s")
+            # slot 0 is the uuid; the remaining slots are the field
+            # sequence. The closing literal carries the provenance
+            # object, the row's closing brace, and the row separator.
+            lits[-1] = lits[-1] + prov_json + "}" + ","
+            segs: List[Tuple[int, int, int]] = []
+            for si, text in enumerate(lits):
+                enc = text.encode("utf-8")
+                segs.append((_SEL_LIT, len(blob), len(enc)))
+                blob.extend(enc)
+                self.lit_total[k] += len(enc)
+                if si == 0:
+                    segs.append((_SEL_UUID, 0, 36))
+                elif si <= len(fields):
+                    segs.append((fields[si - 1], 0, 0))
+            for si, (s, o, ln) in enumerate(segs):
+                sel[k, si], lit[k, si], litlen[k, si] = s, o, ln
+        # Bucket the blob so the jit signature (tmpl_cap feeds the
+        # pool base offsets) is stable across provenance values.
+        cap = int(bucket_size(max(len(blob), 1), minimum=256))
+        padded = np.zeros(cap, np.uint8)
+        padded[:len(blob)] = np.frombuffer(bytes(blob), np.uint8)
+        self.blob = padded
+        self.sel, self.lit, self.litlen = sel, lit, litlen
+
+
+def _uuid36_dev(words):
+    """Digest words int32 [n, 4] → uuid-shaped ASCII uint8 [n, 36]:
+    the device twin of ``oplog_view.format_ids`` (big-endian hex per
+    uint32 word, dashes at byte positions 8/13/18/23)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    u = lax.bitcast_convert_type(words, jnp.uint32)
+    shifts = jnp.asarray([24, 16, 8, 0], jnp.uint32)
+    byts = (u[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xFF)
+    byts = byts.reshape(words.shape[0], 16)
+    nib = jnp.stack([byts >> 4, byts & jnp.uint32(0xF)],
+                    axis=-1).reshape(words.shape[0], 32)
+    ascii_ = (nib + 48 + jnp.where(nib > 9, 39, 0)).astype(jnp.uint8)
+    out = jnp.full((words.shape[0], 36), np.uint8(ord("-")), jnp.uint8)
+    return out.at[:, jnp.asarray(_HEXPOS)].set(ascii_)
+
+
+def _render_program(kind, a_slot, b_slot, words, bcols, scols,
+                    sel_tab, lit_tab, litlen_tab,
+                    esc_blob, esc_offs, esc_lens, tmpl_blob, *, W: int):
+    """The jitted render body: expand each row's segment spec into
+    per-byte pool offsets and gather. Pool layout: template literals ‖
+    escaped string bodies ‖ uuid36 bytes (36 per row)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = kind.shape[0]
+    tmpl_cap = tmpl_blob.shape[0]
+    esc_cap = esc_blob.shape[0]
+    uuid_base = tmpl_cap + esc_cap
+
+    uuid = _uuid36_dev(words)
+    pool = jnp.concatenate([tmpl_blob, esc_blob, uuid.reshape(-1)])
+    pool_max = pool.shape[0] - 1
+
+    kind_c = jnp.clip(kind, 0, 3)
+    a = jnp.clip(a_slot, 0, bcols.shape[1] - 1)
+    b = jnp.clip(b_slot, 0, scols.shape[1] - 1)
+    max_id = esc_offs.shape[0] - 1
+    # Stacked per-row field ids, in _F_* code order (codes 3..10).
+    field_ids = jnp.stack(
+        [bcols[0][a], bcols[1][a], bcols[2][a],
+         scols[0][b], scols[1][b], scols[2][b],
+         scols[3][b], bcols[3][a]], axis=1)
+    field_ids = jnp.clip(field_ids, 0, max_id)
+
+    sel = sel_tab[kind_c]          # [n, S]
+    lit = lit_tab[kind_c]
+    litlen = litlen_tab[kind_c]
+    fid = jnp.take_along_axis(field_ids, jnp.clip(sel - 3, 0, 7), axis=1)
+    f_off = esc_offs[fid] + jnp.int32(tmpl_cap)
+    f_len = esc_lens[fid]
+    row36 = (jnp.arange(n, dtype=jnp.int32) * 36 + jnp.int32(uuid_base))
+    seg_off = jnp.where(sel == _SEL_LIT, lit,
+                        jnp.where(sel == _SEL_UUID, row36[:, None], f_off))
+    seg_len = jnp.where(sel == _SEL_LIT, litlen,
+                        jnp.where(sel == _SEL_UUID, 36,
+                                  jnp.where(sel >= 3, f_len, 0)))
+
+    def chunk_body(args):
+        c_off, c_len = args
+        ends = jnp.cumsum(c_len, axis=1)
+        starts = ends - c_len
+        total = ends[:, -1]
+        j = jnp.arange(W, dtype=jnp.int32)
+        k = jax.vmap(lambda e: jnp.searchsorted(e, j, side="right"))(ends)
+        k = jnp.clip(k, 0, _S - 1)
+        src = (jnp.take_along_axis(c_off, k, axis=1)
+               + (j[None, :] - jnp.take_along_axis(starts, k, axis=1)))
+        valid = j[None, :] < total[:, None]
+        return jnp.where(valid, pool[jnp.clip(src, 0, pool_max)],
+                         jnp.uint8(0))
+
+    if n <= _CHUNK:
+        return chunk_body((seg_off, seg_len))
+    nc = n // _CHUNK  # callers pad n to a _CHUNK multiple past _CHUNK
+    buf = jax.lax.map(chunk_body,
+                      (seg_off.reshape(nc, _CHUNK, _S),
+                       seg_len.reshape(nc, _CHUNK, _S)))
+    return buf.reshape(n, W)
+
+
+class RenderedStream:
+    """Handle to one stream's in-flight device render: the device
+    buffer plus the host-side row lengths. ``json_bytes()`` performs
+    the ONE d2h copy (recorded as the ``render.d2h`` span) and the
+    mask-concat; per-row byte access backs the composed view's
+    device-rendered serialization."""
+
+    __slots__ = ("_buf_dev", "lens", "n", "W", "require", "_buf", "_rows")
+
+    def __init__(self, buf_dev, lens: np.ndarray, n: int, W: int,
+                 require: bool) -> None:
+        self._buf_dev = buf_dev
+        self.lens = lens
+        self.n = n
+        self.W = W
+        self.require = require
+        self._buf: Optional[np.ndarray] = None
+        self._rows: Optional[List[bytes]] = None
+
+    def block_until_ready(self) -> None:
+        self._buf_dev.block_until_ready()
+
+    def _fetch(self) -> np.ndarray:
+        if self._buf is None:
+            with obs_spans.span("render.d2h", layer="ops",
+                                rows=self.n, width=self.W):
+                buf = np.asarray(self._buf_dev)
+                obs_device.record_transfer("d2h", buf.nbytes)
+            self._buf_dev = None
+            self._buf = buf
+        return self._buf
+
+    def json_bytes(self) -> Optional[bytes]:
+        """The full ``[...]`` payload, or ``None`` when the fetch
+        fails under the degradable posture (``require`` re-raises as
+        :class:`RenderFault`)."""
+        try:
+            buf = self._fetch()
+            mask = np.arange(self.W) < self.lens[:, None]
+            flat = buf[:self.n][mask].tobytes()
+            # Every row's closing literal carries the separator comma;
+            # drop the trailing one and bracket.
+            return b"[" + flat[:-1] + b"]"
+        except RenderFault:
+            raise
+        except Exception as exc:  # noqa: BLE001 — posture seam
+            if self.require:
+                raise RenderFault(str(exc), stage="render",
+                                  cause=type(exc).__name__) from exc
+            return None
+
+    def row_bytes(self) -> Optional[List[bytes]]:
+        """Per-row JSON bytes *without* the trailing separator comma —
+        the composed view splices these by ``(side, idx)``. Same
+        containment contract as :meth:`json_bytes`."""
+        if self._rows is not None:
+            return self._rows
+        try:
+            buf = self._fetch()
+            lens = self.lens
+            self._rows = [buf[i, :lens[i] - 1].tobytes()
+                          for i in range(self.n)]
+            return self._rows
+        except RenderFault:
+            raise
+        except Exception as exc:  # noqa: BLE001
+            if self.require:
+                raise RenderFault(str(exc), stage="render",
+                                  cause=type(exc).__name__) from exc
+            return None
+
+
+class DeviceRenderer:
+    """Per-engine render dispatcher: owns the :class:`EscapedStrings`
+    table, the per-provenance :class:`_KindSpec` cache, and the jitted
+    render program's bucket ladder."""
+
+    def __init__(self, interner, sharding=None) -> None:
+        self.interner = interner
+        self.esc = EscapedStrings(interner, sharding)
+        self._spec_cache: Dict[str, _KindSpec] = {}
+        self._jit = None
+
+    def eligible(self, n: int, *, posture: Optional[str] = None) -> bool:
+        posture = posture or render_posture()
+        if posture == "off" or n <= 0:
+            return False
+        if posture == "require":
+            return True
+        return n >= _min_rows()
+
+    def _spec(self, prov_json: str) -> _KindSpec:
+        spec = self._spec_cache.get(prov_json)
+        if spec is None:
+            spec = self._spec_cache[prov_json] = _KindSpec(prov_json)
+            if len(self._spec_cache) > 8:
+                self._spec_cache.pop(next(iter(self._spec_cache)))
+        return spec
+
+    def _program(self):
+        if self._jit is None:
+            import jax
+            self._jit = jax.jit(_render_program,
+                                static_argnames=("W",))
+        return self._jit
+
+    def _row_lens(self, spec: _KindSpec, kind, a_slot, b_slot,
+                  bcols_host, scols_host) -> np.ndarray:
+        """Host-side per-row byte lengths (independent of the device
+        program, which recomputes them from the same inputs): literal
+        total + 36 (uuid) + the kind's field-body lengths."""
+        lens_tab = self.esc.lens_host()
+        kc = np.clip(kind, 0, 3).astype(np.int64)
+        a = np.clip(a_slot, 0, len(bcols_host[0]) - 1)
+        b = np.clip(b_slot, 0, len(scols_host[0]) - 1)
+        max_id = len(lens_tab) - 1
+
+        def flen(cols, col, slot):
+            ids = np.clip(np.asarray(cols[col])[slot], 0, max_id)
+            return lens_tab[ids].astype(np.int64)
+
+        bsym = flen(bcols_host, 0, a)
+        baddr = flen(bcols_host, 1, a)
+        bname = flen(bcols_host, 2, a)
+        bfile = flen(bcols_host, 3, a)
+        ssym = flen(scols_host, 0, b)
+        saddr = flen(scols_host, 1, b)
+        sname = flen(scols_host, 2, b)
+        sfile = flen(scols_host, 3, b)
+        per_kind = np.stack([
+            bsym + 2 * baddr + 2 * bname + 2 * sname + sfile,   # rename
+            bsym + 4 * baddr + 2 * saddr + bfile + sfile,       # move
+            ssym + saddr + sfile,                               # add
+            bsym + baddr + bfile,                               # delete
+        ])
+        rows = np.arange(len(kind))
+        return (spec.lit_total[kc] + 36 + per_kind[kc, rows]).astype(np.int64)
+
+    def dispatch(self, kind: np.ndarray, a_slot: np.ndarray,
+                 b_slot: np.ndarray, words: np.ndarray,
+                 bcols_dev, scols_dev, base_t, side_t,
+                 prov_json: str, *, require: bool
+                 ) -> Optional[RenderedStream]:
+        """Launch one stream's render (async). ``bcols_dev``/
+        ``scols_dev`` are the engine's cached ``[4, bucket]`` device
+        decl tables; ``base_t``/``side_t`` the matching host
+        :class:`DeclTensor`\\ s (the length pass reads their columns).
+        Returns ``None`` when ineligible/contained (auto posture);
+        raises :class:`RenderFault` under ``require``."""
+        try:
+            return self._dispatch(kind, a_slot, b_slot, words, bcols_dev,
+                                  scols_dev, base_t, side_t, prov_json,
+                                  require=require)
+        except RenderFault:
+            raise
+        except Exception as exc:  # noqa: BLE001 — posture seam
+            if require:
+                raise RenderFault(str(exc), stage="render",
+                                  cause=type(exc).__name__) from exc
+            return None
+
+    def _dispatch(self, kind, a_slot, b_slot, words, bcols_dev, scols_dev,
+                  base_t, side_t, prov_json, *, require: bool
+                  ) -> Optional[RenderedStream]:
+        import jax
+        import jax.numpy as jnp
+
+        n = int(kind.shape[0])
+        if n == 0:
+            return None
+        esc_blob, esc_offs, esc_lens = self.esc.sync()
+        spec = self._spec(prov_json)
+        bcols_host = (base_t.sym, base_t.addr, base_t.name, base_t.file)
+        scols_host = (side_t.sym, side_t.addr, side_t.name, side_t.file)
+        lens = self._row_lens(spec, kind, a_slot, b_slot,
+                              bcols_host, scols_host)
+        W = int(bucket_size(int(lens.max()), minimum=64))
+        if W > _max_width():
+            if require:
+                raise RenderFault(
+                    f"row width {W} exceeds {ENV_MAX_WIDTH}"
+                    f"={_max_width()}", stage="render", cause="width")
+            return None
+        n_pad = int(bucket_size(n, minimum=64))
+        if n_pad > _CHUNK:
+            # lax.map chunking needs a _CHUNK multiple; the ladder's
+            # 3·2^(k-1) half-steps aren't all multiples, so round up
+            # (still O(log n) compiled shapes).
+            n_pad = ((n_pad + _CHUNK - 1) // _CHUNK) * _CHUNK
+        null = np.int32(-1)
+
+        def pad(col, fill):
+            out = np.full(n_pad, fill, np.int32)
+            out[:n] = col
+            return out
+
+        kind_p = pad(kind, 3)  # pad rows render as (masked) deletes
+        a_p = pad(a_slot, null)
+        b_p = pad(b_slot, null)
+        w_p = np.zeros((n_pad, 4), np.int32)
+        w_p[:n] = words
+        obs_device.record_transfer(
+            "h2d", kind_p.nbytes + a_p.nbytes + b_p.nbytes + w_p.nbytes
+            + spec.blob.nbytes + 3 * spec.sel.nbytes)
+        buf = self._program()(
+            jnp.asarray(kind_p), jnp.asarray(a_p), jnp.asarray(b_p),
+            jnp.asarray(w_p), bcols_dev, scols_dev,
+            jnp.asarray(spec.sel), jnp.asarray(spec.lit),
+            jnp.asarray(spec.litlen),
+            esc_blob, esc_offs, esc_lens, jnp.asarray(spec.blob), W=W)
+        try:
+            buf.copy_to_host_async()
+        except AttributeError:
+            pass
+        return RenderedStream(buf, lens[:n], n, W, require)

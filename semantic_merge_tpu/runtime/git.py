@@ -37,6 +37,13 @@ def resolve_rev(rev: str, cwd: pathlib.Path | None = None) -> str:
     return run_git(["rev-parse", rev], cwd=cwd)
 
 
+def tree_oid(rev: str, cwd: pathlib.Path | None = None) -> str:
+    """The tree object id a revision points at — the content address
+    the warm residency cache (``service/residency.py``) keys encoded
+    base snapshots under."""
+    return run_git(["rev-parse", rev + "^{tree}"], cwd=cwd)
+
+
 def commit_timestamp_iso(rev: str, cwd: pathlib.Path | None = None) -> str:
     """The commit's committer time as a UTC ISO-8601 string — the
     deterministic replacement for the reference's wall-clock provenance

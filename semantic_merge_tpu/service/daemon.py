@@ -56,6 +56,7 @@ from ..utils import faults, reqenv, workdir
 from ..utils.loggingx import logger
 from ..utils.procs import env_seconds
 from . import protocol, resilience, telemetry
+from . import residency as residency_mod
 
 _OUTCOME_BY_EXIT = {0: "ok", 1: "conflicts", 2: "typecheck", 3: "git-error"}
 
@@ -841,6 +842,9 @@ class Daemon:
                 cache = global_cache()
                 if cache is not None:
                     cache.clear()
+                # Resident encoded snapshots are the other large host
+                # allocation this process owns outright — drop them too.
+                residency_mod.cache().clear(reason="rss-hard")
 
     def _slo_monitor(self) -> None:
         """Evaluate the SLO engine on a fixed cadence
@@ -1001,6 +1005,7 @@ class Daemon:
             "declcache": decl,
             "declcache_hit_rate": (hits / lookups) if lookups else 0.0,
             "batch": scheduler.stats() if scheduler is not None else None,
+            "residency": residency_mod.cache().stats(),
             "slo": self._slo.status() if self._slo is not None else None,
             "resilience": {
                 "pressure": self._pressure,

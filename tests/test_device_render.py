@@ -1,0 +1,237 @@
+"""Device-side op-log rendering parity (``ops/render.py``).
+
+The device renderer assembles the serialized op-log JSON as fixed-width
+byte tensors on the accelerator; the host does one d2h copy and a
+concat. These tests pin the contract that makes the posture safe to
+flip: the payload bytes are IDENTICAL to the PR-2 host tail pipeline —
+per-side op logs and the composed stream, across conflicts, rename
+chains, CRDT/statement ops, both fetch modes, co-batched dispatch, and
+adversarial string content — and every render failure under ``auto``
+falls back to the host pipeline silently, while ``require`` surfaces a
+typed ``RenderFault`` (exit 20).
+"""
+from __future__ import annotations
+
+import pytest
+
+import bench
+from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+from semantic_merge_tpu.core.ops import OpLog
+from semantic_merge_tpu.errors import RenderFault
+from semantic_merge_tpu.frontend.snapshot import Snapshot
+
+TS = "2026-01-01T00:00:00Z"
+
+
+def snap(files):
+    return Snapshot(files=[{"path": p, "content": c} for p, c in files])
+
+
+def merge_payloads(base, left, right, **kw):
+    """Byte-comparable form of everything the render path can touch:
+    both op-log payloads, the composed payload, the composed dicts
+    (materialization parity, not just serialization), conflicts."""
+    backend = TpuTSBackend(mesh=False)
+    res, composed, conflicts = backend.merge(
+        base, left, right, base_rev="bench", seed="bench", timestamp=TS,
+        **kw)
+    composed_bytes = composed.to_json_bytes() \
+        if hasattr(composed, "to_json_bytes") else None
+    return (
+        OpLog(res.op_log_left).to_json_bytes(),
+        OpLog(res.op_log_right).to_json_bytes(),
+        composed_bytes,
+        [op.to_dict() for op in composed],
+        [c.to_dict() for c in conflicts],
+    )
+
+
+def render_on(monkeypatch, posture="require"):
+    monkeypatch.setenv("SEMMERGE_DEVICE_RENDER", posture)
+    monkeypatch.setenv("SEMMERGE_RENDER_MIN_ROWS", "0")
+    monkeypatch.setenv("SEMMERGE_MESH", "off")
+
+
+def render_off(monkeypatch):
+    monkeypatch.setenv("SEMMERGE_DEVICE_RENDER", "off")
+    monkeypatch.setenv("SEMMERGE_MESH", "off")
+
+
+def _nasty_workload():
+    """Every JSON-escaping hazard the renderer's escaped string table
+    must reproduce: quotes, backslashes, control chars, non-ASCII
+    (multi-byte UTF-8), and long names straddling segment widths."""
+    base, left, right = [], [], []
+    specials = ['q"uote', "back\\slash", "tab\there", "nl\nline",
+                "bell\x07", "emojié€", "del\x7f",
+                "x" * 300]
+    for i, s in enumerate(specials):
+        path = f"src/ü{i}.ts"
+        safe = f"fn{i}"
+        content = f"export function {safe}(x: number): number " \
+                  f"{{ return {i}; }}\n"
+        base.append((path, content))
+        # Rename into an adversarial name on the left; move on the
+        # right — both sides' string tables carry the hazards.
+        left.append((path, content.replace(f"{safe}(", f"n{i}_{s}(")))
+        right.append((f"lib/é{i}.ts", content))
+    return snap(base), snap(left), snap(right)
+
+
+@pytest.mark.parametrize("split", ["0", "1"], ids=["onebuf", "split"])
+@pytest.mark.parametrize("workload", ["clean", "divergent", "nasty"])
+def test_render_byte_parity(monkeypatch, workload, split):
+    monkeypatch.setenv("SEMMERGE_SPLIT_FETCH", split)
+    if workload == "nasty":
+        snaps = _nasty_workload()
+    else:
+        snaps = bench.synth_repo(60, 4, divergent=workload == "divergent")
+    render_off(monkeypatch)
+    want = merge_payloads(*snaps)
+    if workload == "divergent":
+        assert want[4], "divergent workload must produce conflicts"
+    render_on(monkeypatch, "require")
+    got = merge_payloads(*snaps)
+    assert got == want
+
+
+def test_render_statement_ops_parity(monkeypatch):
+    # Statement-level ops ride the CRDT materialization path; their
+    # reordered/composed streams must serialize identically whether the
+    # per-side payloads came from the device renderer or the host.
+    snaps = bench.synth_repo(40, 4, divergent=True)
+    render_off(monkeypatch)
+    want = merge_payloads(*snaps, statement_ops=True)
+    render_on(monkeypatch, "require")
+    got = merge_payloads(*snaps, statement_ops=True)
+    assert got == want
+
+
+def test_render_sides_swapped_parity(monkeypatch):
+    # Convergence probe: swapping the sides reorders every composed
+    # decision; the rendered payloads must track the host pipeline in
+    # both orientations independently.
+    base, left, right = bench.synth_repo(40, 4, divergent=True)
+    for sides in ((left, right), (right, left)):
+        render_off(monkeypatch)
+        want = merge_payloads(base, *sides)
+        render_on(monkeypatch, "require")
+        assert merge_payloads(base, *sides) == want
+
+
+def test_render_empty_stream(monkeypatch):
+    base, _, _ = bench.synth_repo(6, 2)
+    render_on(monkeypatch, "require")
+    left_json, right_json, composed_bytes, composed, conflicts = \
+        merge_payloads(base, base, base)
+    assert left_json == b"[]" and right_json == b"[]"
+    assert composed == [] and conflicts == []
+    if composed_bytes is not None:
+        assert composed_bytes == b"[]"
+
+
+def test_render_auto_falls_back_on_width_guard(monkeypatch):
+    # A 1-byte width cap makes every render ineligible mid-dispatch;
+    # auto posture must silently serve the host-pipeline bytes.
+    snaps = bench.synth_repo(20, 3, divergent=True)
+    render_off(monkeypatch)
+    want = merge_payloads(*snaps)
+    render_on(monkeypatch, "auto")
+    monkeypatch.setenv("SEMMERGE_RENDER_MAX_WIDTH", "1")
+    assert merge_payloads(*snaps) == want
+
+
+def test_render_require_width_guard_raises(monkeypatch):
+    snaps = bench.synth_repo(20, 3)
+    render_on(monkeypatch, "require")
+    monkeypatch.setenv("SEMMERGE_RENDER_MAX_WIDTH", "1")
+    with pytest.raises(RenderFault) as err:
+        merge_payloads(*snaps)
+    assert err.value.exit_code == 20
+    assert err.value.stage == "render"
+
+
+def test_render_min_rows_gates_auto(monkeypatch):
+    # Under auto, streams below the row floor skip the renderer — the
+    # handle must be absent, the payloads still correct.
+    snaps = bench.synth_repo(6, 2, divergent=True)
+    render_off(monkeypatch)
+    want = merge_payloads(*snaps)
+    monkeypatch.setenv("SEMMERGE_DEVICE_RENDER", "auto")
+    monkeypatch.setenv("SEMMERGE_RENDER_MIN_ROWS", "1000000")
+    monkeypatch.setenv("SEMMERGE_MESH", "off")
+    assert merge_payloads(*snaps) == want
+
+
+def test_render_cobatched_dispatch_parity(monkeypatch):
+    # Co-batched requests take the packed multi-merge program, which
+    # does not attach render handles; posture must not perturb the
+    # bytes (auto: fallback) nor fault spuriously.
+    import contextlib
+    import threading
+
+    from semantic_merge_tpu import batch
+    from semantic_merge_tpu.utils import reqenv
+
+    monkeypatch.setenv("SEMMERGE_MESH", "off")
+    snaps = bench.synth_repo(4, 2)
+    render_off(monkeypatch)
+    want = merge_payloads(*snaps)
+    render_on(monkeypatch, "auto")
+    batch.activate(window_ms=100.0)
+    try:
+        n = 3
+        results, errors = [None] * n, [None] * n
+        barrier = threading.Barrier(n)
+
+        def work(i):
+            try:
+                be = TpuTSBackend(mesh=False)
+                with reqenv.overlay({batch.ENV_POSTURE: "off"}):
+                    be.merge(*snaps, base_rev="bench", seed="bench",
+                             timestamp=TS)
+                barrier.wait()
+                res, composed, conflicts = be.merge(
+                    *snaps, base_rev="bench", seed="bench", timestamp=TS)
+                results[i] = (
+                    OpLog(res.op_log_left).to_json_bytes(),
+                    OpLog(res.op_log_right).to_json_bytes(),
+                    composed.to_json_bytes()
+                    if hasattr(composed, "to_json_bytes") else None,
+                    [op.to_dict() for op in composed],
+                    [c.to_dict() for c in conflicts],
+                )
+            except BaseException as exc:  # noqa: BLE001 - surfaced below
+                errors[i] = exc
+                with contextlib.suppress(threading.BrokenBarrierError):
+                    barrier.abort()
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for exc in errors:
+            if exc is not None:
+                raise exc
+    finally:
+        batch.deactivate()
+    for i, got in enumerate(results):
+        assert got == want, f"request {i} diverged under device render"
+
+
+def test_render_handle_consumed_once(monkeypatch):
+    # The fast path serves the rendered bytes; a second serialization
+    # of the same view must still be byte-identical (the handle caches
+    # its fetched buffer — or the host fallback reproduces it).
+    snaps = bench.synth_repo(20, 3, divergent=True)
+    render_on(monkeypatch, "require")
+    backend = TpuTSBackend(mesh=False)
+    res, composed, _ = backend.merge(
+        *snaps, base_rev="bench", seed="bench", timestamp=TS)
+    first = OpLog(res.op_log_left).to_json_bytes()
+    second = OpLog(res.op_log_left).to_json_bytes()
+    assert first == second
+    if hasattr(composed, "to_json_bytes"):
+        assert composed.to_json_bytes() == composed.to_json_bytes()

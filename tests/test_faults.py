@@ -224,7 +224,8 @@ def test_exit_codes_documented_and_distinct():
                           "WorkerFault": 12, "ApplyFault": 13,
                           "FormatFault": 14, "DeadlineFault": 15,
                           "BatchFault": 16, "ResolveFault": 17,
-                          "MeshFault": 18, "FleetFault": 19}
+                          "MeshFault": 18, "FleetFault": 19,
+                          "RenderFault": 20}
     assert len(set(EXIT_CODES.values())) == len(EXIT_CODES)
     # Reserved result codes stay distinct from fault codes.
     assert not {0, 1, 2, 3} & set(EXIT_CODES.values())

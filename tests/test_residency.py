@@ -1,0 +1,270 @@
+"""Warm snapshot residency (``service/residency.py``).
+
+The residency cache hands repeat merges of the same base tree the
+already-encoded decl tensor (skipping scan+encode+h2d); these tests pin
+the invalidation matrix that keeps the shortcut byte-safe: a changed
+tree oid misses, a GC'd repository evicts (``stale-tree``), an epoch
+bump — the fleet-failover hook — evicts (``stale-epoch``), an interner
+replacement evicts (``stale-interner``), and both the byte budget and
+the daemon's RSS hard watermark evict. In EVERY case the merge output
+stays byte-identical to a cold run.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+
+import pytest
+
+import bench
+from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+from semantic_merge_tpu.core.ops import OpLog
+from semantic_merge_tpu.frontend.snapshot import annotate_residency
+from semantic_merge_tpu.obs import metrics as obs_metrics
+from semantic_merge_tpu.service import residency
+
+TS = "2026-01-01T00:00:00Z"
+
+
+@pytest.fixture(autouse=True)
+def _residency_on(monkeypatch):
+    monkeypatch.setenv("SEMMERGE_RESIDENCY_CACHE", "on")
+    monkeypatch.setenv("SEMMERGE_MESH", "off")
+    residency.cache().reset()
+    yield
+    residency.cache().reset()
+
+
+def outcome_total(outcome: str) -> float:
+    return obs_metrics.REGISTRY.counter(
+        "snapshot_residency_hits_total").value(outcome=outcome)
+
+
+def eviction_total(reason: str) -> float:
+    return obs_metrics.REGISTRY.counter(
+        "snapshot_residency_evictions_total").value(reason=reason)
+
+
+def merge_bytes(backend, snaps, *, annotate=None):
+    """One fused merge; returns the byte-comparable payload triple.
+    ``annotate=(root, oid)`` keys the base into the residency cache the
+    way the CLI does (fresh snapshot objects each call — the residency
+    hit must not depend on object identity)."""
+    base, left, right = snaps
+    if annotate is not None:
+        annotate_residency(base, annotate[0], annotate[1])
+    res, composed, conflicts = backend.merge(
+        base, left, right, base_rev="bench", seed="bench", timestamp=TS)
+    return (OpLog(res.op_log_left).to_json_bytes(),
+            OpLog(res.op_log_right).to_json_bytes(),
+            [op.to_dict() for op in composed],
+            [c.to_dict() for c in conflicts])
+
+
+def fresh_snaps(divergent=True, n=30):
+    return bench.synth_repo(n, 4, divergent=divergent)
+
+
+def test_repeat_base_hits_and_stays_byte_identical():
+    backend = TpuTSBackend(mesh=False)
+    cold = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    assert cold[3], "divergent workload must produce conflicts"
+    before = outcome_total("hit")
+    warm = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    assert warm == cold
+    assert outcome_total("hit") == before + 1
+    stats = residency.cache().stats()
+    assert stats["entries"] == 1 and stats["bytes"] > 0
+
+
+def test_tree_oid_change_misses_and_stays_byte_identical():
+    backend = TpuTSBackend(mesh=False)
+    merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    # Same repo key, new tree oid (base advanced): must MISS — never
+    # serve the old tree's encoding — and produce identical bytes to a
+    # cold merge of the same content.
+    unannotated = merge_bytes(TpuTSBackend(mesh=False), fresh_snaps())
+    before_hit, before_miss = outcome_total("hit"), outcome_total("miss")
+    got = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-b"))
+    assert got == unannotated
+    assert outcome_total("hit") == before_hit
+    assert outcome_total("miss") == before_miss + 1
+    assert residency.cache().stats()["entries"] == 2
+
+
+def _git(args, cwd):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def test_repo_gc_mid_residency_evicts_stale_tree(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(["init", "-q"], repo)
+    (repo / "a.ts").write_text("export function a(): number "
+                               "{ return 1; }\n")
+    _git(["add", "."], repo)
+    _git(["-c", "user.email=t@t", "-c", "user.name=t",
+          "commit", "-q", "-m", "seed"], repo)
+    oid = subprocess.run(
+        ["git", "rev-parse", "HEAD^{tree}"], cwd=repo, check=True,
+        stdout=subprocess.PIPE, text=True).stdout.strip()
+
+    backend = TpuTSBackend(mesh=False)
+    key = (str(repo), oid)
+    cold = merge_bytes(backend, fresh_snaps(), annotate=key)
+    warm = merge_bytes(backend, fresh_snaps(), annotate=key)
+    assert warm == cold
+
+    # GC the repository out from under the resident entry: the tree
+    # object is gone, so the next lookup must evict (stale-tree) and
+    # re-encode — byte-identically.
+    shutil.rmtree(repo / ".git")
+    _git(["init", "-q"], repo)  # a repo with no such tree
+    before = outcome_total("stale-tree")
+    regone = merge_bytes(backend, fresh_snaps(), annotate=key)
+    assert regone == cold
+    assert outcome_total("stale-tree") == before + 1
+    assert eviction_total("stale") >= 1
+
+
+def test_rss_hard_watermark_clear_evicts_and_reencodes():
+    backend = TpuTSBackend(mesh=False)
+    cold = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    assert residency.cache().stats()["entries"] == 1
+    # The daemon's pressure monitor makes exactly this call at the RSS
+    # hard watermark (service/daemon.py _pressure_monitor).
+    before = eviction_total("rss-hard")
+    dropped = residency.cache().clear(reason="rss-hard")
+    assert dropped == 1
+    assert eviction_total("rss-hard") == before + 1
+    assert residency.cache().stats()["entries"] == 0
+    assert residency.cache().stats()["bytes"] == 0
+    regone = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    assert regone == cold
+
+
+def test_fleet_failover_epoch_bump_evicts_stale_epoch():
+    backend = TpuTSBackend(mesh=False)
+    cold = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    # The fleet router makes exactly this call when a membership change
+    # moves keys (fleet/router.py _set_ring): a rehashed member must
+    # not trust any resident handle from the previous routing epoch.
+    residency.cache().bump_epoch()
+    before = outcome_total("stale-epoch")
+    regone = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    assert regone == cold
+    assert outcome_total("stale-epoch") == before + 1
+    # The re-encode repopulated under the new epoch: next lookup hits.
+    before_hit = outcome_total("hit")
+    warm = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    assert warm == cold
+    assert outcome_total("hit") == before_hit + 1
+
+
+def test_fresh_backend_shares_interner_and_hits():
+    # The daemon builds a fresh backend per request (get_backend is not
+    # memoized); under residency every backend must adopt the
+    # process-shared interner or no daemon request could ever hit.
+    backend = TpuTSBackend(mesh=False)
+    cold = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    other = TpuTSBackend(mesh=False)
+    assert other._interner is backend._interner
+    before = outcome_total("hit")
+    got = merge_bytes(other, fresh_snaps(), annotate=("", "oid-a"))
+    assert got == cold
+    assert outcome_total("hit") == before + 1
+
+
+def test_growth_guard_swap_evicts_stale_interner():
+    # The growth guard is the one remaining interner-replacement path:
+    # it must swap the process-shared instance (so later backends adopt
+    # the replacement), and entries encoded under the dead token must
+    # never be served — the next lookup evicts (stale-interner) and
+    # re-encodes byte-identically.
+    from semantic_merge_tpu.backends import ts_tpu
+    from semantic_merge_tpu.core.encode import Interner
+    backend = TpuTSBackend(mesh=False)
+    cold = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    old = backend._interner
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(Interner, "__len__", lambda self: 4_000_001)
+        backend._maybe_reset_interner()
+    assert backend._interner is not old
+    assert backend._interner.shared
+    assert ts_tpu._SHARED_INTERNER is backend._interner
+    assert TpuTSBackend(mesh=False)._interner is backend._interner
+    before = outcome_total("stale-interner")
+    got = merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    assert got == cold
+    assert outcome_total("stale-interner") == before + 1
+
+
+def test_byte_budget_evicts_lru(monkeypatch):
+    # A ~zero budget admits nothing; a small budget evicts the oldest
+    # entry when a second is admitted.
+    backend = TpuTSBackend(mesh=False)
+    monkeypatch.setenv("SEMMERGE_RESIDENCY_CACHE_MB", "0.00001")
+    merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    assert residency.cache().stats()["entries"] == 0
+    monkeypatch.setenv("SEMMERGE_RESIDENCY_CACHE_MB", "0.06")
+    merge_bytes(backend, fresh_snaps(n=60), annotate=("", "oid-a"))
+    assert residency.cache().stats()["entries"] == 1
+    before = eviction_total("lru")
+    merge_bytes(backend, fresh_snaps(n=60), annotate=("", "oid-b"))
+    stats = residency.cache().stats()
+    assert stats["entries"] == 1, "budget admits one ~52K entry, not two"
+    assert eviction_total("lru") > before
+
+
+def test_scope_participates_in_key():
+    backend = TpuTSBackend(mesh=False)
+    base, left, right = fresh_snaps()
+    annotate_residency(base, "", "oid-a", scope=["src/a.ts"])
+    backend.merge(base, left, right, base_rev="bench", seed="bench",
+                  timestamp=TS)
+    base2, left2, right2 = fresh_snaps()
+    annotate_residency(base2, "", "oid-a", scope=["src/b.ts"])
+    before = outcome_total("hit")
+    backend.merge(base2, left2, right2, base_rev="bench", seed="bench",
+                  timestamp=TS)
+    # Different scope, same tree: a restricted encoding must not be
+    # served for a differently-restricted request.
+    assert outcome_total("hit") == before
+    assert residency.cache().stats()["entries"] == 2
+
+
+def test_posture_off_bypasses_cache(monkeypatch):
+    monkeypatch.setenv("SEMMERGE_RESIDENCY_CACHE", "off")
+    backend = TpuTSBackend(mesh=False)
+    merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    merge_bytes(backend, fresh_snaps(), annotate=("", "oid-a"))
+    assert residency.cache().stats()["entries"] == 0
+
+
+def test_daemon_status_reports_residency():
+    from semantic_merge_tpu.service import daemon as daemon_mod
+    d = daemon_mod.Daemon.__new__(daemon_mod.Daemon)
+    # Only status() is exercised; give it the minimal state it reads.
+    import threading
+    import time as _time
+    d._state_lock = threading.Lock()
+    d._in_flight, d._served = 0, 0
+    d._t0 = _time.time()
+    d._queue = __import__("queue").Queue()
+    d._socket_path = "-"
+    d._workers_n = 0
+    d._draining = False
+    d._fleet_member = False
+    d._repo_locks = {}
+    d._telemetry = None
+    d._slo = None
+    d._pressure = 0
+    d._soft_mb = d._hard_mb = 0.0
+    d._exec_ewma = 0.0
+    d._idem = {}
+    d._projected_wait = lambda: 0.0
+    status = d.status()
+    res = status["residency"]
+    assert set(res) >= {"enabled", "entries", "bytes", "budget_bytes",
+                        "hit_rate", "evictions"}

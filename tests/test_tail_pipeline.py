@@ -188,3 +188,70 @@ def test_resolve_host_workers_resolution(monkeypatch):
     assert resolve_host_workers(3) == 3  # invalid env ignored
     monkeypatch.setenv("SEMMERGE_HOST_WORKERS", "0")
     assert resolve_host_workers(3) >= 1  # floor at 1
+
+
+def test_tail_disjoint_attribution():
+    """bench._tail_disjoint: pool-worker ``materialize_overlap`` time
+    executing inside a main-thread tail-phase wall window is attributed
+    ONCE (to the overlap pool), not twice — summing the tail trio with
+    the overlap phase counts every wall instant exactly once."""
+    import bench
+    from semantic_merge_tpu.obs import spans as obs_spans
+
+    rec = obs_spans.SpanRecorder()
+    e = rec.epoch
+    # Main-thread tail spans: serialize [1.0, 1.3), then
+    # compose_materialize [1.3, 1.7).
+    obs_spans.record_into(rec, "serialize", 0.300, t_start=e + 1.0)
+    obs_spans.record_into(rec, "compose_materialize", 0.400,
+                          t_start=e + 1.3)
+    # Worker shards: two adjacent spans merging into [1.10, 1.40) —
+    # straddling the serialize/compose boundary — plus one entirely
+    # outside any tail window (must subtract nothing).
+    obs_spans.record_into(rec, "materialize_overlap", 0.150,
+                          t_start=e + 1.10)
+    obs_spans.record_into(rec, "materialize_overlap", 0.150,
+                          t_start=e + 1.25)
+    obs_spans.record_into(rec, "materialize_overlap", 0.100,
+                          t_start=e + 2.0)
+
+    phases = {"serialize": 0.300, "compose_materialize": 0.400,
+              "materialize_overlap": 0.400, "kernel": 0.100}
+    out = bench._tail_disjoint(phases, rec)
+    # serialize window [1.0, 1.3) ∩ worker union [1.10, 1.40) = 0.20.
+    assert out["serialize"] == pytest.approx(0.100, abs=1e-4)
+    # compose_materialize [1.3, 1.7) ∩ [1.10, 1.40) = 0.10.
+    assert out["compose_materialize"] == pytest.approx(0.300, abs=1e-4)
+    # Overlap pool and non-tail phases are reported as measured.
+    assert out["materialize_overlap"] == pytest.approx(0.400)
+    assert out["kernel"] == pytest.approx(0.100)
+    # The disjoint invariant: tail trio + overlap == total busy wall.
+    disjoint_sum = (out["serialize"] + out["compose_materialize"]
+                    + out["materialize_overlap"])
+    assert disjoint_sum == pytest.approx(0.300 + 0.400 + 0.400 - 0.300,
+                                         abs=1e-4)
+
+
+def test_tail_disjoint_no_workers_is_identity():
+    import bench
+    from semantic_merge_tpu.obs import spans as obs_spans
+
+    rec = obs_spans.SpanRecorder()
+    obs_spans.record_into(rec, "serialize", 0.3, t_start=rec.epoch + 1.0)
+    phases = {"serialize": 0.3, "compose_materialize": 0.4}
+    assert bench._tail_disjoint(phases, rec) == phases
+
+
+def test_tail_disjoint_clamps_at_zero():
+    """A phase fully covered by worker intervals reports 0, never a
+    negative wall (rounding in span_dicts can over-cover by ~1e-6)."""
+    import bench
+    from semantic_merge_tpu.obs import spans as obs_spans
+
+    rec = obs_spans.SpanRecorder()
+    e = rec.epoch
+    obs_spans.record_into(rec, "serialize", 0.200, t_start=e + 1.0)
+    obs_spans.record_into(rec, "materialize_overlap", 0.500,
+                          t_start=e + 0.9)
+    out = bench._tail_disjoint({"serialize": 0.200}, rec)
+    assert out["serialize"] == pytest.approx(0.0, abs=1e-5)

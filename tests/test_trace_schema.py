@@ -941,3 +941,99 @@ def test_export_validator(schema, tmp_path):
                            "validate_export", str(bad)],
                           capture_output=True, text=True, timeout=60)
     assert fail.returncode == 1
+
+
+def test_device_render_records_validate(schema, tmp_path, monkeypatch):
+    """A trace from a REAL device-rendered merge with a residency hit —
+    the ``render.d2h`` d2h-copy span, the ``residency.hit`` /
+    ``residency.encode_delta`` spans, and the residency metric series —
+    must pass ``validate_device_render``; drifted shapes (wrong layer,
+    missing transfer meta, undocumented outcome/reason, labeled bytes
+    gauge) are rejected field by field. The CLI subcommand wires the
+    same validator."""
+    import bench
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    from semantic_merge_tpu.backends.ts_tpu import TpuTSBackend
+    from semantic_merge_tpu.core.ops import OpLog
+    from semantic_merge_tpu.frontend.snapshot import annotate_residency
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    from semantic_merge_tpu.service import residency
+
+    monkeypatch.setenv("SEMMERGE_MESH", "off")
+    monkeypatch.setenv("SEMMERGE_DEVICE_RENDER", "require")
+    monkeypatch.setenv("SEMMERGE_RENDER_MIN_ROWS", "0")
+    monkeypatch.setenv("SEMMERGE_RESIDENCY_CACHE", "on")
+    residency.cache().reset()
+    tracer = trace_mod.Tracer(enabled=True)
+    backend = TpuTSBackend(mesh=False)
+    with tracer.phase("merge", backend="tpu"):
+        for _ in range(2):  # first populates residency, second hits
+            base, left, right = bench.synth_repo(20, 3, divergent=True)
+            annotate_residency(base, "", "cafe" * 10)
+            res, composed, _ = backend.merge(
+                base, left, right, base_rev="bench", seed="bench",
+                timestamp="2026-01-01T00:00:00Z")
+            OpLog(res.op_log_left).to_json_bytes()   # forces render.d2h
+            OpLog(res.op_log_right).to_json_bytes()
+    residency.cache().clear(reason="rss-hard")
+    residency.cache().reset()
+    trace = tmp_path / ".semmerge-trace.json"
+    tracer.write(trace)
+    data = json.loads(trace.read_text())
+    data["metrics"] = obs_metrics.REGISTRY.to_dict()
+    names = {row.get("name") for row in data["spans"]}
+    assert {"render.d2h", "residency.hit",
+            "residency.encode_delta"} <= names, names
+    assert schema.validate_trace(data) == []
+    assert schema.validate_device_render(data) == []
+
+    def spans_named(doc, name):
+        return [r for r in doc["spans"] if r.get("name") == name]
+
+    broken = json.loads(json.dumps(data))
+    spans_named(broken, "render.d2h")[0]["layer"] = "backend"
+    assert any("render.d2h span layer" in e
+               for e in schema.validate_device_render(broken))
+
+    broken = json.loads(json.dumps(data))
+    del spans_named(broken, "render.d2h")[0]["meta"]["rows"]
+    assert any("'rows'" in e
+               for e in schema.validate_device_render(broken))
+
+    broken = json.loads(json.dumps(data))
+    spans_named(broken, "residency.hit")[0]["meta"].pop("repo")
+    assert any("'repo'" in e
+               for e in schema.validate_device_render(broken))
+
+    broken = json.loads(json.dumps(data))
+    series = broken["metrics"]["counters"][
+        "snapshot_residency_hits_total"]["series"]
+    series[0]["labels"] = {"outcome": "warmish"}
+    assert any("warmish" in e
+               for e in schema.validate_device_render(broken))
+
+    broken = json.loads(json.dumps(data))
+    series = broken["metrics"]["counters"][
+        "snapshot_residency_evictions_total"]["series"]
+    series[0]["labels"] = {"why": "rss-hard"}
+    assert any("snapshot_residency_evictions_total" in e
+               for e in schema.validate_device_render(broken))
+
+    broken = json.loads(json.dumps(data))
+    gauge = broken["metrics"]["gauges"]["snapshot_residency_bytes"]
+    gauge["series"][0]["labels"] = {"pool": "a"}
+    assert any("no labels" in e
+               for e in schema.validate_device_render(broken))
+
+    good = tmp_path / "render-trace.json"
+    good.write_text(json.dumps(data))
+    ok = subprocess.run([sys.executable, str(_SCRIPT),
+                         "validate_device_render", str(good)],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    bad = tmp_path / "render-bad.json"
+    bad.write_text(json.dumps(broken))
+    fail = subprocess.run([sys.executable, str(_SCRIPT),
+                           "validate_device_render", str(bad)],
+                          capture_output=True, text=True, timeout=60)
+    assert fail.returncode == 1

@@ -958,6 +958,10 @@ PRESETS = {
     "batchserve": {"files": 48, "decls": 4, "batchserve": True},
     "overload": {"files": 24, "decls": 4, "overload": True},
     "fleet": {"files": 24, "decls": 4, "fleet": True},
+    # fleetwan: the cross-host fleet shape — remote members joined over
+    # TCP, 20ms injected dial latency; gates the post-churn rehash miss
+    # rate at <= 0.15.
+    "fleetwan": {"files": 24, "decls": 4, "fleetwan": True},
     "tracecost": {"files": 10000, "decls": 4, "tracecost": True},
     "slocost": {"files": 10000, "decls": 4, "slocost": True},
     # devtail: the rung-5 host-tail ladder — cold vs resident-base vs
@@ -2236,6 +2240,338 @@ def run_fleet_bench(record: dict, args, json_only: bool = False) -> int:
         shutil.rmtree(scratch, ignore_errors=True)
 
 
+def run_fleetwan_bench(record: dict, args, json_only: bool = False) -> int:
+    """The ``fleetwan`` preset: the cross-host fleet shape on a TCP
+    loopback with injected per-dial latency (the ``net:slow`` seam,
+    20 ms — a same-region WAN RTT). A router with no local members
+    fronts 3 standalone daemons joined over ``serve --join``; every
+    router->member dial pays the lag. Four measurements:
+
+    1. warm throughput through the laggy transport
+       -> ``fleetwan_merges_per_sec`` (headline);
+    2. elastic churn — one TCP join + one drain; after the incremental
+       handoff prewarms moved keys, one merge per repo must land warm
+       -> ``fleetwan_rehash_miss_rate`` = cold dispatches / repos,
+       hard-gated at <= 0.15 (an unassisted rendezvous rehash faults
+       ~1/N of the keyspace in cold) and guarded in PERF_BASELINE.json;
+    3. SIGKILL the rendezvous owner of one repo, time until that
+       repo's next merge lands on the rehashed owner
+       -> ``fleetwan_failover_recovery_ms``;
+    4. a second, heartbeat-quiet fleet (health interval 5 s vs 0.2 s)
+       isolates the probe plane's throughput cost on the same laggy
+       transport -> ``fleetwan_heartbeat_overhead_pct``.
+    """
+    import shutil
+    import signal as signal_mod
+    import subprocess
+    import tempfile
+    import threading
+
+    from semantic_merge_tpu.fleet import hashring
+    from semantic_merge_tpu.service import client as svc_client
+
+    scratch = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-fleetwan-"))
+    lag_s = 0.02
+    miss_gate = 0.15
+    n_repos = 8
+    repos = []
+    for i in range(n_repos):
+        repo = scratch / f"repo{i}"
+        _build_service_repo(repo, args.files, args.decls)
+        repos.append(repo)
+
+    child_env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    prior_pp = child_env.get("PYTHONPATH", "")
+    child_env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{prior_pp}"
+                               if prior_pp else pkg_root)
+    child_env.update({
+        "SEMMERGE_DAEMON": "off",
+        "SEMMERGE_SERVICE_WORKERS": "1",
+        "SEMMERGE_SERVICE_DRAIN_TIMEOUT": "2",
+    })
+    for key in ("SEMMERGE_FAULT", "SEMMERGE_METRICS",
+                "SEMMERGE_SERVICE_SOCKET", "SEMMERGE_FLEET",
+                "SEMMERGE_FLEET_MEMBERS", "SEMMERGE_FLEET_HEDGE",
+                "SEMMERGE_FLEET_HEDGE_MS"):
+        child_env.pop(key, None)
+    if os.environ.get("SEMMERGE_BENCH_PLATFORM") == "cpu":
+        child_env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn_router(sock, health_interval):
+        # The lag is injected in the ROUTER's env only: its dials to
+        # members (dispatch, heartbeats, handoff prewarms) all pay it —
+        # the member daemons and the bench client stay unlagged.
+        env = dict(child_env)
+        env.update({
+            "SEMMERGE_FLEET_HEDGE": "off",
+            "SEMMERGE_FLEET_HEALTH_INTERVAL": health_interval,
+            "SEMMERGE_FAULT": "net:slow:lag",
+            "SEMMERGE_FAULT_NET_SLOW_S": f"{lag_s}",
+        })
+        log = open(sock + ".log", "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "semantic_merge_tpu", "fleet",
+             "--socket", sock, "--members", "0"],
+            stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+            cwd="/", env=env, start_new_session=True)
+        log.close()
+        return proc
+
+    def spawn_member(router_sock, member_id):
+        env = dict(child_env)
+        env["SEMMERGE_FLEET_JOIN_INTERVAL"] = "0.5"
+        log = open(str(scratch / f"member-{member_id}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "semantic_merge_tpu", "serve",
+             "--socket", "tcp://127.0.0.1:0", "--join", router_sock,
+             "--member-id", member_id],
+            stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+            cwd="/", env=env, start_new_session=True)
+        log.close()
+        return proc
+
+    def fleet_status(sock, timeout=10):
+        try:
+            return svc_client.call_control("status", path=sock,
+                                           timeout=timeout)
+        except Exception:
+            return None
+
+    def wait_ring(sock, proc, want_ids, timeout=240.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                return (f"router exited rc={proc.returncode} "
+                        f"(log: {sock}.log)")
+            status = fleet_status(sock)
+            ring = {m["id"] for m in (status or {}).get("members", [])
+                    if m.get("in_ring")}
+            if status and status.get("fleet") and want_ids <= ring:
+                return None
+            time.sleep(0.2)
+        return (f"ring never reached {sorted(want_ids)} within "
+                f"{timeout:g}s (log: {sock}.log)")
+
+    def call(sock, repo, timeout=180):
+        return svc_client.call_verb(
+            "semmerge",
+            {"argv": ["basebr", "brA", "brB", "--backend", "host"],
+             "cwd": str(repo), "env": {},
+             "idempotency_key": f"bench-{os.urandom(8).hex()}"},
+            path=sock, timeout=timeout)
+
+    def warm(sock):
+        for repo in repos:
+            frame = call(sock, repo)
+            if (frame.get("result") or {}).get("exit_code") != 0:
+                return f"warm-up merge failed: {str(frame)[:200]}"
+        return None
+
+    def sweep(sock, total, concurrency):
+        work = [repos[i % n_repos] for i in range(total)]
+        lock = threading.Lock()
+        errors = []
+
+        def worker():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    repo = work.pop()
+                try:
+                    frame = call(sock, repo)
+                except Exception as exc:
+                    with lock:
+                        errors.append(f"sweep request died: {exc}")
+                    return
+                if (frame.get("result") or {}).get("exit_code") != 0:
+                    with lock:
+                        errors.append(f"sweep merge failed: "
+                                      f"{str(frame)[:200]}")
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t0
+        return (total / wall if wall else 0.0), errors
+
+    def counter_total(status, name):
+        metric = ((status or {}).get("metrics") or {}) \
+            .get("counters", {}).get(name, {})
+        return sum(s["value"] for s in metric.get("series", []))
+
+    def teardown(proc, sock):
+        if proc is None or proc.poll() is not None:
+            return
+        proc.send_signal(signal_mod.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def fail(msg: str) -> int:
+        record["error"] = msg
+        emit_record(record)
+        return 1
+
+    router_a = router_b = None
+    sock_a = str(scratch / "wan-a.sock")
+    sock_b = str(scratch / "wan-b.sock")
+    member_procs = {}
+    try:
+        # ----- phase 1: warm throughput through the laggy transport --
+        router_a = spawn_router(sock_a, health_interval="0.2")
+        for mid in ("t0", "t1", "t2"):
+            member_procs[mid] = spawn_member(sock_a, mid)
+        err = wait_ring(sock_a, router_a, {"t0", "t1", "t2"})
+        if err:
+            return fail(err)
+        err = warm(sock_a)
+        if err:
+            return fail(err)
+        rate_hb, errors = sweep(sock_a, total=24, concurrency=6)
+        if errors:
+            return fail("fleetwan sweep: " + "; ".join(errors[:3]))
+        record["fleetwan_merges_per_sec"] = round(rate_hb, 2)
+        if not json_only:
+            print(f"# fleetwan ({lag_s*1e3:.0f} ms lag): "
+                  f"{rate_hb:6.2f} merges/sec", file=sys.stderr)
+
+        # ----- phase 2: churn — one join + one drain, miss rate ------
+        member_procs["t3"] = spawn_member(sock_a, "t3")
+        err = wait_ring(sock_a, router_a, {"t1", "t2", "t3"})
+        if err:
+            return fail(err)
+        ack = svc_client.call_control("drain", params={"member": "t0"},
+                                      path=sock_a, timeout=30)
+        if not (ack or {}).get("ok"):
+            return fail(f"drain of t0 not acked: {ack!r}")
+        # The affinity handoff prewarms moved keys off the churn path
+        # (a background thread); wait for the handoff counter to go
+        # quiet before sampling, so the measurement sees the rebalanced
+        # steady state, not the rebalance itself.
+        settle_deadline = time.monotonic() + 120
+        last = (-1.0, time.monotonic())
+        while time.monotonic() < settle_deadline:
+            status = fleet_status(sock_a, timeout=30)
+            now_total = counter_total(status, "fleet_handoffs_total")
+            if now_total != last[0]:
+                last = (now_total, time.monotonic())
+            elif time.monotonic() - last[1] >= 1.5:
+                break
+            time.sleep(0.25)
+        status = fleet_status(sock_a, timeout=30)
+        misses0 = counter_total(status, "fleet_affinity_misses_total")
+        for repo in repos:
+            frame = call(sock_a, repo)
+            if (frame.get("result") or {}).get("exit_code") != 0:
+                return fail(f"post-churn merge failed: "
+                            f"{str(frame)[:200]}")
+        status = fleet_status(sock_a, timeout=30)
+        misses = counter_total(status, "fleet_affinity_misses_total") \
+            - misses0
+        miss_rate = misses / n_repos
+        record["fleetwan_rehash_miss_rate"] = round(miss_rate, 4)
+        record["fleetwan_handoffs_total"] = counter_total(
+            status, "fleet_handoffs_total")
+        if not json_only:
+            print(f"# rehash miss rate after join+drain: "
+                  f"{miss_rate:.3f} ({misses:.0f}/{n_repos} cold; "
+                  f"gate {miss_gate})", file=sys.stderr)
+
+        # ----- phase 3: failover recovery on the laggy transport -----
+        status = fleet_status(sock_a, timeout=30)
+        ring = [m["id"] for m in (status or {}).get("members", [])
+                if m.get("in_ring")]
+        victim_id = hashring.owner(hashring.repo_key(str(repos[0])),
+                                   ring)
+        victim = member_procs.get(victim_id)
+        if victim is None:
+            return fail(f"owner {victim_id!r} of repo0 is not a "
+                        f"spawned member")
+        t0 = time.perf_counter()
+        os.kill(victim.pid, signal_mod.SIGKILL)
+        recovery_s = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                frame = call(sock_a, repos[0], timeout=60)
+            except Exception:
+                time.sleep(0.1)
+                continue
+            if (frame.get("result") or {}).get("exit_code") == 0:
+                recovery_s = time.perf_counter() - t0
+                break
+            time.sleep(0.1)
+        if recovery_s is None:
+            return fail("repo0 merge did not recover within 120s of "
+                        "its owner's SIGKILL")
+        record["fleetwan_failover_recovery_ms"] = round(
+            recovery_s * 1e3, 1)
+        if not json_only:
+            print(f"# failover recovery: {recovery_s*1e3:8.1f} ms",
+                  file=sys.stderr)
+        teardown(router_a, sock_a)
+        router_a = None
+
+        # ----- phase 4: heartbeat overhead vs a quiet fleet ----------
+        router_b = spawn_router(sock_b, health_interval="5")
+        for mid in ("q0", "q1", "q2"):
+            member_procs[mid] = spawn_member(sock_b, mid)
+        err = wait_ring(sock_b, router_b, {"q0", "q1", "q2"})
+        if err:
+            return fail(err)
+        err = warm(sock_b)
+        if err:
+            return fail(err)
+        rate_quiet, errors = sweep(sock_b, total=24, concurrency=6)
+        if errors:
+            return fail("fleetwan quiet sweep: "
+                        + "; ".join(errors[:3]))
+        overhead = (max(0.0, (rate_quiet - rate_hb) / rate_quiet * 100)
+                    if rate_quiet > 0 else 0.0)
+        record["fleetwan_quiet_merges_per_sec"] = round(rate_quiet, 2)
+        record["fleetwan_heartbeat_overhead_pct"] = round(overhead, 2)
+        if not json_only:
+            print(f"# heartbeat overhead: {overhead:5.2f}% "
+                  f"({rate_quiet:.2f} merges/sec with probes quiet)",
+                  file=sys.stderr)
+
+        record["metric"] = (
+            f"merges/sec through a TCP-loopback fleet with "
+            f"{lag_s*1e3:.0f} ms injected dial latency (3 remote "
+            f"members joined via announce, rendezvous affinity, "
+            f"hedging off, {n_repos} repos x {args.files} files x "
+            f"{args.decls} decls, host backend, 1 worker/member)")
+        record["value"] = round(rate_hb, 2)
+        record["unit"] = "merges/sec"
+        record["vs_baseline"] = round(
+            rate_hb / rate_quiet, 3) if rate_quiet else 0.0
+        if miss_rate > miss_gate:
+            return fail(f"fleetwan rehash miss rate {miss_rate:.3f} "
+                        f"exceeds the {miss_gate} gate — the affinity "
+                        f"handoff is not prewarming moved keys")
+        emit_record(record)
+        return 0
+    finally:
+        teardown(router_a, sock_a)
+        teardown(router_b, sock_b)
+        for proc in member_procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal_mod.SIGTERM)
+        for proc in member_procs.values():
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
 def run_incremental_bench(record: dict, args, n_changed: int,
                           json_only: bool = False) -> int:
     """The rung5i scenario: a 10k-file tree where only ``n_changed``
@@ -2380,6 +2716,9 @@ def main() -> int:
         # Router + member daemons are all subprocesses; the parent
         # needs no accelerator.
         return run_fleet_bench(record, args, json_only=args.json_only)
+    if args.preset == "fleetwan":
+        # Same shape over TCP with injected dial latency.
+        return run_fleetwan_bench(record, args, json_only=args.json_only)
     if args.preset == "resolve":
         # One-shot CLI subprocesses on the host backend: the parent
         # needs no accelerator.

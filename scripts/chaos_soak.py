@@ -40,6 +40,22 @@ members and replays its dispatch WAL; :func:`audit_wal` then walks the
 full retained journal history to prove every effect was accounted for
 exactly once (no duplicate ``--inplace`` effect from a replay or a
 failover re-dispatch).
+
+The cross-host legs (ISSUE 19) ride on the fleet drill:
+
+- ``--tcp-members N`` adds N *standalone* daemons that join the router
+  over real TCP (``serve --join``) — the two-host-simulated shape; a
+  router SIGKILL also proves remote members re-announce themselves to
+  the replacement.
+- ``--partitions K`` SIGSTOPs a TCP member K times: the connection
+  stays up but reads never complete (true half-open), so only the
+  application-level heartbeat can eject it — counted as a
+  ``reason="partition"`` failover. Traffic keeps settling byte-exact
+  on the survivors; SIGCONT heals the member and it rejoins.
+- ``--churn`` performs one elastic join and one drain mid-load: a
+  fresh TCP member announces itself into a warm ring (moved keys are
+  handed off), and a serving member is drained (``reason="drain"``,
+  never a failure eject).
 """
 from __future__ import annotations
 
@@ -324,15 +340,19 @@ def spawn_supervised(sock_path: str, dump_path: pathlib.Path,
     return proc
 
 
-def daemon_status(sock_path: str, timeout: float = 5.0) -> Optional[dict]:
+def control(sock_path: str, method: str,
+            params: Optional[Dict[str, Any]] = None,
+            timeout: float = 5.0) -> Optional[dict]:
+    """One control-verb round trip (status/drain/leave/...); ``None``
+    on any transport failure — callers poll."""
     s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
     s.settimeout(timeout)
     try:
         s.connect(sock_path)
         rfile = s.makefile("r", encoding="utf-8")
         wfile = s.makefile("w", encoding="utf-8")
-        protocol.write_message(wfile, {"id": 1, "method": "status",
-                                       "params": {}})
+        protocol.write_message(wfile, {"id": 1, "method": method,
+                                       "params": params or {}})
         resp = protocol.read_message(rfile)
         return (resp or {}).get("result")
     except (OSError, protocol.ProtocolError):
@@ -342,6 +362,10 @@ def daemon_status(sock_path: str, timeout: float = 5.0) -> Optional[dict]:
             s.close()
         except OSError:
             pass
+
+
+def daemon_status(sock_path: str, timeout: float = 5.0) -> Optional[dict]:
+    return control(sock_path, "status", timeout=timeout)
 
 
 def wait_daemon(sock_path: str, sup: subprocess.Popen,
@@ -604,6 +628,58 @@ def wait_fleet(sock_path: str, router: subprocess.Popen,
                        f"(log: {sock_path}.log)")
 
 
+def spawn_tcp_member(router_sock: str, workdir: pathlib.Path,
+                     member_id: str,
+                     extra_env: Optional[Dict[str, str]] = None
+                     ) -> subprocess.Popen:
+    """Start a *standalone* member daemon on an ephemeral TCP port that
+    announces itself to the router (``serve --join``) — the two-host-
+    simulated shape: the router reaches it only over the TCP member
+    transport, and it re-announces itself after router restarts or
+    healed partitions."""
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": str(REPO_ROOT),
+        "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+        "SEMMERGE_DAEMON": "off",
+        "SEMMERGE_FLEET_JOIN_INTERVAL": "0.5",
+    })
+    for key in ("SEMMERGE_FAULT", "SEMMERGE_STRICT", "SEMMERGE_RESOLVE",
+                "SEMMERGE_METRICS", "SEMMERGE_SERVICE_SOCKET"):
+        env.pop(key, None)
+    if extra_env:
+        env.update(extra_env)
+    log_path = pathlib.Path(workdir) / f"member-{member_id}.log"
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "semantic_merge_tpu", "serve",
+         "--socket", "tcp://127.0.0.1:0", "--join", router_sock,
+         "--member-id", member_id],
+        stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+        cwd="/", env=env, start_new_session=True)
+    log.close()
+    return proc
+
+
+def wait_member(sock_path: str, member_id: str, *, in_ring: bool,
+                timeout: float = 120.0) -> dict:
+    """Wait until the router's view of ``member_id`` reaches (or, for
+    ``in_ring=False``, leaves) the ring."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status = daemon_status(sock_path)
+        view = next((m for m in (status or {}).get("members", [])
+                     if m.get("id") == member_id), None)
+        if in_ring and view is not None and view.get("in_ring"):
+            return view
+        if not in_ring and (view is None or not view.get("in_ring")):
+            return view or {}
+        time.sleep(0.2)
+    raise RuntimeError(
+        f"member {member_id} never became "
+        f"{'ring member' if in_ring else 'ejected'} within {timeout:g}s")
+
+
 def audit_wal(wal_dir: str) -> List[str]:
     """Exactly-once accounting over the full retained WAL history.
 
@@ -654,28 +730,60 @@ def audit_wal(wal_dir: str) -> List[str]:
     return errors
 
 
+#: Requests carved out of the budget for each special (churn /
+#: partition) phase so ``requests`` stays the total fired.
+_PHASE_BURST = 4
+
+
 def run_fleet_soak(workdir: pathlib.Path, *, requests: int = 40,
                    repos: int = 6, concurrency: int = 6,
                    members: int = 3, member_kills: int = 2,
-                   router_kills: int = 1, seed: int = 1
-                   ) -> Dict[str, Any]:
+                   router_kills: int = 1, seed: int = 1,
+                   tcp_members: int = 0, partitions: int = 0,
+                   churn: bool = False) -> Dict[str, Any]:
     """Fleet kill-drill: randomized member SIGKILLs plus a router
     SIGKILL mid-stream (the replacement router reclaims the orphaned
     members, replays the WAL, and keeps serving). Every request must
     settle byte-exact with documented exits only; the WAL history must
-    account for every effect exactly once."""
+    account for every effect exactly once.
+
+    ``tcp_members`` adds standalone daemons joined over real TCP;
+    ``partitions`` SIGSTOPs one of them (half-open link: the heartbeat,
+    not the dial, must eject it — a ``reason="partition"`` failover)
+    while traffic keeps settling on the survivors, then SIGCONTs it and
+    waits for the rejoin; ``churn`` performs one elastic TCP join and
+    one drain mid-load."""
+    if partitions and tcp_members < 1:
+        raise ValueError("--partitions needs at least one --tcp-members "
+                         "(the half-open victim is a TCP member)")
+    special_phases = partitions + (1 if churn else 0)
+    main_requests = requests - _PHASE_BURST * special_phases
+    kill_events = (["member"] * member_kills + ["router"] * router_kills)
+    if main_requests < len(kill_events) + 2:
+        raise ValueError(f"requests={requests} too small for "
+                         f"{special_phases} special phase(s) plus "
+                         f"{len(kill_events)} kill(s)")
     rng = random.Random(seed)
     workdir = pathlib.Path(workdir)
     workdir.mkdir(parents=True, exist_ok=True)
     repo_paths = [build_repo(workdir / f"repo{i}") for i in range(repos)]
     sock = str(workdir / "fleet.sock")
     wal_dir = sock + ".semmerge-fleet-wal"
-    router = spawn_fleet_router(sock, members=members)
+    router_env: Dict[str, str] = {}
+    if partitions:
+        # Partition ejection is heartbeat-paced: tighten the deadline so
+        # the half-open victim is detected in ~3 probes, not ~3×2s.
+        router_env["SEMMERGE_FLEET_HEARTBEAT_TIMEOUT"] = "0.75"
+    router = spawn_fleet_router(sock, members=members,
+                                extra_env=router_env)
+    tcp_procs: Dict[str, subprocess.Popen] = {}
+    stopped: Dict[str, subprocess.Popen] = {}
 
     stats: Dict[str, Any] = {
         "lock": threading.Lock(), "transport_retries": 0,
         "shed_retries": 0, "outcomes": {}, "bad_responses": [],
         "member_kills": 0, "router_kills": 0,
+        "partitions": 0, "joins": 0, "drains": 0,
         "router_pids_seen": set(), "member_pids_seen": set(),
     }
     report: Dict[str, Any] = {"requests": requests, "errors": []}
@@ -683,18 +791,21 @@ def run_fleet_soak(workdir: pathlib.Path, *, requests: int = 40,
     try:
         status = wait_fleet(sock, router, min_members=members)
         stats["router_pids_seen"].add(status["pid"])
+        for i in range(tcp_members):
+            mid = f"t{i}"
+            tcp_procs[mid] = spawn_tcp_member(sock, workdir, mid)
+            wait_member(sock, mid, in_ring=True)
+        status = daemon_status(sock) or status
         for m in status.get("members", []):
             if m.get("pid"):
                 stats["member_pids_seen"].add(m["pid"])
 
         schedule = []
-        for _ in range(requests):
+        for _ in range(main_requests):
             shape = FLEET_SHAPES[rng.randrange(len(FLEET_SHAPES))]
             schedule.append((repo_paths[rng.randrange(repos)], shape))
-        kill_events = (["member"] * member_kills
-                       + ["router"] * router_kills)
-        lo, hi = requests // 4, max(requests // 4 + len(kill_events),
-                                    3 * requests // 4)
+        lo = main_requests // 4
+        hi = max(lo + len(kill_events), 3 * main_requests // 4)
         kill_points = sorted(
             zip(rng.sample(range(lo, hi), len(kill_events)),
                 rng.sample(kill_events, len(kill_events))))
@@ -725,21 +836,50 @@ def run_fleet_soak(workdir: pathlib.Path, *, requests: int = 40,
                         f"{name}: exit {code!r} not in documented "
                         f"{allowed} ({resp.get('error') or ''})")
 
+        def launch(repo: pathlib.Path, shape) -> None:
+            sem.acquire()
+            t = threading.Thread(target=fire, args=(repo, shape))
+            t.start()
+            threads.append(t)
+
+        def drain_inflight() -> None:
+            for t in threads:
+                t.join(timeout=300)
+            del threads[:]
+
+        def burst(n: int) -> None:
+            for _ in range(n):
+                shape = FLEET_SHAPES[rng.randrange(len(FLEET_SHAPES))]
+                launch(repo_paths[rng.randrange(repos)], shape)
+            drain_inflight()
+
         for i, (repo, shape) in enumerate(schedule):
             while kill_points and i == kill_points[0][0]:
                 _, what = kill_points.pop(0)
                 if what == "member":
-                    status = daemon_status(sock)
-                    live = [m for m in (status or {}).get("members", [])
-                            if m.get("pid") and m.get("in_ring")]
-                    if live:
+                    # Only supervised members are SIGKILL fodder — a
+                    # killed remote has no supervisor to bring it back.
+                    # Poll briefly: the kill point may land right after
+                    # a router respawn, before any child is back up.
+                    victim_deadline = time.monotonic() + 60.0
+                    while time.monotonic() < victim_deadline:
+                        status = daemon_status(sock)
+                        live = [m for m in
+                                (status or {}).get("members", [])
+                                if m.get("pid") and m.get("in_ring")
+                                and not m.get("remote")]
+                        if not live:
+                            time.sleep(0.2)
+                            continue
                         victim = live[rng.randrange(len(live))]
                         try:
                             os.kill(victim["pid"], signal.SIGKILL)
-                            with stats["lock"]:
-                                stats["member_kills"] += 1
                         except OSError:
-                            pass
+                            time.sleep(0.2)
+                            continue
+                        with stats["lock"]:
+                            stats["member_kills"] += 1
+                        break
                 else:
                     try:
                         os.kill(router.pid, signal.SIGKILL)
@@ -748,15 +888,56 @@ def run_fleet_soak(workdir: pathlib.Path, *, requests: int = 40,
                             stats["router_kills"] += 1
                     except OSError:
                         pass
-                    router = spawn_fleet_router(sock, members=members)
-            sem.acquire()
-            t = threading.Thread(target=fire, args=(repo, shape))
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join(timeout=300)
+                    router = spawn_fleet_router(sock, members=members,
+                                                extra_env=router_env)
+            launch(repo, shape)
+        drain_inflight()
 
-        final = wait_fleet(sock, router, min_members=members)
+        if churn:
+            # One elastic join + one drain mid-load: the newcomer
+            # announces itself into a warm ring (moved keys handed
+            # off), serves a burst, then is drained — a deliberate
+            # leave, never a failure eject.
+            cj = spawn_tcp_member(sock, workdir, "cj0")
+            tcp_procs["cj0"] = cj
+            wait_member(sock, "cj0", in_ring=True)
+            stats["joins"] += 1
+            burst(_PHASE_BURST // 2)
+            ack = control(sock, "drain", {"member": "cj0"}, timeout=10.0)
+            if not (ack or {}).get("ok"):
+                report["errors"].append(
+                    f"drain of churn member not acked: {ack!r}")
+            wait_member(sock, "cj0", in_ring=False)
+            stats["drains"] += 1
+            burst(_PHASE_BURST - _PHASE_BURST // 2)
+
+        for p in range(partitions):
+            # Half-open partition: SIGSTOP keeps the victim's sockets
+            # accepting (kernel backlog) while reads never complete, so
+            # only the application-level heartbeat can detect it. Drain
+            # in-flight work first — the drill measures detection and
+            # failover, not a 600s dispatch stall.
+            victim_id = f"t{p % tcp_members}"
+            victim = tcp_procs[victim_id]
+            try:
+                os.kill(victim.pid, signal.SIGSTOP)
+            except OSError:
+                continue
+            stopped[victim_id] = victim
+            stats["partitions"] += 1
+            try:
+                wait_member(sock, victim_id, in_ring=False)
+                burst(_PHASE_BURST)
+            finally:
+                try:
+                    os.kill(victim.pid, signal.SIGCONT)
+                except OSError:
+                    pass
+                stopped.pop(victim_id, None)
+            wait_member(sock, victim_id, in_ring=True)
+
+        expected_up = members + tcp_members
+        final = wait_fleet(sock, router, min_members=expected_up)
         stats["router_pids_seen"].add(final["pid"])
         for m in final.get("members", []):
             if m.get("pid"):
@@ -774,13 +955,21 @@ def run_fleet_soak(workdir: pathlib.Path, *, requests: int = 40,
         final = daemon_status(sock) or final
         counters = (final.get("metrics") or {}).get("counters", {})
 
-        def _counter_total(name):
+        def _counter_total(name, **labels):
             series = counters.get(name, {}).get("series")
             if series is None:
                 return None
-            return sum(s["value"] for s in series)
+            return sum(s["value"] for s in series
+                       if all((s.get("labels") or {}).get(k) == v
+                              for k, v in labels.items()))
 
         report["failovers_total"] = _counter_total("fleet_failovers_total")
+        report["partition_failovers"] = _counter_total(
+            "fleet_failovers_total", reason="partition")
+        report["drain_failovers"] = _counter_total(
+            "fleet_failovers_total", reason="drain")
+        report["joins_total"] = _counter_total("fleet_joins_total")
+        report["handoffs_total"] = _counter_total("fleet_handoffs_total")
         report["rehash_moves_total"] = _counter_total(
             "fleet_rehash_moves_total")
         report["wal_replayed_total"] = _counter_total(
@@ -792,6 +981,20 @@ def run_fleet_soak(workdir: pathlib.Path, *, requests: int = 40,
                 f"{report['wal_open']} WAL entries still open after "
                 f"settling — journaled effects unaccounted for")
     finally:
+        for proc in stopped.values():
+            try:
+                os.kill(proc.pid, signal.SIGCONT)
+            except OSError:
+                pass
+        for proc in tcp_procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in tcp_procs.values():
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
         if router.poll() is None:
             router.send_signal(signal.SIGTERM)
             try:
@@ -807,6 +1010,10 @@ def run_fleet_soak(workdir: pathlib.Path, *, requests: int = 40,
     report["shed_retries"] = stats["shed_retries"]
     report["member_kills"] = stats["member_kills"]
     report["router_kills"] = stats["router_kills"]
+    report["tcp_members"] = tcp_members
+    report["partitions"] = stats["partitions"]
+    report["churn_joins"] = stats["joins"]
+    report["churn_drains"] = stats["drains"]
     report["router_pids_seen"] = len(stats["router_pids_seen"])
     report["member_pids_seen"] = len(stats["member_pids_seen"])
     report["errors"].extend(stats["bad_responses"])
@@ -816,6 +1023,17 @@ def run_fleet_soak(workdir: pathlib.Path, *, requests: int = 40,
     if stats["router_kills"] and report["router_pids_seen"] < 2:
         report["errors"].append(
             "router was SIGKILLed but no replacement pid was observed")
+    if stats["partitions"] and not report.get("partition_failovers"):
+        report["errors"].append(
+            "a member was partitioned (SIGSTOP) but no "
+            'reason="partition" failover was counted')
+    if stats["drains"] and not report.get("drain_failovers"):
+        report["errors"].append(
+            'a member was drained but no reason="drain" failover was '
+            "counted")
+    if (tcp_members or stats["joins"]) and not report.get("joins_total"):
+        report["errors"].append(
+            "TCP members joined but fleet_joins_total stayed zero")
     report["ok"] = not report["errors"]
     return report
 
@@ -837,6 +1055,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="Fleet members (with --fleet)")
     parser.add_argument("--router-kills", type=int, default=1,
                         help="Router SIGKILLs mid-stream (with --fleet)")
+    parser.add_argument("--tcp-members", type=int, default=0,
+                        help="Standalone members joined over TCP "
+                             "(with --fleet)")
+    parser.add_argument("--partitions", type=int, default=0,
+                        help="SIGSTOP partitions of a TCP member "
+                             "(with --fleet; needs --tcp-members)")
+    parser.add_argument("--churn", action="store_true",
+                        help="One elastic TCP join + one drain "
+                             "mid-load (with --fleet)")
     parser.add_argument("--workdir", default=None,
                         help="Scratch dir (default: a fresh temp dir)")
     parser.add_argument("--json", action="store_true",
@@ -852,7 +1079,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             workdir, requests=args.requests, repos=args.repos,
             concurrency=args.concurrency, members=args.members,
             member_kills=args.kills, router_kills=args.router_kills,
-            seed=args.seed)
+            seed=args.seed, tcp_members=args.tcp_members,
+            partitions=args.partitions, churn=args.churn)
     else:
         report = run_soak(workdir, requests=args.requests,
                           repos=args.repos,
@@ -864,6 +1092,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"fleet soak: {report['requests']} requests, "
               f"{report['member_kills']} member kills, "
               f"{report['router_kills']} router kills, "
+              f"{report['partitions']} partitions, "
+              f"{report['churn_joins']} joins, "
+              f"{report['churn_drains']} drains, "
               f"{report['transport_retries']} transport retries, "
               f"{report['elapsed_s']}s -> "
               f"{'OK' if report['ok'] else 'FAIL'}")

@@ -21,6 +21,8 @@ Subcommand modes for the request-tracing artifacts::
         .semmerge-conflicts.json [...]
     python scripts/check_trace_schema.py validate_fleet \
         STATUS_OR_TRACE_JSON [...]
+    python scripts/check_trace_schema.py validate_transport \
+        STATUS_OR_TRACE_JSON [...]
     python scripts/check_trace_schema.py validate_fleet_trace \
         SEMMERGE_FLEET_TRACE_DIR/<trace_id>.json [...]
     python scripts/check_trace_schema.py validate_export \
@@ -31,9 +33,10 @@ otherwise. The tier-1 suite imports :func:`validate_trace` /
 :func:`validate_events` / :func:`validate_bench` / :func:`validate_batch`
 / :func:`validate_request_traces` / :func:`validate_postmortem` /
 :func:`validate_slo` / :func:`validate_conflicts` /
-:func:`validate_fleet` / :func:`validate_fleet_trace` /
-:func:`validate_export` directly (``tests/test_trace_schema.py``), so
-trace-format drift fails CI before it reaches a consumer.
+:func:`validate_fleet` / :func:`validate_transport` /
+:func:`validate_fleet_trace` / :func:`validate_export` directly
+(``tests/test_trace_schema.py``), so trace-format drift fails CI
+before it reaches a consumer.
 
 Dependency-free on purpose: the schema IS this file plus the runbook
 table, not a jsonschema document that could drift separately.
@@ -252,9 +255,14 @@ RESOLUTION_GATES = ("recompose", "parity", "typecheck", "format")
 #: ``fleet.hedge`` records each hedge-race leg's outcome (won/lost);
 #: ``fleet.wal_fsync`` the pre-dispatch journal fsync;
 #: ``fleet.relay`` one member round-trip leg;
-#: ``fleet.hedge_wait`` the p99-derived delay before a hedge launch.
+#: ``fleet.hedge_wait`` the p99-derived delay before a hedge launch;
+#: ``fleet.join`` one remote member admitted via the join handshake;
+#: ``fleet.handoff`` one rehashed repo key prewarmed onto its new
+#: owner; ``fleet.heartbeat`` a transport heartbeat edge (recorded on
+#: probe failures and on the recovery after them, not every probe).
 FLEET_SPANS = ("fleet.route", "fleet.failover", "fleet.hedge",
-               "fleet.wal_fsync", "fleet.relay", "fleet.hedge_wait")
+               "fleet.wal_fsync", "fleet.relay", "fleet.hedge_wait",
+               "fleet.join", "fleet.handoff", "fleet.heartbeat")
 
 #: Required meta keys per fleet span name.
 FLEET_SPAN_META = {
@@ -264,6 +272,9 @@ FLEET_SPAN_META = {
     "fleet.wal_fsync": (),
     "fleet.relay": ("member",),
     "fleet.hedge_wait": (),
+    "fleet.join": ("member", "address", "capacity"),
+    "fleet.handoff": ("member", "reason", "ok"),
+    "fleet.heartbeat": ("member", "outcome"),
 }
 
 #: Documented ``fleet.relay`` outcomes: the leg answered first
@@ -274,8 +285,11 @@ FLEET_RELAY_OUTCOMES = ("ok", "late", "transport")
 #: Documented ``fleet_failovers_total`` / ``fleet.failover`` reasons:
 #: supervisor reaped the child (``crash``), a dispatch hit a dead
 #: socket (``transport``), the heartbeat probe failed repeatedly
-#: (``health``), the member was told to drain (``drain``).
-FLEET_FAILOVER_REASONS = ("crash", "transport", "health", "drain")
+#: (``health``), the member was told to drain (``drain``), heartbeats
+#: read-timed-out against a half-open connection (``partition``), a
+#: remote member deliberately left the fleet (``leave``).
+FLEET_FAILOVER_REASONS = ("crash", "transport", "health", "drain",
+                          "partition", "leave")
 
 #: Label keys of the fleet metric series (``fleet/router.py``). The
 #: ``fleet_members`` gauge is the live ring size (unlabeled, >= 0);
@@ -289,6 +303,30 @@ FLEET_METRIC_LABELS = {
     "fleet_scrape_errors_total": ("member",),
     "fleet_trace_dropped_total": (),
 }
+
+#: Label keys of the cross-host transport metric series
+#: (``fleet/transport.py`` + the router's membership counters).
+TRANSPORT_METRIC_LABELS = {
+    "fleet_transport_errors_total": ("op",),
+    "fleet_transport_resends_total": (),
+    "fleet_heartbeats_total": ("outcome",),
+    "fleet_handoffs_total": ("reason",),
+    "fleet_affinity_misses_total": (),
+    "fleet_joins_total": (),
+}
+
+#: Documented ``fleet_transport_errors_total`` op label values
+#: (``fleet/transport.py`` OPS).
+TRANSPORT_OPS = ("dial", "read", "control", "heartbeat")
+
+#: Documented ``fleet_heartbeats_total`` / ``fleet.heartbeat`` outcome
+#: values (``fleet/transport.py`` HEARTBEAT_OUTCOMES).
+TRANSPORT_HEARTBEAT_OUTCOMES = ("ok", "connect", "timeout", "error")
+
+#: Documented ``fleet_handoffs_total`` / ``fleet.handoff`` reasons —
+#: the ring change that moved the keys being prewarmed.
+TRANSPORT_HANDOFF_REASONS = ("join", "leave", "crash", "transport",
+                             "health", "partition", "drain")
 
 #: Documented WAL record kinds (``fleet/wal.py``).
 FLEET_WAL_KINDS = ("request", "dispatch", "ack")
@@ -997,6 +1035,116 @@ def validate_fleet(data: Any) -> List[str]:
     return errors
 
 
+def validate_transport(data: Any) -> List[str]:
+    """Validate the cross-host transport records of a trace/events or
+    metrics-shaped artifact (``fleet/transport.py`` + the router's
+    membership plane): the ``fleet.join`` / ``fleet.handoff`` /
+    ``fleet.heartbeat`` spans carry their documented meta with values
+    from the documented sets, the ``fleet_transport_*`` and membership
+    counters carry their documented label sets (ops from
+    ``TRANSPORT_OPS``, heartbeat outcomes from
+    ``TRANSPORT_HEARTBEAT_OUTCOMES``, handoff reasons from
+    ``TRANSPORT_HANDOFF_REASONS``), and ``fleet_member_draining`` is a
+    member-labeled 0/1 gauge."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["transport: top level must be a JSON object"]
+    for i, row in enumerate(data.get("spans", [])):
+        if not isinstance(row, dict):
+            continue
+        name = row.get("name")
+        if name not in ("fleet.join", "fleet.handoff", "fleet.heartbeat"):
+            continue
+        meta = row.get("meta")
+        if not isinstance(meta, dict):
+            errors.append(f"trace.spans[{i}]: {name} needs meta")
+            continue
+        for key in FLEET_SPAN_META[name]:
+            if key not in meta:
+                errors.append(f"trace.spans[{i}]: {name} meta missing "
+                              f"{key!r}")
+        member = meta.get("member")
+        if "member" in meta and (not isinstance(member, str)
+                                 or not member):
+            errors.append(f"trace.spans[{i}]: {name} meta 'member' must "
+                          f"be a non-empty string")
+        if name == "fleet.join":
+            address = meta.get("address")
+            if "address" in meta and (not isinstance(address, str)
+                                      or not address):
+                errors.append(f"trace.spans[{i}]: fleet.join meta "
+                              f"'address' must be a non-empty string")
+            capacity = meta.get("capacity")
+            if "capacity" in meta and (
+                    not isinstance(capacity, int)
+                    or isinstance(capacity, bool) or capacity < 1):
+                errors.append(f"trace.spans[{i}]: fleet.join meta "
+                              f"'capacity' must be an int >= 1")
+        if name == "fleet.handoff":
+            reason = meta.get("reason")
+            if "reason" in meta and reason not in \
+                    TRANSPORT_HANDOFF_REASONS:
+                errors.append(f"trace.spans[{i}]: fleet.handoff reason "
+                              f"{reason!r} not in "
+                              f"{TRANSPORT_HANDOFF_REASONS}")
+            if "ok" in meta and not isinstance(meta["ok"], bool):
+                errors.append(f"trace.spans[{i}]: fleet.handoff meta "
+                              f"'ok' must be a boolean")
+        if name == "fleet.heartbeat":
+            outcome = meta.get("outcome")
+            if "outcome" in meta and outcome not in \
+                    TRANSPORT_HEARTBEAT_OUTCOMES:
+                errors.append(f"trace.spans[{i}]: fleet.heartbeat outcome "
+                              f"{outcome!r} not in "
+                              f"{TRANSPORT_HEARTBEAT_OUTCOMES}")
+    metrics = data.get("metrics", data)
+    if isinstance(metrics, dict):
+        counters = metrics.get("counters", {})
+        if not isinstance(counters, dict):
+            counters = {}
+        for name, labels in TRANSPORT_METRIC_LABELS.items():
+            m = counters.get(name)
+            if not isinstance(m, dict):
+                continue
+            for j, s in enumerate(m.get("series", [])):
+                got = tuple(sorted((s.get("labels") or {}).keys()))
+                if got != tuple(sorted(labels)):
+                    errors.append(f"metrics.counters.{name}[{j}]: labels "
+                                  f"{got} != documented "
+                                  f"{tuple(sorted(labels))}")
+        label_values = (
+            ("fleet_transport_errors_total", "op", TRANSPORT_OPS),
+            ("fleet_heartbeats_total", "outcome",
+             TRANSPORT_HEARTBEAT_OUTCOMES),
+            ("fleet_handoffs_total", "reason",
+             TRANSPORT_HANDOFF_REASONS),
+        )
+        for name, label, allowed in label_values:
+            m = counters.get(name)
+            if not isinstance(m, dict):
+                continue
+            for j, s in enumerate(m.get("series", [])):
+                value = (s.get("labels") or {}).get(label)
+                if value not in allowed:
+                    errors.append(f"metrics.counters.{name}[{j}]: "
+                                  f"{label} {value!r} not in {allowed}")
+        gauges = metrics.get("gauges", {})
+        draining = gauges.get("fleet_member_draining") \
+            if isinstance(gauges, dict) else None
+        if isinstance(draining, dict):
+            for j, s in enumerate(draining.get("series", [])):
+                got = tuple(sorted((s.get("labels") or {}).keys()))
+                if got != ("member",):
+                    errors.append(
+                        f"metrics.gauges.fleet_member_draining[{j}]: "
+                        f"labels {got} != ('member',)")
+                if s.get("value") not in (0, 0.0, 1, 1.0):
+                    errors.append(
+                        f"metrics.gauges.fleet_member_draining[{j}]: "
+                        f"value must be 0 or 1")
+    return errors
+
+
 def validate_fleet_trace(data: Any) -> List[str]:
     """Validate one *stitched* fleet-trace artifact
     (``SEMMERGE_FLEET_TRACE_DIR/<trace_id>.json``): span rows conform,
@@ -1599,6 +1747,20 @@ def main(argv: List[str]) -> int:
                 with open(path, encoding="utf-8") as fh:
                     errors.extend(f"{path}: {e}" for e in
                                   validate_fleet(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
+    if argv and argv[0] == "validate_transport":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_transport "
+                  "STATUS_OR_TRACE_JSON [...]", file=sys.stderr)
+            return 2
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_transport(json.load(fh)))
             except (OSError, json.JSONDecodeError) as exc:
                 errors.append(f"{path}: unreadable ({exc})")
         return _finish(errors)

@@ -153,10 +153,30 @@ def build_parser() -> argparse.ArgumentParser:
                                   "on a unix socket (see runbook: Service "
                                   "mode)")
     p_serve.add_argument("--socket", default=None,
-                         help="Unix socket path (default: "
+                         help="Unix socket path or tcp://host:port "
+                              "(tcp://host:0 picks an ephemeral port; "
+                              "mTLS via SEMMERGE_FLEET_TLS_*). Default: "
                               "SEMMERGE_SERVICE_SOCKET, else "
                               "$XDG_RUNTIME_DIR/semmerge.sock, else "
                               "/tmp/semmerge-<uid>.sock)")
+    p_serve.add_argument("--join", default=None, metavar="ROUTER",
+                         help="Announce this daemon to a fleet router "
+                              "(unix path or tcp://host:port) and keep "
+                              "re-announcing every "
+                              "SEMMERGE_FLEET_JOIN_INTERVAL seconds — "
+                              "elastic membership instead of a "
+                              "router-spawned subprocess")
+    p_serve.add_argument("--advertise", default=None, metavar="ADDR",
+                         help="Address the router should dial this "
+                              "member on (default: the bound --socket; "
+                              "set it when NAT/bind-all makes the bound "
+                              "address undialable)")
+    p_serve.add_argument("--capacity", type=int, default=None,
+                         help="Relative capacity announced in the join "
+                              "handshake (default 1)")
+    p_serve.add_argument("--member-id", default=None,
+                         help="Stable member id to join as (default: "
+                              "router-assigned r1, r2, …)")
     p_serve.add_argument("--workers", type=int, default=None,
                          help="Executor threads (SEMMERGE_SERVICE_WORKERS, "
                               "default 4)")
@@ -192,12 +212,15 @@ def build_parser() -> argparse.ArgumentParser:
              "failover, a durable dispatch WAL, and hedged reads (see "
              "runbook: Fleet mode)")
     p_fleet.add_argument("--socket", default=None,
-                         help="Client-facing unix socket (same resolution "
-                              "chain as serve); members bind "
-                              "<socket>.m0, .m1, …")
+                         help="Client-facing unix socket or "
+                              "tcp://host:port (same resolution chain "
+                              "as serve; mTLS via SEMMERGE_FLEET_TLS_*);"
+                              " local members bind <socket>.m0, .m1, …")
     p_fleet.add_argument("--members", type=int, default=None,
-                         help="Member daemons to supervise "
-                              "(SEMMERGE_FLEET_MEMBERS, default 3)")
+                         help="Local member daemons to supervise "
+                              "(SEMMERGE_FLEET_MEMBERS, default 3; 0 = "
+                              "pure-remote fleet serving only members "
+                              "that `semmerge serve --join` in)")
     p_fleet.add_argument("--workers", type=int, default=None,
                          help="Executor threads per member "
                               "(SEMMERGE_SERVICE_WORKERS, default 4)")
@@ -213,6 +236,11 @@ def build_parser() -> argparse.ArgumentParser:
                          help="Drain one member (e.g. m1) out of a running "
                               "fleet and exit; 'all' drains the router "
                               "itself")
+    p_fleet.add_argument("--leave", default=None, metavar="MEMBER",
+                         help="Remove a joined remote member (by id or "
+                              "advertised address) from a running fleet "
+                              "and exit — the deliberate-departure path: "
+                              "its keys hand off, no failover is counted")
 
     p_stats = sub.add_parser("stats",
                              help="Pretty-print a semmerge trace/metrics "
@@ -945,7 +973,11 @@ def cmd_serve(args: argparse.Namespace) -> int:
     from .service.daemon import Daemon
     daemon = Daemon(socket_path=args.socket, workers=args.workers,
                     queue_size=args.queue, idle_exit=args.idle_exit,
-                    events_path=args.events)
+                    events_path=args.events,
+                    join=getattr(args, "join", None),
+                    advertise=getattr(args, "advertise", None),
+                    capacity=getattr(args, "capacity", None),
+                    member_id=getattr(args, "member_id", None))
     return daemon.serve_forever()
 
 
@@ -969,6 +1001,16 @@ def cmd_fleet(args: argparse.Namespace) -> int:
         try:
             result = service_client.call_control("drain", params=params,
                                                  path=args.socket)
+        except service_client.DaemonUnavailable as exc:
+            print(f"semmerge fleet: no router running ({exc})",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(result, indent=2, default=str))
+        return 0 if result.get("ok") else 1
+    if getattr(args, "leave", None):
+        try:
+            result = service_client.call_control(
+                "leave", params={"member": args.leave}, path=args.socket)
         except service_client.DaemonUnavailable as exc:
             print(f"semmerge fleet: no router running ({exc})",
                   file=sys.stderr)
@@ -1154,9 +1196,16 @@ def _stats_fleet(args: argparse.Namespace, service_client) -> int:
         if not isinstance(st, dict):
             print(f"member {member_id}: unreachable")
             continue
+        state = st.get("state")
+        if not st.get("ok"):
+            # draining is a deliberate departure; dead is a failure —
+            # the rollup keeps them distinct.
+            print(f"member {member_id}: {state or 'unreachable'}")
+            continue
         decl_rate = st.get("declcache_hit_rate", 0.0) or 0.0
         res_rate = (st.get("residency") or {}).get("hit_rate", 0.0) or 0.0
-        print(f"member {member_id}: pid={st.get('pid')} "
+        print(f"member {member_id}: "
+              f"{state or 'ready'} pid={st.get('pid')} "
               f"served={st.get('served_total', 0)} "
               f"queue_depth={st.get('queue_depth', 0)} "
               f"in_flight={st.get('in_flight', 0)} "

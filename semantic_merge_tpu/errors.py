@@ -36,6 +36,9 @@ code  meaning
       request under ``SEMMERGE_FLEET=require``
 20    ``RenderFault`` — device-side op-log rendering failed under
       ``SEMMERGE_DEVICE_RENDER=require``
+21    ``TransportFault`` — a cross-host fleet transport call failed
+      (dial refused, read deadline, half-open partition) under
+      ``SEMMERGE_FLEET=require``
 ====  =============================================================
 
 Codes 10-17 are only ever *exit* codes in strict mode (or, for
@@ -181,6 +184,21 @@ class RenderFault(MergeFault):
     default_stage = "render"
 
 
+class TransportFault(MergeFault):
+    """A cross-host fleet transport call (``fleet/transport.py``)
+    failed: the dial was refused or timed out, a read deadline expired,
+    or an application-level heartbeat declared the connection half-open
+    (partition). Under the default ``auto`` posture the caller degrades
+    through the existing ladder — the router health-ejects the member
+    and replays its WAL entries onto survivors; the client falls back
+    to the single-daemon / in-process path — so this fault only
+    surfaces as an exit under ``SEMMERGE_FLEET=require``, where the
+    transport is the contract."""
+
+    exit_code = 21
+    default_stage = "transport"
+
+
 #: Fault class each pipeline stage wraps *unexpected* exceptions into.
 STAGE_FAULTS = {
     "snapshot": ParseFault,
@@ -218,6 +236,15 @@ STAGE_FAULTS = {
     "fleet:dispatch": FleetFault,
     "fleet:failover": FleetFault,
     "fleet:replay": FleetFault,
+    # Cross-host member transport (fleet/transport.py): dial, read,
+    # heartbeat, and injected net:* stages all classify as
+    # TransportFault so the posture seam (auto → ladder fallthrough,
+    # require → exit 21) sees one fault type for the network.
+    "transport": TransportFault,
+    "net:connect": TransportFault,
+    "net:read": TransportFault,
+    "net:partition": TransportFault,
+    "net:slow": TransportFault,
     # Conflict-resolution tier (resolve/): propose/verify classify as
     # ResolveFault so the CLI's containment (auto → conflict-as-result,
     # require → exit 17) sees one fault type for the whole tier.
@@ -240,7 +267,7 @@ STAGE_FAULTS = {
 EXIT_CODES = {cls.__name__: cls.exit_code for cls in
               (ParseFault, KernelFault, WorkerFault, ApplyFault,
                FormatFault, DeadlineFault, BatchFault, ResolveFault,
-               MeshFault, FleetFault, RenderFault)}
+               MeshFault, FleetFault, RenderFault, TransportFault)}
 
 
 def fault_for_stage(stage: str) -> type:

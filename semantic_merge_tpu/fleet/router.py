@@ -20,10 +20,21 @@ Request flow::
   member. Failover order and hedge targets come from the same ranking.
 - **Membership**: a health thread ticks every member's supervisor,
   probes liveness (the member's loopback ``/healthz`` when its
-  ephemeral telemetry port is known, the socket ``hello`` handshake
-  otherwise), ejects failed or draining members from the ring
-  (counting the keys whose owner moved — ``fleet_rehash_moves_total``)
-  and re-admits them when they come back.
+  ephemeral telemetry port is known, the application-level transport
+  heartbeat otherwise), ejects failed or draining members from the
+  ring (counting the keys whose owner moved —
+  ``fleet_rehash_moves_total``) and re-admits them when they come
+  back. Membership is *elastic*: besides the router-spawned local
+  members, remote daemons (usually on other hosts, over the
+  ``tcp://`` transport — :mod:`fleet.transport`) announce themselves
+  with a ``join`` handshake carrying capacity and affinity epoch, are
+  probed by the same heartbeats (a half-open link — dial succeeds,
+  reads never answer — ejects with reason ``partition``), and depart
+  with ``leave``/drain. Every ring change triggers an incremental
+  *affinity handoff*: keys whose rendezvous owner moved are prewarmed
+  onto the new owner (bounded by ``SEMMERGE_FLEET_HANDOFF_MAX``) so
+  post-churn requests land warm — ``fleet_affinity_misses_total``
+  over routed requests is the fleetwan bench's rehash miss rate.
 - **Durability**: every verb request is journaled to the router's WAL
   before first dispatch and acked after the response is written
   toward the client; a router restart replays unacked entries to
@@ -59,7 +70,8 @@ import urllib.request
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..errors import FleetFault, MergeFault, fault_boundary
+from ..errors import (FleetFault, MergeFault, TransportFault,
+                      fault_boundary)
 from ..obs import export as obs_export
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
@@ -70,7 +82,7 @@ from ..service.supervisor import MemberSupervisor
 from ..utils import faults
 from ..utils.loggingx import logger
 from ..utils.procs import env_seconds
-from . import hashring, wal as fleet_wal
+from . import hashring, transport, wal as fleet_wal
 
 _MEMBERS_HELP = "Fleet members currently in the routing ring"
 _FAILOVERS_HELP = "Fleet failovers (member ejections/re-dispatches), by reason"
@@ -78,6 +90,11 @@ _REHASH_HELP = "Repo keys whose owner moved on a membership change"
 _HEDGES_HELP = "Hedged dispatches issued for slow primaries"
 _HEDGE_WINS_HELP = "Hedged dispatches where the hedge answered first"
 _REPLAY_HELP = "WAL entries replayed after a router restart"
+_HANDOFFS_HELP = "Affinity handoffs (prewarms of moved keys), by reason"
+_MISSES_HELP = "Routed requests that landed on a cold (non-warm) member"
+_JOINS_HELP = "Member join handshakes accepted"
+_DRAINING_HELP = ("Members alive but draining (1=draining) — "
+                  "deliberate departures, not failures")
 
 #: Health-probe failures before a member is ejected from the ring.
 _EJECT_AFTER = 3
@@ -136,30 +153,69 @@ def _env_float(name: str, default: float) -> float:
 
 
 class _MemberTransport(Exception):
-    """A member connection died mid-request (crash, SIGKILL, garbage) —
-    the failover trigger, never surfaced to the client directly."""
+    """A member connection died mid-request (crash, SIGKILL, garbage,
+    partition) — the failover trigger, never surfaced to the client
+    directly. ``reason`` feeds the failover counter/span:
+    ``transport`` for connect-shaped loss, ``partition`` when the
+    connection was up but the read deadline expired (half-open)."""
+
+    def __init__(self, message: str, reason: str = "transport") -> None:
+        super().__init__(message)
+        self.reason = reason
 
 
 class _Member:
-    """Router-side view of one member daemon."""
+    """Router-side view of one member daemon — a local child under a
+    :class:`MemberSupervisor`, or a remote (``sup=None``) daemon that
+    announced itself over the transport and is supervised elsewhere."""
 
-    def __init__(self, member_id: str, socket_path: str,
-                 sup: MemberSupervisor) -> None:
+    def __init__(self, member_id: str, address: str,
+                 sup: Optional[MemberSupervisor] = None,
+                 capacity: int = 1, epoch: int = 0) -> None:
         self.id = member_id
-        self.socket_path = socket_path
+        self.address = address
         self.sup = sup
+        self.remote = sup is None
         self.in_ring = False
         self.draining = False
+        self.dead = False
         self.fail_streak = 0
+        self.last_fault: Optional[str] = None
+        self.capacity = capacity
+        self.epoch = epoch
         self.metrics_port: Optional[int] = None
         self.dispatches = 0
 
+    @property
+    def socket_path(self) -> str:
+        return self.address
+
+    def state(self) -> str:
+        """``ready`` (serving, in ring), ``draining`` (alive but
+        refusing new work — NOT a failure), ``dead`` (crashed, ejected,
+        or partitioned), or ``starting`` (known, not yet admitted)."""
+        if self.dead:
+            return "dead"
+        if self.draining:
+            return "draining"
+        if self.in_ring:
+            return "ready"
+        return "starting"
+
     def view(self) -> Dict[str, Any]:
-        return {"id": self.id, "socket": self.socket_path,
-                "pid": self.sup.pid, "in_ring": self.in_ring,
+        return {"id": self.id, "socket": self.address,
+                "pid": self.sup.pid if self.sup is not None else None,
+                "in_ring": self.in_ring,
                 "draining": self.draining,
-                "restarts": self.sup.restarts,
-                "last_rc": self.sup.last_rc,
+                "state": self.state(),
+                "remote": self.remote,
+                "capacity": self.capacity,
+                "epoch": self.epoch,
+                "last_fault": self.last_fault,
+                "restarts": self.sup.restarts if self.sup is not None
+                else None,
+                "last_rc": self.sup.last_rc if self.sup is not None
+                else None,
                 "metrics_port": self.metrics_port,
                 "dispatches": self.dispatches}
 
@@ -176,7 +232,9 @@ class FleetRouter:
         self._socket_path = protocol.socket_path(socket_path)
         n = members if members is not None else \
             _env_int("SEMMERGE_FLEET_MEMBERS", 3)
-        self._n = max(1, n)
+        # 0 local members is a pure-remote fleet: every member arrives
+        # over the transport with a join handshake.
+        self._n = max(0, n)
         self._workers = workers
         self._queue_size = queue_size
         self._wal = fleet_wal.WriteAheadLog(
@@ -205,6 +263,20 @@ class FleetRouter:
         self._health_interval = env_seconds(
             "SEMMERGE_FLEET_HEALTH_INTERVAL", 0.5)
         self._request_timeout = env_seconds("SEMMERGE_FLEET_TIMEOUT", 600.0)
+        # Cross-host transport knobs (fleet/transport.py): per-call
+        # connect deadline, bounded idempotency-keyed resends, and the
+        # application-level heartbeat deadline that declares half-open
+        # connections dead.
+        self._connect_timeout = transport.connect_timeout()
+        self._resends = transport.resends()
+        self._heartbeat_timeout = transport.heartbeat_timeout()
+        self._handoff_max = _env_int("SEMMERGE_FLEET_HANDOFF_MAX", 256)
+        # Warm-affinity tracking: key → member ids that have served it.
+        # A dispatch to a non-warm member is an affinity miss; ring
+        # changes hand moved keys off to their new owners (prewarm).
+        self._warm: Dict[str, set] = {}
+        self._affinity_epoch = 0
+        self._remote_seq = 0
         self._telemetry: Optional[telemetry.TelemetryServer] = None
         # Trace stitching: one router-side recorder per request grafts
         # the router's own fleet spans together with the span trees the
@@ -256,8 +328,26 @@ class FleetRouter:
         env.pop("SEMMERGE_SERVICE_SOCKET", None)
         return env
 
+    def _member_socket(self, member_id: str) -> str:
+        """Local members always speak AF_UNIX; when the router itself
+        binds ``tcp://`` their sockets derive from the WAL directory
+        instead of the (meaningless as a path) router address."""
+        if transport.is_tcp(self._socket_path):
+            return os.path.join(self._wal.directory, f"{member_id}.sock")
+        return f"{self._socket_path}.{member_id}"
+
     def _bind(self) -> Optional[socket.socket]:
         path = self._socket_path
+        if transport.is_tcp(path):
+            try:
+                return transport.listen(path)
+            except OSError:
+                probe = transport.dial(path, timeout=2.0)
+                if probe is not None:
+                    with contextlib.suppress(OSError):
+                        probe.close()
+                    return None
+                raise
         if os.path.exists(path):
             probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             probe.settimeout(2.0)
@@ -296,10 +386,10 @@ class FleetRouter:
             pass  # not the main thread (test embedding)
         pending = self._wal.open()
         for i in range(self._n):
-            self._reclaim_orphan(f"{self._socket_path}.m{i}")
+            self._reclaim_orphan(self._member_socket(f"m{i}"))
         for i in range(self._n):
             member_id = f"m{i}"
-            member_sock = f"{self._socket_path}.{member_id}"
+            member_sock = self._member_socket(member_id)
             sup = MemberSupervisor(member_id,
                                    self.member_argv(member_sock),
                                    env=self._member_env(member_id))
@@ -380,8 +470,9 @@ class FleetRouter:
         self._draining = True
         with contextlib.suppress(OSError):
             sock.close()
-        with contextlib.suppress(OSError):
-            os.unlink(self._socket_path)
+        if not transport.is_tcp(self._socket_path):
+            with contextlib.suppress(OSError):
+                os.unlink(self._socket_path)
         drain = env_seconds("SEMMERGE_SERVICE_DRAIN_TIMEOUT", 30.0)
         deadline = time.monotonic() + drain if drain > 0 else None
         while True:
@@ -394,10 +485,11 @@ class FleetRouter:
                 break
             time.sleep(0.05)
         for m in self._members:
-            m.sup.terminate()
+            if m.sup is not None:
+                m.sup.terminate()
         child_deadline = time.monotonic() + (drain if drain > 0 else 30.0)
         for m in self._members:
-            proc = m.sup.proc
+            proc = m.sup.proc if m.sup is not None else None
             if proc is None:
                 continue
             remain = child_deadline - time.monotonic()
@@ -449,6 +541,13 @@ class FleetRouter:
             member.in_ring = up
             after = [m.id for m in self._members if m.in_ring]
             seen = list(self._seen_set)
+            self._affinity_epoch += 1
+            if not up:
+                # The member's warm state is suspect the moment it
+                # leaves the ring (a crash respawns it cold); rejoin
+                # re-warms through dispatches and handoffs.
+                for warm in self._warm.values():
+                    warm.discard(member.id)
         moved = hashring.moved_keys(seen, before, after)
         gauge = obs_metrics.REGISTRY.gauge("fleet_members", _MEMBERS_HELP)
         gauge.set(len(after))
@@ -481,12 +580,55 @@ class FleetRouter:
         else:
             logger.info("fleet member %s joined; ring=%s", member.id,
                         after)
+        if moved and after and not self._draining:
+            # Incremental affinity handoff, off the caller's path: the
+            # moved keys' new owners get prewarmed so post-churn
+            # requests land warm instead of cold.
+            threading.Thread(
+                target=self._handoff,
+                args=(sorted(moved), list(after),
+                      "join" if up else reason),
+                daemon=True, name="fleet-handoff").start()
+
+    def _handoff(self, moved: List[str], ring: List[str],
+                 reason: str) -> None:
+        """Prewarm each moved key onto its new rendezvous owner
+        (bounded by ``SEMMERGE_FLEET_HANDOFF_MAX``) — the incremental
+        rebalance that drives the post-churn rehash miss rate under
+        the fleetwan gate instead of letting every moved key fault in
+        cold."""
+        for key in moved[:self._handoff_max]:
+            if self._stop.is_set() or self._draining:
+                return
+            owner_id = hashring.owner(key, ring)
+            owner = self._member_by_id(owner_id) if owner_id else None
+            if owner is None or not owner.in_ring:
+                continue
+            with self._ring_lock:
+                if owner.id in self._warm.get(key, set()):
+                    continue
+            t0 = time.perf_counter()
+            result = self._member_call(owner, "prewarm", {"cwd": key},
+                                       timeout=10.0)
+            ok = bool(result and result.get("ok"))
+            if ok:
+                with self._ring_lock:
+                    self._warm.setdefault(key, set()).add(owner.id)
+            obs_metrics.REGISTRY.counter(
+                "fleet_handoffs_total", _HANDOFFS_HELP).inc(
+                    1, reason=reason)
+            obs_spans.record("fleet.handoff", time.perf_counter() - t0,
+                             layer="fleet", member=owner.id,
+                             reason=reason, ok=ok)
 
     def _probe(self, member: _Member) -> Tuple[bool, bool]:
         """(alive, draining) — /healthz over the member's loopback
-        telemetry port when known, the socket hello handshake
-        otherwise. A degraded (503) health answer is still *alive*:
-        SLO burn is not a membership event."""
+        telemetry port when known, the application-level transport
+        heartbeat otherwise. A degraded (503) health answer is still
+        *alive*: SLO burn is not a membership event. A heartbeat
+        failure stamps ``member.last_fault`` so the eject can
+        distinguish a dead member (``connect``) from a partitioned
+        half-open one (``read-timeout``)."""
         if member.metrics_port:
             try:
                 req = urllib.request.Request(
@@ -500,9 +642,23 @@ class FleetRouter:
                 member.metrics_port = None
             except Exception:
                 member.metrics_port = None  # port gone: re-discover
-        hello = self._member_call(member, "hello", {}, timeout=2.0)
-        if hello is None:
+        t0 = time.perf_counter()
+        try:
+            hello = transport.heartbeat(member.address,
+                                        timeout=self._heartbeat_timeout)
+        except TransportFault as exc:
+            member.last_fault = str(exc.cause or "connect")
+            obs_spans.record(
+                "fleet.heartbeat", time.perf_counter() - t0,
+                layer="fleet", member=member.id,
+                outcome="timeout" if exc.cause == "read-timeout"
+                else "connect" if exc.cause == "connect" else "error")
             return False, False
+        if member.last_fault is not None:
+            member.last_fault = None
+            obs_spans.record("fleet.heartbeat",
+                             time.perf_counter() - t0, layer="fleet",
+                             member=member.id, outcome="ok")
         return True, bool(hello.get("draining"))
 
     def _discover_port(self, member: _Member) -> None:
@@ -513,27 +669,12 @@ class FleetRouter:
     def _member_call(self, member: _Member, method: str,
                      params: Dict[str, Any],
                      timeout: float) -> Optional[Dict[str, Any]]:
-        """One control round-trip to a member; ``None`` on any failure."""
-        try:
-            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            conn.settimeout(timeout)
-            conn.connect(member.socket_path)
-        except OSError:
-            return None
-        try:
-            rfile = conn.makefile("r", encoding="utf-8")
-            wfile = conn.makefile("w", encoding="utf-8")
-            protocol.write_message(wfile, {"id": 0, "method": method,
-                                           "params": params})
-            resp = protocol.read_message(rfile)
-            if resp is None or "result" not in resp:
-                return None
-            return resp["result"]
-        except (OSError, ValueError, protocol.ProtocolError):
-            return None
-        finally:
-            with contextlib.suppress(OSError):
-                conn.close()
+        """One control round-trip to a member over the transport
+        (bounded jittered resends inside); ``None`` on any failure."""
+        return transport.call(member.address, method, params,
+                              timeout=min(timeout,
+                                          self._connect_timeout),
+                              read_deadline=timeout)
 
     def _health_loop(self) -> None:
         metrics_interval = env_seconds("SEMMERGE_OTLP_METRICS_INTERVAL",
@@ -555,23 +696,26 @@ class FleetRouter:
                         "fleet SLO burn: %s (fast %sx, slow %sx)",
                         r.get("objective"), r.get("burn_fast"),
                         r.get("burn_slow"))
-            for member in self._members:
+            for member in list(self._members):
                 if self._draining:
                     return
-                event = member.sup.ensure()
-                if event == "died":
-                    member.metrics_port = None
-                    member.fail_streak = 0
-                    self._set_ring(member, False, "crash")
-                    continue
-                if event == "spawned":
-                    member.fail_streak = 0
-                    continue
-                if not member.sup.running():
-                    continue
+                if member.sup is not None:
+                    event = member.sup.ensure()
+                    if event == "died":
+                        member.metrics_port = None
+                        member.fail_streak = 0
+                        member.dead = True
+                        self._set_ring(member, False, "crash")
+                        continue
+                    if event == "spawned":
+                        member.fail_streak = 0
+                        continue
+                    if not member.sup.running():
+                        continue
                 alive, draining = self._probe(member)
                 if alive:
                     member.fail_streak = 0
+                    member.dead = False
                     if member.metrics_port is None:
                         self._discover_port(member)
                     member.draining = draining
@@ -581,9 +725,16 @@ class FleetRouter:
                         self._set_ring(member, True, "join")
                 else:
                     member.fail_streak += 1
-                    if member.in_ring and \
-                            member.fail_streak >= _EJECT_AFTER:
-                        self._set_ring(member, False, "health")
+                    if member.fail_streak >= _EJECT_AFTER:
+                        member.dead = True
+                        if member.in_ring:
+                            # A half-open link (dial ok, reads dead) is
+                            # a partition; a refused dial is a death.
+                            self._set_ring(
+                                member, False,
+                                "partition"
+                                if member.last_fault == "read-timeout"
+                                else "health")
 
     def _await_ring(self, timeout: float) -> List[str]:
         deadline = time.monotonic() + timeout
@@ -640,10 +791,19 @@ class FleetRouter:
                         "result": {
                             "router": self.status(),
                             "members": {
-                                m.id: self._member_call(m, "status", {},
-                                                        timeout=5.0)
-                                for m in self._members},
+                                m.id: self._member_status_block(m)
+                                for m in list(self._members)},
                         }})
+                    continue
+                if method == "join":
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": self._join_verb(params)})
+                    continue
+                if method == "leave":
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": self._leave_verb(params)})
                     continue
                 if method == "drain":
                     protocol.write_message(wfile, {
@@ -720,7 +880,9 @@ class FleetRouter:
         with self._ring_lock:
             if key not in self._seen_set:
                 if len(self._seen_keys) == self._seen_keys.maxlen:
-                    self._seen_set.discard(self._seen_keys[0])
+                    evicted = self._seen_keys[0]
+                    self._seen_set.discard(evicted)
+                    self._warm.pop(evicted, None)
                 self._seen_keys.append(key)
                 self._seen_set.add(key)
         rec = obs_spans.SpanRecorder(detailed=False) if self._stitch \
@@ -772,25 +934,33 @@ class FleetRouter:
                 response, winner, hedged_won = self._send(
                     target, hedge_target, method, params, rec,
                     attempts + 1)
-            except _MemberTransport:
+            except _MemberTransport as dead:
                 attempts += 1
                 tried.add(target.id)
-                self._set_ring(target, False, "transport")
+                target.dead = True
+                self._set_ring(target, False, dead.reason)
                 obs_metrics.REGISTRY.counter(
                     "fleet_failovers_total", _FAILOVERS_HELP).inc(
-                        1, reason="transport")
+                        1, reason=dead.reason)
                 obs_spans.record("fleet.failover",
                                  time.monotonic() - t0, layer="fleet",
-                                 t_start=t0_pc, reason="transport",
+                                 t_start=t0_pc, reason=dead.reason,
                                  member=target.id, attempt=attempts)
                 if attempts >= max_attempts:
                     raise FleetFault(
                         f"dispatch failed on {attempts} members",
-                        stage="fleet:failover", cause="transport")
+                        stage="fleet:failover", cause=dead.reason)
                 continue
             dt = time.monotonic() - t0
             self._latencies.append(dt)
             winner.dispatches += 1
+            with self._ring_lock:
+                warm = self._warm.setdefault(key, set())
+                cold = winner.id not in warm
+                warm.add(winner.id)
+            if cold:
+                obs_metrics.REGISTRY.counter(
+                    "fleet_affinity_misses_total", _MISSES_HELP).inc(1)
             obs_spans.record("fleet.route", dt, layer="fleet",
                              t_start=t0_pc, verb=method, member=winner.id,
                              attempt=attempts + 1)
@@ -837,7 +1007,8 @@ class FleetRouter:
         def leg(member: _Member, is_hedge: bool) -> None:
             t0 = time.perf_counter()
             try:
-                resp = self._member_verb(member, method, params, conns)
+                resp = self._member_verb(member, method, params, conns,
+                                         abandoned=done.is_set)
             except _MemberTransport:
                 if rec is not None:
                     obs_spans.record_into(
@@ -934,37 +1105,69 @@ class FleetRouter:
 
     def _member_verb(self, member: _Member, method: str,
                      params: Dict[str, Any],
-                     conns: Dict[str, socket.socket]) -> Dict[str, Any]:
-        """One verb round-trip; raises :class:`_MemberTransport` on any
-        transport-shaped failure. A well-formed ``result`` *or typed*
-        ``error`` frame is a final answer and passes through."""
-        try:
-            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                     conns: Dict[str, socket.socket],
+                     abandoned=None) -> Dict[str, Any]:
+        """One verb round-trip over the member transport; raises
+        :class:`_MemberTransport` once the bounded resend budget
+        (``SEMMERGE_FLEET_RESENDS``, jittered exponential backoff
+        between tries) is spent. A well-formed ``result`` *or typed*
+        ``error`` frame is a final answer and passes through. Resends
+        are safe because every fleet request carries an idempotency
+        key — a member that already executed the first send replays
+        its recorded response instead of executing twice. ``abandoned``
+        (the hedge race's ``done``) stops resends once another leg has
+        settled the request."""
+        last_cause = "connect"
+        for resend in range(self._resends + 1):
+            if resend:
+                if abandoned is not None and abandoned():
+                    break  # the race is settled; don't re-dispatch
+                transport.count_resend()
+                time.sleep(transport.backoff_s(resend - 1))
+            try:
+                conn = transport.dial(member.address,
+                                      timeout=self._connect_timeout)
+            except TransportFault as exc:
+                last_cause = str(exc.cause or "connect")
+                continue
+            if conn is None:
+                last_cause = "connect"
+                continue
             conn.settimeout(self._request_timeout)
-            conn.connect(member.socket_path)
-        except OSError as exc:
-            raise _MemberTransport(str(exc)) from exc
-        conns[member.id] = conn
-        try:
-            rfile = conn.makefile("r", encoding="utf-8")
-            wfile = conn.makefile("w", encoding="utf-8")
-            protocol.write_message(wfile, {"id": 1, "method": method,
-                                           "params": params})
-            resp = protocol.read_message(rfile)
-        except (OSError, ValueError, protocol.ProtocolError) as exc:
-            raise _MemberTransport(str(exc)) from exc
-        finally:
-            conns.pop(member.id, None)
-            with contextlib.suppress(OSError):
-                conn.close()
-        if resp is None:
-            raise _MemberTransport("member closed the connection")
-        if "result" in resp:
-            return {"result": resp["result"]}
-        error = resp.get("error")
-        if isinstance(error, dict) and "exit_code" in error:
-            return {"error": error}  # typed: the member's final answer
-        raise _MemberTransport(f"malformed member response: {resp!r}")
+            conns[member.id] = conn
+            try:
+                rfile = conn.makefile("r", encoding="utf-8")
+                wfile = conn.makefile("w", encoding="utf-8")
+                protocol.write_message(wfile, {"id": 1, "method": method,
+                                               "params": params})
+                transport.check_read_faults()
+                resp = protocol.read_message(rfile)
+            except socket.timeout:
+                last_cause = "read-timeout"
+                continue
+            except TransportFault as exc:
+                last_cause = str(exc.cause or "transport")
+                continue
+            except (OSError, ValueError, protocol.ProtocolError) as exc:
+                last_cause = type(exc).__name__
+                continue
+            finally:
+                conns.pop(member.id, None)
+                with contextlib.suppress(OSError):
+                    conn.close()
+            if resp is None:
+                last_cause = "eof"
+                continue
+            if "result" in resp:
+                return {"result": resp["result"]}
+            error = resp.get("error")
+            if isinstance(error, dict) and "exit_code" in error:
+                return {"error": error}  # typed: the final answer
+            last_cause = "malformed"
+        raise _MemberTransport(
+            f"member {member.id} unreachable ({last_cause})",
+            reason="partition" if last_cause == "read-timeout"
+            else "transport")
 
     # ------------------------------------------------------------------
     # replay
@@ -1051,11 +1254,20 @@ class FleetRouter:
         a wedged member must not wedge the fleet scrape."""
         up = obs_metrics.REGISTRY.gauge(
             "fleet_member_up", "Ring membership by member (1=in ring)")
-        for m in self._members:
-            up.set(1.0 if m.in_ring else 0.0, member=m.id)
+        draining = obs_metrics.REGISTRY.gauge(
+            "fleet_member_draining", _DRAINING_HELP)
+        for m in list(self._members):
+            # A draining member is alive and deliberate — it must NOT
+            # read as a failure in the rollups (fleet_member_up alerts
+            # fire on dead members, not on drains).
+            state = m.state()
+            up.set(1.0 if state in ("ready", "draining") else 0.0,
+                   member=m.id)
+            draining.set(1.0 if state == "draining" else 0.0,
+                         member=m.id)
         parts = [_label_member(
             obs_metrics.REGISTRY.render_prometheus(), "router")]
-        for m in self._members:
+        for m in list(self._members):
             port = m.metrics_port
             if not port:
                 continue
@@ -1074,6 +1286,111 @@ class FleetRouter:
 
     # ------------------------------------------------------------------
     # control verbs
+
+    def _next_remote_id(self) -> str:
+        # caller holds _ring_lock
+        self._remote_seq += 1
+        return f"r{self._remote_seq}"
+
+    def _join_verb(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Admit (or refresh) a remote member: validate the announced
+        address with a heartbeat, add it to the ring, and hand moved
+        keys off. Idempotent — members re-announce periodically, which
+        doubles as rejoin after a healed partition or router restart."""
+        address = str(params.get("address") or "").strip()
+        if not address:
+            return {"ok": False, "error": "join needs an address"}
+        try:
+            capacity = max(1, int(params.get("capacity") or 1))
+        except (TypeError, ValueError):
+            capacity = 1
+        try:
+            epoch = int(params.get("epoch") or 0)
+        except (TypeError, ValueError):
+            epoch = 0
+        want_id = str(params.get("member") or "").strip()
+        try:
+            transport.heartbeat(address,
+                                timeout=self._heartbeat_timeout)
+        except TransportFault as exc:
+            return {"ok": False,
+                    "error": f"join probe failed ({exc.cause}): {exc}"}
+        with self._ring_lock:
+            member = next((m for m in self._members
+                           if m.address == address), None)
+            fresh = member is None
+            if fresh:
+                member_id = want_id or self._next_remote_id()
+                if any(m.id == member_id for m in self._members):
+                    return {"ok": False,
+                            "error": f"member id {member_id!r} taken"}
+                member = _Member(member_id, address, sup=None,
+                                 capacity=capacity, epoch=epoch)
+                self._members.append(member)
+            else:
+                member.capacity, member.epoch = capacity, epoch
+            member.dead = False
+            member.draining = False
+            member.fail_streak = 0
+            member.last_fault = None
+        if fresh:
+            obs_metrics.REGISTRY.counter("fleet_joins_total",
+                                         _JOINS_HELP).inc(1)
+            obs_spans.record("fleet.join", 0.0, layer="fleet",
+                             member=member.id,
+                             address=transport.describe(address),
+                             capacity=capacity)
+            logger.info("fleet member %s joined from %s (capacity=%d)",
+                        member.id, transport.describe(address),
+                        capacity)
+        self._set_ring(member, True, "join")
+        return {"ok": True, "member": member.id, "fresh": fresh,
+                "ring": self._ring(), "epoch": self._affinity_epoch}
+
+    def _leave_verb(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Remove a remote member that announced its departure. Local
+        (supervised) members drain instead — the supervisor owns their
+        lifecycle."""
+        ident = str(params.get("member") or params.get("address")
+                    or "").strip()
+        with self._ring_lock:
+            member = next((m for m in self._members
+                           if m.id == ident or m.address == ident),
+                          None)
+        if member is None:
+            return {"ok": False, "error": f"unknown member {ident!r}"}
+        if member.sup is not None:
+            return {"ok": False,
+                    "error": "local members leave via drain/shutdown"}
+        member.draining = True
+        self._set_ring(member, False, "leave")
+        with self._ring_lock:
+            self._members = [m for m in self._members
+                             if m.id != member.id]
+        # The label series must not keep reporting the departed member
+        # as up/draining forever.
+        obs_metrics.REGISTRY.gauge(
+            "fleet_member_up",
+            "Ring membership by member (1=in ring)").set(
+                0.0, member=member.id)
+        obs_metrics.REGISTRY.gauge(
+            "fleet_member_draining",
+            _DRAINING_HELP).set(0.0, member=member.id)
+        logger.info("fleet member %s left (%s)", member.id,
+                    transport.describe(member.address))
+        return {"ok": True, "member": member.id, "ring": self._ring()}
+
+    def _member_status_block(self, m: _Member) -> Dict[str, Any]:
+        """One member's ``member_status`` entry: its own status payload
+        (when it answers) merged with the router-side ``state`` —
+        ``draining`` is a deliberate departure, ``dead`` a failure; the
+        aggregation must not lump them."""
+        status = self._member_call(m, "status", {}, timeout=5.0)
+        block: Dict[str, Any] = dict(status) \
+            if isinstance(status, dict) else {"ok": False}
+        block["state"] = m.state()
+        block["router_view"] = m.view()
+        return block
 
     def _drain_verb(self, params: Dict[str, Any]) -> Dict[str, Any]:
         member_id = params.get("member")
@@ -1094,6 +1411,7 @@ class FleetRouter:
     def status(self) -> Dict[str, Any]:
         with self._state_lock:
             in_flight, served = self._in_flight, self._served
+        members = list(self._members)
         return {
             "ok": True,
             "fleet": True,
@@ -1104,8 +1422,20 @@ class FleetRouter:
             "draining": self._draining,
             "in_flight": in_flight,
             "served_total": served,
-            "members": [m.view() for m in self._members],
+            "members": [m.view() for m in members],
             "members_up": len(self._ring()),
+            "members_draining": sum(1 for m in members
+                                    if m.state() == "draining"),
+            "members_dead": sum(1 for m in members
+                                if m.state() == "dead"),
+            "affinity_epoch": self._affinity_epoch,
+            "transport": {
+                "tls": transport.tls_enabled(),
+                "connect_timeout_s": self._connect_timeout,
+                "heartbeat_timeout_s": self._heartbeat_timeout,
+                "resends": self._resends,
+                "handoff_max": self._handoff_max,
+            },
             "wal": {"dir": self._wal.directory,
                     "open": self._wal.open_count(),
                     "replayed": self._replayed},

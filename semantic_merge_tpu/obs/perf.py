@@ -60,6 +60,12 @@ GUARDED_FIELDS = {
     "fleet_merges_per_sec_m1": "higher",
     "fleet_merges_per_sec_m3": "higher",
     "fleet_rehash_miss_rate": "lower",
+    # Fleetwan preset (cross-host fleet over TCP with injected dial
+    # latency): the post-churn rehash miss rate — cold dispatches after
+    # one elastic join + one drain, with the incremental handoff
+    # prewarming moved keys — must stay under the 0.15 gate instead of
+    # drifting back toward the unassisted ~1/N rendezvous rehash.
+    "fleetwan_rehash_miss_rate": "lower",
     # Tracecost preset, fleet leg: what the stitched observability
     # plane (member span shipping + router grafting + artifact/OTLP
     # sealing) costs a routed merge, as a percent of the dark fleet's

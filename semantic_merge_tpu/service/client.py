@@ -28,6 +28,16 @@ carries ``fleet: true``) and fails with the ``FleetFault`` exit (19)
 otherwise. ``off`` (default) leaves this module byte-identical to the
 fleet-less client.
 
+The fleet dial itself goes through :mod:`fleet.transport` (stdlib-only,
+so the milliseconds-fast client path keeps its import set): a
+``tcp://host:port`` service socket reaches a remote router (mTLS when
+``SEMMERGE_FLEET_TLS_*`` is configured), and the ``net:*`` fault stages
+fire at this seam. A :class:`~semantic_merge_tpu.errors.TransportFault`
+raised here is the network refusing to carry the request: under
+``SEMMERGE_FLEET=require`` it exits 21 with the work tree untouched;
+under ``auto`` the client degrades through the existing ladder
+(single daemon, then in-process) — byte-identical output.
+
 :func:`delegate` is called from ``__main__`` BEFORE ``cli`` (and
 therefore jax) is imported — the client path costs milliseconds, which
 is the whole point of the warm daemon.
@@ -44,6 +54,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import protocol
+from ..errors import TransportFault  # stdlib-only module: stays cheap
 
 #: Exit for ``require`` mode with no usable daemon — the WorkerFault
 #: code (errors.EXIT_CODES), hardcoded so this module never imports
@@ -106,6 +117,16 @@ def delegate(argv: Sequence[str]) -> Optional[int]:
         try:
             return _run_on_daemon(argv[0], argv[1:], spawn=False,
                                   require_fleet=True)
+        except TransportFault as exc:
+            # The transport itself refused to carry the request (an
+            # injected net:* fault, a mid-handshake break). Nothing has
+            # executed and the work tree is untouched: require exits
+            # with the TransportFault code, auto degrades through the
+            # same ladder a missing router takes.
+            if fm == "require":
+                sys.stderr.write(f"semmerge: fleet transport failed: "
+                                 f"{exc} (exit {exc.exit_code})\n")
+                return exc.exit_code
         except DaemonUnavailable as exc:
             if fm == "require":
                 sys.stderr.write(f"semmerge: fleet required but "
@@ -151,6 +172,7 @@ def _run_on_daemon(verb: str, rest: List[str], *, spawn: bool = True,
     # idempotent response and the original execution share one trace.
     trace_id = os.urandom(8).hex()
     attempt = 0
+    backoff = 0.0
     while True:
         try:
             return _attempt_on_daemon(verb, rest, deadline, idem_key,
@@ -171,9 +193,20 @@ def _run_on_daemon(verb: str, rest: List[str], *, spawn: bool = True,
         except DaemonUnavailable:
             if attempt >= retries:
                 raise
-            time.sleep(min(0.05 * (2 ** attempt)
-                           * random.uniform(0.5, 1.5), 2.0))
+            backoff = _reconnect_backoff_s(backoff)
+            time.sleep(backoff)
         attempt += 1
+
+
+def _reconnect_backoff_s(prev: float, base: float = 0.05,
+                         cap: float = 2.0) -> float:
+    """Decorrelated-jitter reconnect backoff: ``min(cap, uniform(base,
+    prev * 3))``. The old fixed exponential schedule kept a herd of
+    clients that failed together re-arriving in lockstep (its ±50%
+    jitter band still clusters around the same powers of two); each
+    draw here depends on the *previous draw*, so the herd spreads out
+    within a retry or two."""
+    return min(cap, random.uniform(base, max(prev * 3.0, base)))
 
 
 def _attempt_on_daemon(verb: str, rest: List[str], deadline: float,
@@ -266,18 +299,41 @@ def _try_connect(path: str, timeout: float = 5.0,
     listening (absent socket, stale socket, or a peer that cannot
     complete the handshake). With ``require_fleet`` the peer must
     answer as a fleet router (``fleet: true`` in its hello) — a plain
-    daemon on the path counts as unusable."""
-    if not os.path.exists(path):
-        return None
-    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    sock.settimeout(timeout)
+    daemon on the path counts as unusable.
+
+    Fleet dials (and any ``tcp://`` address) go through the transport
+    seam, which handles TLS and fires the ``net:*`` fault stages: an
+    injected fault raises :class:`TransportFault` out of here (the
+    posture seam in :func:`delegate` turns it into exit 21 or ladder
+    fallthrough), while a *real* dead address stays ``None`` — the
+    same no-router shape as before."""
+    check_read = None
+    if require_fleet or path.startswith("tcp://"):
+        from ..fleet import transport as fleet_transport
+        sock = fleet_transport.dial(path, timeout=timeout)
+        if sock is None:
+            return None
+        check_read = fleet_transport.check_read_faults
+    else:
+        if not os.path.exists(path):
+            return None
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        try:
+            sock.connect(path)
+        except OSError:
+            with contextlib.suppress(OSError):
+                sock.close()
+            return None
     try:
-        sock.connect(path)
+        sock.settimeout(timeout)
         rfile = sock.makefile("r", encoding="utf-8")
         wfile = sock.makefile("w", encoding="utf-8")
         protocol.write_message(wfile, {
             "id": 0, "method": "hello",
             "params": {"version": protocol.PROTOCOL_VERSION}})
+        if check_read is not None:
+            check_read()
         resp = protocol.read_message(rfile)
     except (OSError, ValueError, protocol.ProtocolError):
         with contextlib.suppress(OSError):

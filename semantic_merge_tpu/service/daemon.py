@@ -29,6 +29,18 @@ socket left by a dead daemon is detected by a probe connect and
 replaced; a live daemon on the socket makes a second ``serve`` exit 0
 immediately. An idle daemon exits after ``SEMMERGE_SERVICE_IDLE_EXIT``
 seconds; idle per-repo state is reaped after ``SEMMERGE_SERVICE_TTL``.
+
+Cross-host membership (``fleet/transport.py``): a ``tcp://host:port``
+socket path listens on TCP (mTLS when ``SEMMERGE_FLEET_TLS_*`` is set;
+``:0`` picks an ephemeral port, resolved before anything is
+advertised). ``--join ROUTER_ADDR`` announces this daemon to a fleet
+router with a ``join`` handshake carrying the advertised address,
+capacity, and an announce epoch, re-announces every
+``SEMMERGE_FLEET_JOIN_INTERVAL`` seconds (so an ejected member rejoins
+by itself once reachable again), stops announcing while draining, and
+sends a best-effort ``leave`` on shutdown. The router prewarms moved
+repo keys onto their new owners through the cheap ``prewarm`` wire
+verb below.
 """
 from __future__ import annotations
 
@@ -40,6 +52,7 @@ import pathlib
 import queue
 import signal
 import socket
+import ssl
 import sys
 import threading
 import time
@@ -47,6 +60,7 @@ from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 from ..errors import DeadlineFault, MergeFault, WorkerFault, fault_boundary
+from ..fleet import transport as fleet_transport
 from ..obs import export as obs_export
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
@@ -173,8 +187,30 @@ class Daemon:
                  queue_size: Optional[int] = None,
                  idle_exit: Optional[float] = None,
                  repo_ttl: Optional[float] = None,
-                 events_path: Optional[str] = None) -> None:
+                 events_path: Optional[str] = None,
+                 join: Optional[str] = None,
+                 advertise: Optional[str] = None,
+                 capacity: Optional[int] = None,
+                 member_id: Optional[str] = None) -> None:
         self._socket_path = protocol.socket_path(socket_path)
+        # Elastic membership: announce to a fleet router instead of
+        # being a router-spawned subprocess. The advertised address
+        # defaults to the bound socket (resolved after an ephemeral
+        # :0 bind), so `--socket tcp://0.0.0.0:0 --join ...` just works
+        # on one host.
+        self._join_addr = (join or
+                           os.environ.get("SEMMERGE_FLEET_JOIN",
+                                          "").strip() or None)
+        self._advertise = (advertise or
+                           os.environ.get("SEMMERGE_FLEET_ADVERTISE",
+                                          "").strip() or None)
+        self._capacity = max(1, capacity if capacity is not None else
+                             _env_int("SEMMERGE_FLEET_CAPACITY", 1))
+        self._member_id = (member_id or
+                           os.environ.get("SEMMERGE_FLEET_MEMBER_ID",
+                                          "").strip() or None)
+        self._join_epoch = 0
+        self._joined_as: Optional[str] = None
         self._workers_n = workers if workers is not None else \
             max(1, _env_int("SEMMERGE_SERVICE_WORKERS", 4))
         qsize = queue_size if queue_size is not None else \
@@ -289,6 +325,9 @@ class Daemon:
         if self._telemetry is not None:
             logger.info("telemetry listening on 127.0.0.1:%d "
                         "(/metrics, /healthz)", self._telemetry.port)
+        if self._join_addr:
+            threading.Thread(target=self._join_loop, daemon=True,
+                             name="svc-fleet-join").start()
         logger.info("merge service listening on %s (%d workers, queue %d)",
                     self._socket_path, self._workers_n, self._queue.maxsize)
         try:
@@ -309,6 +348,23 @@ class Daemon:
 
     def _bind(self) -> Optional[socket.socket]:
         path = self._socket_path
+        if fleet_transport.is_tcp(path):
+            try:
+                sock = fleet_transport.listen(path)
+            except OSError:
+                # Port taken: a live daemon already serving there is
+                # the same "whoever raced us serves" outcome as the
+                # unix path; anything else is a real bind error.
+                probe = fleet_transport.dial(path, timeout=2.0)
+                if probe is not None:
+                    with contextlib.suppress(OSError):
+                        probe.close()
+                    return None
+                raise
+            # An ephemeral :0 bind resolves here so logs, status, and
+            # the join announce all advertise something dialable.
+            self._socket_path = fleet_transport.bound_address(sock, path)
+            return sock
         if os.path.exists(path):
             probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             probe.settimeout(2.0)
@@ -360,6 +416,11 @@ class Daemon:
             except socket.timeout:
                 self._maybe_idle_exit()
                 continue
+            except ssl.SSLError:
+                # One client's failed TLS handshake (no cert under
+                # mTLS, plaintext against a TLS listener) must not
+                # stop the accept loop.
+                continue
             except OSError:
                 break
             threading.Thread(target=self._handle_conn, args=(conn,),
@@ -384,8 +445,16 @@ class Daemon:
         # already connected keep their established connections.
         with contextlib.suppress(OSError):
             sock.close()
-        with contextlib.suppress(OSError):
-            os.unlink(self._socket_path)
+        if not fleet_transport.is_tcp(self._socket_path):
+            with contextlib.suppress(OSError):
+                os.unlink(self._socket_path)
+        if self._join_addr and self._joined_as:
+            # Deliberate departure: tell the router so the ring update
+            # is a "leave" (draining), not a heartbeat-timeout eject.
+            with contextlib.suppress(Exception):
+                fleet_transport.call(
+                    self._join_addr, "leave",
+                    {"member": self._joined_as}, timeout=2.0, retries=0)
         drain = env_seconds("SEMMERGE_SERVICE_DRAIN_TIMEOUT", 30.0)
         deadline = time.monotonic() + drain if drain > 0 else None
         while True:
@@ -439,15 +508,17 @@ class Daemon:
                 method = msg.get("method")
                 params = msg.get("params") or {}
                 if method == "hello":
+                    # The hello doubles as the fleet heartbeat: the
+                    # router's health probe reads `draining` off it to
+                    # tell a deliberate departure from a failure, so it
+                    # is always present — router-spawned, self-joined,
+                    # and standalone daemons alike.
                     hello = {"ok": True, "pid": os.getpid(),
-                             "version": protocol.PROTOCOL_VERSION}
-                    if self._fleet_member is not None:
-                        # Membership announce: a router's health probe
-                        # learns from the handshake that this daemon is
-                        # the member it spawned (and whether it is
-                        # already draining toward handoff).
-                        hello["fleet_member"] = self._fleet_member
-                        hello["draining"] = self._draining
+                             "version": protocol.PROTOCOL_VERSION,
+                             "draining": self._draining}
+                    member = self._fleet_member or self._joined_as
+                    if member is not None:
+                        hello["fleet_member"] = member
                     protocol.write_message(wfile,
                                            {"id": req_id, "result": hello})
                     continue
@@ -489,6 +560,17 @@ class Daemon:
                     protocol.write_message(wfile, {
                         "id": req_id,
                         "result": self._capture_profile(params)})
+                    continue
+                if method == "prewarm":
+                    # Incremental affinity handoff: the router warms a
+                    # rehashed repo key onto its new owner before real
+                    # traffic lands there. Deliberately cheap — resolve
+                    # the repo's HEAD tree (priming the OS page cache
+                    # over .git) without touching jax or the decl
+                    # cache; the first real request pays the rest.
+                    protocol.write_message(wfile, {
+                        "id": req_id,
+                        "result": self._prewarm(params)})
                     continue
                 if method == "shutdown":
                     protocol.write_message(wfile,
@@ -952,6 +1034,68 @@ class Daemon:
         finally:
             self._profile_lock.release()
 
+    def _prewarm(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Warm a repo key this daemon just became the owner of: one
+        ``git rev-parse`` against the repo, bounded and contained —
+        a prewarm failure is an answer, never a fault."""
+        cwd = str(params.get("cwd") or "").strip()
+        if not cwd or not os.path.isdir(cwd):
+            return {"ok": False, "cwd": cwd, "error": "no such directory"}
+        import subprocess
+        try:
+            proc = subprocess.run(
+                ["git", "-C", cwd, "rev-parse", "HEAD^{tree}"],
+                capture_output=True, text=True, timeout=10.0)
+        except (OSError, subprocess.SubprocessError) as exc:
+            return {"ok": False, "cwd": cwd,
+                    "error": f"{type(exc).__name__}: {exc}"}
+        if proc.returncode != 0:
+            return {"ok": False, "cwd": cwd,
+                    "error": (proc.stderr or "").strip()[:200]}
+        with self._locks_lock:
+            entry = self._repo_locks.setdefault(
+                cwd, {"lock": threading.Lock(), "last": 0.0})
+            entry["last"] = time.time()
+        return {"ok": True, "cwd": cwd,
+                "tree_oid": proc.stdout.strip()}
+
+    def _join_loop(self) -> None:
+        """Announce this daemon to the fleet router, then keep
+        re-announcing — the re-announce is also the rejoin path after
+        a partition-eject (the router resets the member's fail streak
+        and puts it back in the ring). Draining suppresses the
+        announce so a deliberate departure never looks alive-again."""
+        advertise = self._advertise or self._socket_path
+        interval = max(0.2, env_seconds("SEMMERGE_FLEET_JOIN_INTERVAL",
+                                        5.0))
+        while True:
+            if not self._draining:
+                self._join_epoch += 1
+                params = {"address": advertise,
+                          "capacity": self._capacity,
+                          "epoch": self._join_epoch}
+                if self._member_id:
+                    params["member"] = self._member_id
+                elif self._joined_as:
+                    params["member"] = self._joined_as
+                result = fleet_transport.call(
+                    self._join_addr, "join", params,
+                    timeout=fleet_transport.connect_timeout(),
+                    retries=0)
+                if result and result.get("ok"):
+                    member = str(result.get("member") or "")
+                    if member and member != self._joined_as:
+                        self._joined_as = member
+                        logger.info(
+                            "joined fleet %s as member %s "
+                            "(advertising %s)", self._join_addr,
+                            member, advertise)
+                elif result is not None:
+                    logger.warning("fleet join rejected: %s",
+                                   result.get("error"))
+            if self._stop.wait(interval):
+                return
+
     def _reaper(self) -> None:
         """Evict per-repo state idle past the TTL."""
         interval = max(1.0, min(self._repo_ttl / 2.0, 60.0))
@@ -997,7 +1141,18 @@ class Daemon:
             "served_total": served,
             "workers": self._workers_n,
             "draining": self._draining,
-            "fleet_member": self._fleet_member,
+            "fleet_member": self._fleet_member or self._joined_as,
+            "fleet_join": ({"router": self._join_addr,
+                            "advertise": (self._advertise
+                                          or self._socket_path),
+                            "capacity": self._capacity,
+                            "joined_as": self._joined_as,
+                            "announces": self._join_epoch}
+                           if self._join_addr else None),
+            "transport": ("tcp+tls" if fleet_transport.is_tcp(
+                self._socket_path) and fleet_transport.tls_enabled()
+                else "tcp" if fleet_transport.is_tcp(self._socket_path)
+                else "unix"),
             "repos_tracked": len(self._repo_locks),
             "rss_mb": round(_rss_mb(), 3),
             "metrics_port": (self._telemetry.port
@@ -1025,8 +1180,14 @@ def main(argv=None) -> int:  # pragma: no cover - thin alias
     import argparse
     parser = argparse.ArgumentParser(prog="semmerge-daemon")
     parser.add_argument("--socket", default=None)
+    parser.add_argument("--join", default=None)
+    parser.add_argument("--advertise", default=None)
+    parser.add_argument("--capacity", type=int, default=None)
+    parser.add_argument("--member-id", default=None)
     args = parser.parse_args(argv)
-    return Daemon(socket_path=args.socket).serve_forever()
+    return Daemon(socket_path=args.socket, join=args.join,
+                  advertise=args.advertise, capacity=args.capacity,
+                  member_id=args.member_id).serve_forever()
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -81,6 +81,14 @@ def serve_argv(args) -> List[str]:
         argv += ["--idle-exit", str(args.idle_exit)]
     if getattr(args, "events", None):
         argv += ["--events", str(args.events)]
+    if getattr(args, "join", None):
+        argv += ["--join", str(args.join)]
+    if getattr(args, "advertise", None):
+        argv += ["--advertise", str(args.advertise)]
+    if getattr(args, "capacity", None) is not None:
+        argv += ["--capacity", str(args.capacity)]
+    if getattr(args, "member_id", None):
+        argv += ["--member-id", str(args.member_id)]
     return argv
 
 

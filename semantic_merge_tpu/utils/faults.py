@@ -70,6 +70,23 @@ resolver:propose     ``resolve.engine`` inside the propose span
 resolver:verify      ``resolve.engine`` before the gate ladder
 ===================  ==================================================
 
+Network stages (``semantic_merge_tpu/fleet/transport.py``) fire at the
+transport seam every cross-host (and unix-socket) member call goes
+through, and parse the same compound way. All four classify as
+:class:`~semantic_merge_tpu.errors.TransportFault` (exit 21 under
+``SEMMERGE_FLEET=require``; ladder fallthrough under ``auto``):
+
+===================  ==================================================
+stage                call site
+===================  ==================================================
+net:connect          ``transport.dial`` — before the socket connect
+net:read             ``transport.Conn.request`` — before the reply read
+net:partition        both dial and read (half-open: the connect
+                     succeeds upstream but every read deadline expires)
+net:slow             dial — injects ``SEMMERGE_FAULT_NET_SLOW_S``
+                     (default 0.2 s) latency per call, then proceeds
+===================  ==================================================
+
 Inside the daemon the injection spec and the per-stage hit counters are
 read through the request overlay (:mod:`semantic_merge_tpu.utils.
 reqenv`): each request carries its client's ``SEMMERGE_FAULT`` and gets
@@ -107,7 +124,7 @@ ENV_VAR = "SEMMERGE_FAULT"
 #: Stage-name prefixes that contain a colon themselves (the service
 #: daemon's and batching subsystem's stages) — the parser joins the
 #: first two segments for these.
-COMPOUND_STAGE_PREFIXES = ("service", "batch", "resolver")
+COMPOUND_STAGE_PREFIXES = ("service", "batch", "resolver", "net")
 
 _counters: Dict[str, int] = {}
 

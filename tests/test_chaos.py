@@ -84,27 +84,41 @@ def test_chaos_full_soak(chaos_soak, tmp_path):
 
 
 def test_fleet_chaos_smoke(chaos_soak, tmp_path):
-    """The ISSUE 14 kill-drill: a 3-member fleet under byte-exact
-    traffic with one member SIGKILL and one router SIGKILL mid-stream.
-    The replacement router reclaims the orphaned members and replays
-    its WAL; every request settles byte-exact with documented exits,
-    and the full journal history accounts for each effect exactly
-    once."""
+    """The ISSUE 14 kill-drill plus the ISSUE 19 cross-host legs: a
+    fleet of 3 supervised members and one standalone member joined
+    over real TCP, under byte-exact traffic with one member SIGKILL
+    and one router SIGKILL mid-stream, one elastic TCP join + one
+    drain (churn), and one SIGSTOP partition of the TCP member — a
+    half-open link only the application heartbeat can eject, counted
+    as a reason="partition" failover. Every request settles byte-exact
+    with documented exits, the healed member rejoins, and the full
+    journal history accounts for each effect exactly once."""
     report = chaos_soak.run_fleet_soak(
         tmp_path / "fleet", requests=24, repos=4, concurrency=4,
-        members=3, member_kills=1, router_kills=1, seed=3)
+        members=3, member_kills=1, router_kills=1, seed=3,
+        tcp_members=1, partitions=1, churn=True)
     assert report["errors"] == [], "\n".join(report["errors"])
     assert report["ok"] is True
     total = sum(sum(per_code.values())
                 for per_code in report["outcomes"].values())
     assert total == 24
     # Kills landed and the fleet healed: failovers counted, a
-    # replacement router pid appeared, the ring refilled.
+    # replacement router pid appeared, the ring refilled (3 supervised
+    # + the healed TCP member; the churn member stays drained).
     assert report["member_kills"] == 1
     assert report["router_kills"] == 1
     assert report["failovers_total"] >= 1
     assert report["router_pids_seen"] >= 2
-    assert report["members_up"] == 3
+    assert report["members_up"] == 4
+    # The cross-host legs all landed: the partition was ejected by
+    # heartbeat (not a dial failure), the churn drain was a deliberate
+    # leave, and both TCP members were admitted via the join verb.
+    assert report["partitions"] == 1
+    assert report["partition_failovers"] >= 1
+    assert report["churn_joins"] == 1
+    assert report["churn_drains"] == 1
+    assert report["drain_failovers"] >= 1
+    assert report["joins_total"] >= 2
     # Exactly-once accounting: nothing left open in the journal.
     assert report["wal_open"] == 0
 
@@ -113,11 +127,14 @@ def test_fleet_chaos_smoke(chaos_soak, tmp_path):
 def test_fleet_chaos_full_drill(chaos_soak, tmp_path):
     report = chaos_soak.run_fleet_soak(
         tmp_path / "fleet", requests=120, repos=8, concurrency=8,
-        members=3, member_kills=3, router_kills=2, seed=11)
+        members=3, member_kills=3, router_kills=2, seed=11,
+        tcp_members=2, partitions=2, churn=True)
     assert report["errors"] == [], "\n".join(report["errors"])
     assert report["member_kills"] == 3
     assert report["router_kills"] == 2
     assert report["failovers_total"] >= 3
+    assert report["partitions"] == 2
+    assert report["partition_failovers"] >= 1
     assert report["wal_open"] == 0
 
 

@@ -15,14 +15,17 @@ including a real SIGKILL mid-commit resolved by ``semmerge --resume``),
 and schema validation of the ``degradation`` spans / fault metric
 series via ``scripts/check_trace_schema.py``.
 """
+import contextlib
 import hashlib
 import importlib.util
 import json
 import os
 import pathlib
 import signal
+import socket
 import subprocess
 import sys
+import threading
 
 import pytest
 
@@ -225,7 +228,7 @@ def test_exit_codes_documented_and_distinct():
                           "FormatFault": 14, "DeadlineFault": 15,
                           "BatchFault": 16, "ResolveFault": 17,
                           "MeshFault": 18, "FleetFault": 19,
-                          "RenderFault": 20}
+                          "RenderFault": 20, "TransportFault": 21}
     assert len(set(EXIT_CODES.values())) == len(EXIT_CODES)
     # Reserved result codes stay distinct from fault codes.
     assert not {0, 1, 2, 3} & set(EXIT_CODES.values())
@@ -308,6 +311,110 @@ def test_service_stages_registered_as_worker_faults():
     finally:
         os.environ.pop("SEMMERGE_FAULT", None)
         faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Net stages: the fleet transport seam (typed TransportFault, exit 21)
+# ---------------------------------------------------------------------------
+
+NET_FAULT_STAGES = ("net:connect", "net:read", "net:partition", "net:slow")
+
+
+def test_net_stages_registered_as_transport_faults():
+    from semantic_merge_tpu.errors import STAGE_FAULTS, TransportFault
+    assert TransportFault.exit_code == 21
+    for stage in ("transport",) + NET_FAULT_STAGES:
+        assert STAGE_FAULTS[stage] is TransportFault
+    # The compound stage survives SEMMERGE_FAULT's colon syntax.
+    faults.reset()
+    try:
+        os.environ["SEMMERGE_FAULT"] = "net:connect:fault"
+        with pytest.raises(TransportFault) as exc_info:
+            faults.check("net:connect")
+        assert exc_info.value.stage == "net:connect"
+        assert exc_info.value.cause == "injected"
+        assert exc_info.value.exit_code == 21
+    finally:
+        os.environ.pop("SEMMERGE_FAULT", None)
+        faults.reset()
+
+
+def _fleet_client_env(posture: str, sock: str, **extra) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEMMERGE_FLEET"] = posture
+    env["SEMMERGE_SERVICE_SOCKET"] = sock
+    env.pop("SEMMERGE_DAEMON", None)
+    env.pop("SEMMERGE_FAULT", None)
+    env.update(extra)
+    return env
+
+
+@pytest.fixture
+def sink_socket(tmp_path):
+    """A listener that accepts connections and never answers. The
+    ``net:read`` seam fires after a successful dial, so it needs
+    something on the other end of the socket — but never a reply."""
+    path = str(tmp_path / "sink.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(8)
+    held = []
+
+    def _accept():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            held.append(conn)
+
+    threading.Thread(target=_accept, daemon=True).start()
+    yield path
+    srv.close()
+    for conn in held:
+        with contextlib.suppress(OSError):
+            conn.close()
+
+
+def _run_fleet_merge(repo, env):
+    # delegate() runs in __main__ before the CLI imports, so the fleet
+    # transport seam is only reachable through a real subprocess.
+    return subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu", "semmerge",
+         "basebr", "brA", "brB", "--inplace", "--backend", "host"],
+        cwd=repo, capture_output=True, text=True, env=env)
+
+
+@pytest.mark.parametrize("stage", NET_FAULT_STAGES)
+def test_net_fault_require_fleet_exits_21_tree_untouched(repo, sink_socket,
+                                                         stage):
+    """Under ``SEMMERGE_FLEET=require`` every injected transport fault
+    is exit 21 with the work tree bitwise untouched: the fault fires in
+    the dial/read seam, before any merge work starts."""
+    before = tree_state(repo)
+    proc = _run_fleet_merge(repo, _fleet_client_env(
+        "require", sink_socket, SEMMERGE_FAULT=f"{stage}:fault"))
+    assert proc.returncode == 21, \
+        f"{stage}:fault must exit 21 under require: {proc.stderr}"
+    assert "fleet transport failed" in proc.stderr
+    assert tree_state(repo) == before, \
+        "a transport fault under require must leave the tree untouched"
+
+
+@pytest.mark.parametrize("stage", NET_FAULT_STAGES)
+def test_net_fault_auto_fleet_falls_back_byte_exact(repo, sink_socket,
+                                                    stage):
+    """Under ``SEMMERGE_FLEET=auto`` the same faults degrade through
+    the ladder: the client falls back in-process and the settled tree
+    is byte-exact against the independent textual oracle."""
+    expected = expected_textual_tree(repo)
+    proc = _run_fleet_merge(repo, _fleet_client_env(
+        "auto", sink_socket, SEMMERGE_FAULT=f"{stage}:fault"))
+    assert proc.returncode == 0, proc.stderr
+    assert tree_state(repo) == expected, \
+        "the auto-posture fallback must settle byte-exact"
 
 
 # ---------------------------------------------------------------------------

@@ -256,6 +256,8 @@ def test_daemon_status_reports_residency():
     d._workers_n = 0
     d._draining = False
     d._fleet_member = False
+    d._joined_as = d._join_addr = d._advertise = None
+    d._capacity, d._join_epoch = 1, 0
     d._repo_locks = {}
     d._telemetry = None
     d._slo = None

@@ -883,6 +883,141 @@ def test_fleet_trace_validator(schema, tmp_path):
     assert usage.returncode == 2
 
 
+def test_transport_records_validate(schema, tmp_path):
+    """The cross-host transport plane (ISSUE 19): the membership spans
+    (``fleet.join`` / ``fleet.handoff`` / ``fleet.heartbeat``), the
+    ``fleet_transport_*`` counters, and the ``fleet_member_draining``
+    gauge validate; drifted shapes (undocumented op/outcome/reason,
+    capacity < 1, labeled resend counter, non-binary draining gauge)
+    are rejected field by field. The CLI subcommand wires the same
+    validator next to ``validate_fleet``."""
+    from semantic_merge_tpu.obs import metrics as obs_metrics
+    import semantic_merge_tpu.runtime.trace as trace_mod
+    tracer = trace_mod.Tracer(enabled=True)
+    with tracer.phase("route"):
+        obs_spans.record("fleet.join", 0.01, layer="fleet",
+                         member="blue", address="tcp://10.0.0.7:7633",
+                         capacity=2)
+        obs_spans.record("fleet.handoff", 0.005, layer="fleet",
+                         member="blue", reason="join", ok=True)
+        obs_spans.record("fleet.heartbeat", 0.002, layer="fleet",
+                         member="blue", outcome="timeout")
+    obs_metrics.REGISTRY.counter("fleet_transport_errors_total",
+                                 "t").inc(1, op="dial")
+    obs_metrics.REGISTRY.counter("fleet_transport_resends_total",
+                                 "t").inc(1)
+    obs_metrics.REGISTRY.counter("fleet_heartbeats_total",
+                                 "t").inc(1, outcome="ok")
+    obs_metrics.REGISTRY.counter("fleet_handoffs_total",
+                                 "t").inc(1, reason="leave")
+    obs_metrics.REGISTRY.counter("fleet_affinity_misses_total",
+                                 "t").inc(1)
+    obs_metrics.REGISTRY.counter("fleet_joins_total", "t").inc(1)
+    obs_metrics.REGISTRY.gauge("fleet_member_draining",
+                               "t").set(1.0, member="blue")
+    trace = tmp_path / ".semmerge-trace.json"
+    tracer.write(trace)
+    data = json.loads(trace.read_text())
+    assert schema.validate_trace(data) == []
+    assert schema.validate_transport(data) == []
+    # The membership spans are fleet spans too — the fleet validator
+    # must accept the same artifact.
+    assert schema.validate_fleet(data) == []
+
+    def spans_named(doc, name):
+        return [r for r in doc["spans"] if r.get("name") == name]
+
+    broken = json.loads(json.dumps(data))
+    del spans_named(broken, "fleet.join")[0]["meta"]["member"]
+    assert any("missing 'member'" in e
+               for e in schema.validate_transport(broken))
+
+    broken = json.loads(json.dumps(data))
+    spans_named(broken, "fleet.join")[0]["meta"]["capacity"] = 0
+    assert any("capacity" in e
+               for e in schema.validate_transport(broken))
+
+    broken = json.loads(json.dumps(data))
+    spans_named(broken, "fleet.handoff")[0]["meta"]["reason"] = "mystery"
+    assert any("mystery" in e for e in schema.validate_transport(broken))
+
+    broken = json.loads(json.dumps(data))
+    spans_named(broken, "fleet.handoff")[0]["meta"]["ok"] = "yes"
+    assert any("boolean" in e for e in schema.validate_transport(broken))
+
+    broken = json.loads(json.dumps(data))
+    spans_named(broken, "fleet.heartbeat")[0]["meta"]["outcome"] = "slowish"
+    assert any("slowish" in e for e in schema.validate_transport(broken))
+
+    broken = json.loads(json.dumps(data))
+    series = broken["metrics"]["counters"][
+        "fleet_transport_errors_total"]["series"]
+    series[0]["labels"] = {"op": "telepathy"}
+    assert any("telepathy" in e
+               for e in schema.validate_transport(broken))
+
+    broken = json.loads(json.dumps(data))
+    series = broken["metrics"]["counters"][
+        "fleet_transport_resends_total"]["series"]
+    series[0]["labels"] = {"member": "blue"}
+    assert any("fleet_transport_resends_total" in e
+               for e in schema.validate_transport(broken))
+
+    broken = json.loads(json.dumps(data))
+    series = broken["metrics"]["counters"][
+        "fleet_heartbeats_total"]["series"]
+    series[0]["labels"] = {"outcome": "shrug"}
+    assert any("shrug" in e for e in schema.validate_transport(broken))
+
+    broken = json.loads(json.dumps(data))
+    gauge = broken["metrics"]["gauges"]["fleet_member_draining"]
+    gauge["series"][0]["labels"] = {"socket": "x"}
+    assert any("fleet_member_draining" in e
+               for e in schema.validate_transport(broken))
+
+    broken = json.loads(json.dumps(data))
+    gauge = broken["metrics"]["gauges"]["fleet_member_draining"]
+    gauge["series"][0]["value"] = 0.5
+    assert any("fleet_member_draining" in e
+               for e in schema.validate_transport(broken))
+
+    assert schema.validate_transport([]) \
+        == ["transport: top level must be a JSON object"]
+
+    # The CLI subcommand wires the same validator.
+    good = tmp_path / "transport.json"
+    good.write_text(json.dumps(data))
+    ok = subprocess.run([sys.executable, str(_SCRIPT),
+                         "validate_transport", str(good)],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stderr
+    bad = tmp_path / "transport-bad.json"
+    bad.write_text(json.dumps(broken))
+    fail = subprocess.run([sys.executable, str(_SCRIPT),
+                           "validate_transport", str(bad)],
+                          capture_output=True, text=True, timeout=60)
+    assert fail.returncode == 1
+    assert "fleet_member_draining" in fail.stderr
+
+
+def test_transport_taxonomies_documented(schema):
+    """The transport validator's documented sets mirror the living
+    code: span meta keys, op labels, heartbeat outcomes, and the
+    handoff-reason superset of the failover reasons."""
+    from semantic_merge_tpu.fleet import transport
+    assert {"fleet.join", "fleet.handoff", "fleet.heartbeat"} \
+        <= set(schema.FLEET_SPANS)
+    assert schema.FLEET_SPAN_META["fleet.join"] == ("member", "address",
+                                                    "capacity")
+    assert tuple(schema.TRANSPORT_OPS) == tuple(transport.OPS)
+    assert tuple(schema.TRANSPORT_HEARTBEAT_OUTCOMES) \
+        == tuple(transport.HEARTBEAT_OUTCOMES)
+    assert set(schema.FLEET_FAILOVER_REASONS) \
+        <= set(schema.TRANSPORT_HANDOFF_REASONS) | {"crash", "health"}
+    assert "partition" in schema.FLEET_FAILOVER_REASONS
+    assert "leave" in schema.FLEET_FAILOVER_REASONS
+
+
 def test_export_validator(schema, tmp_path):
     """The OTLP tier: real ``obs.export`` payloads (traces and metrics)
     validate; malformed ids, reversed timestamps, and kind-less metrics

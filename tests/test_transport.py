@@ -1,0 +1,420 @@
+"""Cross-host fleet transport (ISSUE 19 tentpole): address parsing,
+deadlines/backoff, TLS/mTLS loopback, half-open detection, and elastic
+membership over real TCP.
+
+The bar:
+
+- ``tcp://host:port`` (bracketed IPv6 included) selects the TCP
+  transport; anything malformed is a loud ``ValueError``, never a
+  silent unix-path fallback. ``:0`` listeners resolve to a dialable
+  advertised address.
+- Backoff is jittered and capped — both the transport's full-jitter
+  resend backoff and the client's decorrelated reconnect backoff.
+- With ``SEMMERGE_FLEET_TLS_*`` configured, the loopback round trip is
+  mTLS end to end, and a client without a certificate is refused by a
+  CA-pinned server.
+- ``heartbeat`` distinguishes a dead member (``connect``) from a
+  half-open/partitioned one (``read-timeout``): the shape TCP keepalive
+  cannot see.
+- A standalone daemon joins a live router over TCP (``serve --join``),
+  shows up in ``member_status`` as a remote ready member, drains as
+  ``draining`` (not ``dead``), and leaves cleanly — by verb or by
+  SIGTERM (the teardown announces the departure).
+"""
+import contextlib
+import json
+import os
+import pathlib
+import signal
+import socket
+import ssl
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from semantic_merge_tpu.errors import TransportFault
+from semantic_merge_tpu.fleet import transport
+from semantic_merge_tpu.service import protocol
+
+from test_fleet import _control, _counter_total, _spawn_router, _stop_router
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Addresses
+# ---------------------------------------------------------------------------
+
+def test_tcp_address_parsing():
+    assert transport.is_tcp("tcp://10.0.0.7:7633")
+    assert not transport.is_tcp("/run/semmerge.sock")
+    assert transport.tcp_endpoint("tcp://10.0.0.7:7633") == ("10.0.0.7",
+                                                             7633)
+    assert transport.tcp_endpoint("tcp://[::1]:7633") == ("::1", 7633)
+    assert transport.tcp_endpoint("tcp://localhost:0") == ("localhost", 0)
+    for bad in ("/run/semmerge.sock", "tcp://", "tcp://host",
+                "tcp://host:port", "tcp://:7633", "tcp://[]:7633"):
+        with pytest.raises(ValueError):
+            transport.tcp_endpoint(bad)
+
+
+def test_bound_address_resolves_ephemeral_port():
+    srv = transport.listen("tcp://127.0.0.1:0")
+    try:
+        addr = transport.bound_address(srv, "tcp://127.0.0.1:0")
+        host, port = transport.tcp_endpoint(addr)
+        assert host == "127.0.0.1" and port > 0
+        assert port == srv.getsockname()[1]
+    finally:
+        srv.close()
+    # Pass-throughs: fixed ports and unix paths come back untouched.
+    assert transport.bound_address(None, "/run/x.sock") == "/run/x.sock"
+
+
+# ---------------------------------------------------------------------------
+# Backoff
+# ---------------------------------------------------------------------------
+
+def test_resend_backoff_is_jittered_and_capped():
+    for attempt in range(12):
+        ceiling = min(2.0, 0.05 * (2.0 ** attempt))
+        samples = [transport.backoff_s(attempt) for _ in range(50)]
+        assert all(0.0 <= s <= ceiling for s in samples), (attempt, samples)
+    assert len({round(transport.backoff_s(6), 9)
+                for _ in range(50)}) > 1, "backoff must be jittered"
+
+
+def test_client_reconnect_backoff_decorrelated():
+    """The client's reconnect loop uses decorrelated jitter: each delay
+    is drawn from ``[base, prev * 3]`` capped at 2s — delays grow from
+    the previous *sample* (not a fixed ladder), so colliding clients
+    spread out instead of re-arriving in lockstep."""
+    from semantic_merge_tpu.service.client import _reconnect_backoff_s
+    assert _reconnect_backoff_s(0.0) == pytest.approx(0.05)
+    for prev in (0.05, 0.2, 1.0, 50.0):
+        samples = [_reconnect_backoff_s(prev) for _ in range(100)]
+        hi = min(2.0, max(prev * 3.0, 0.05))
+        assert all(0.05 <= s <= hi for s in samples), (prev, samples)
+    assert all(_reconnect_backoff_s(100.0) <= 2.0 for _ in range(100))
+    assert len({round(_reconnect_backoff_s(1.0), 9)
+                for _ in range(50)}) > 1, "reconnect backoff must jitter"
+    # A full chain stays within the cap from any start.
+    delay = 0.0
+    for _ in range(20):
+        delay = _reconnect_backoff_s(delay)
+        assert 0.05 <= delay <= 2.0
+
+
+# ---------------------------------------------------------------------------
+# Loopback round trips (plaintext + TLS)
+# ---------------------------------------------------------------------------
+
+class _HelloServer:
+    """A minimal in-process member: answers ``hello`` on a transport
+    listener. ``mute=True`` accepts and never replies (the half-open
+    shape); ``slam=True`` closes immediately after accept."""
+
+    def __init__(self, *, mute=False, slam=False):
+        self.sock = transport.listen("tcp://127.0.0.1:0")
+        self.address = transport.bound_address(self.sock,
+                                               "tcp://127.0.0.1:0")
+        self._mute, self._slam = mute, slam
+        self._held = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except ssl.SSLError:  # a refused client handshake must not
+                continue          # kill the accept loop (OSError subclass!)
+            except (OSError, ValueError):
+                return
+            if self._slam:
+                conn.close()
+                continue
+            if self._mute:
+                self._held.append(conn)
+                continue
+            try:
+                rfile = conn.makefile("r", encoding="utf-8")
+                wfile = conn.makefile("w", encoding="utf-8")
+                req = protocol.read_message(rfile)
+                protocol.write_message(wfile, {
+                    "id": req["id"],
+                    "result": {"ok": True, "pid": os.getpid(),
+                               "version": protocol.PROTOCOL_VERSION,
+                               "fleet": False, "draining": False}})
+            except Exception:  # noqa: BLE001
+                pass
+            finally:
+                with contextlib.suppress(OSError):
+                    conn.close()
+
+    def close(self):
+        with contextlib.suppress(OSError):
+            self.sock.close()
+        for conn in self._held:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+
+def test_plaintext_tcp_roundtrip_and_heartbeat():
+    srv = _HelloServer()
+    try:
+        hello = transport.heartbeat(srv.address, timeout=10.0)
+        assert hello["ok"] and hello["draining"] is False
+        result = transport.call(srv.address, "hello", {}, timeout=10.0)
+        assert result and result["ok"]
+    finally:
+        srv.close()
+
+
+def test_heartbeat_distinguishes_dead_from_half_open():
+    # Dead: nothing listening — the dial itself fails.
+    srv = _HelloServer()
+    dead_addr = srv.address
+    srv.close()
+    time.sleep(0.05)
+    with pytest.raises(TransportFault) as exc_info:
+        transport.heartbeat(dead_addr, timeout=2.0)
+    assert exc_info.value.cause == "connect"
+    # Half-open: the dial succeeds, the reply never comes.
+    mute = _HelloServer(mute=True)
+    try:
+        with pytest.raises(TransportFault) as exc_info:
+            transport.heartbeat(mute.address, timeout=0.5)
+        assert exc_info.value.cause == "read-timeout"
+        assert exc_info.value.exit_code == 21
+    finally:
+        mute.close()
+
+
+def test_roundtrip_peer_close_is_typed():
+    srv = _HelloServer(slam=True)
+    try:
+        with pytest.raises(TransportFault) as exc_info:
+            transport.roundtrip(srv.address, {"id": 0, "method": "hello",
+                                              "params": {}},
+                                read_deadline=5.0)
+        # Slammed mid-request: either a clean EOF or the broken pipe /
+        # reset surfaces — all typed, never a bare OSError.
+        assert exc_info.value.cause in ("eof", "ProtocolError",
+                                        "ConnectionResetError",
+                                        "BrokenPipeError")
+    finally:
+        srv.close()
+
+
+def test_call_returns_none_after_resend_budget(tmp_path):
+    addr = "tcp://127.0.0.1:1"  # reserved port: always refused
+    t0 = time.monotonic()
+    assert transport.call(addr, "hello", {}, timeout=0.5, retries=1) is None
+    assert time.monotonic() - t0 < 30.0
+
+
+# ---------------------------------------------------------------------------
+# TLS / mTLS
+# ---------------------------------------------------------------------------
+
+def _make_certs(tmp_path):
+    """A private CA plus one endpoint cert signed by it (both fleet
+    sides share the same material in these loopback tests)."""
+    ca_key, ca_pem = str(tmp_path / "ca.key"), str(tmp_path / "ca.pem")
+    ep_key, ep_csr, ep_pem = (str(tmp_path / "ep.key"),
+                              str(tmp_path / "ep.csr"),
+                              str(tmp_path / "ep.pem"))
+    run = lambda *argv: subprocess.run(  # noqa: E731
+        argv, check=True, capture_output=True)
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", ca_key, "-out", ca_pem, "-days", "2",
+        "-subj", "/CN=semmerge-test-ca")
+    run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", ep_key, "-out", ep_csr, "-subj", "/CN=127.0.0.1")
+    run("openssl", "x509", "-req", "-in", ep_csr, "-CA", ca_pem,
+        "-CAkey", ca_key, "-CAcreateserial", "-out", ep_pem, "-days", "2")
+    return ca_pem, ep_pem, ep_key
+
+
+def test_mtls_roundtrip_and_unauthenticated_client_refused(tmp_path,
+                                                           monkeypatch):
+    if not os.path.exists("/usr/bin/openssl"):
+        pytest.skip("openssl unavailable")
+    ca_pem, ep_pem, ep_key = _make_certs(tmp_path)
+    monkeypatch.setenv(transport.ENV_TLS_CERT, ep_pem)
+    monkeypatch.setenv(transport.ENV_TLS_KEY, ep_key)
+    monkeypatch.setenv(transport.ENV_TLS_CA, ca_pem)
+    assert transport.tls_enabled()
+    srv = _HelloServer()  # listener wraps itself from the same env
+    try:
+        hello = transport.heartbeat(srv.address, timeout=10.0)
+        assert hello["ok"], "mTLS loopback hello must succeed"
+        # A client with no certificate must be refused by the
+        # CA-pinned server. TLS 1.3 delivers the certificate_required
+        # alert on first I/O, not at the handshake — either way the
+        # failure is a typed TransportFault, and the server's accept
+        # loop survives to serve the next authenticated client.
+        monkeypatch.delenv(transport.ENV_TLS_CERT)
+        monkeypatch.delenv(transport.ENV_TLS_KEY)
+        with pytest.raises(TransportFault):
+            transport.heartbeat(srv.address, timeout=5.0)
+        monkeypatch.setenv(transport.ENV_TLS_CERT, ep_pem)
+        monkeypatch.setenv(transport.ENV_TLS_KEY, ep_key)
+        assert transport.heartbeat(srv.address, timeout=10.0)["ok"]
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership: serve --join over real TCP
+# ---------------------------------------------------------------------------
+
+def _spawn_member(router_sock, tmp_path, *, member_id="blue",
+                  join_interval="0.5", capacity=2, extra_env=None):
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+                "SEMMERGE_DAEMON": "off",
+                "SEMMERGE_FLEET_JOIN_INTERVAL": join_interval,
+                "SEMMERGE_SERVICE_DRAIN_TIMEOUT": "2"})
+    for key in ("SEMMERGE_FAULT", "SEMMERGE_METRICS",
+                "SEMMERGE_SERVICE_SOCKET"):
+        env.pop(key, None)
+    env.update(extra_env or {})
+    log = open(str(tmp_path / f"member-{member_id}.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "semantic_merge_tpu", "serve",
+         "--socket", "tcp://127.0.0.1:0", "--join", router_sock,
+         "--member-id", member_id, "--capacity", str(capacity)],
+        stdin=subprocess.DEVNULL, stdout=log, stderr=log,
+        cwd="/", env=env, start_new_session=True)
+    log.close()
+    return proc
+
+
+def _wait_members(router_sock, want_ids, *, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    status = None
+    while time.monotonic() < deadline:
+        status = _control(router_sock, "status")
+        got = {m["id"] for m in (status or {}).get("members", [])}
+        if got == set(want_ids):
+            return status
+        time.sleep(0.2)
+    raise AssertionError(f"members never settled to {want_ids}: "
+                         f"{status and status.get('members')}")
+
+
+def _stop_member(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def test_tcp_member_joins_drains_and_leaves(tmp_path):
+    """The full elastic lifecycle against a pure-remote router
+    (``--members 0``): a standalone TCP daemon announces itself, shows
+    up as a remote ready member with a dialable advertised address,
+    drains as ``draining`` (distinguished from ``dead``), and a
+    deliberate ``leave`` removes it from the ring."""
+    sock = str(tmp_path / "fleet.sock")
+    router = _spawn_router(sock, members=0)
+    member = None
+    try:
+        # Join: a long announce interval means exactly one announce —
+        # the leave below must stick, not race a re-join.
+        member = _spawn_member(sock, tmp_path, member_id="blue",
+                               join_interval="3600")
+        status = _wait_members(sock, {"blue"})
+        blue = {m["id"]: m for m in status["members"]}["blue"]
+        assert blue["remote"] is True
+        assert blue["state"] == "ready"
+        assert blue["capacity"] == 2
+        assert transport.is_tcp(blue["socket"])
+        host, port = transport.tcp_endpoint(blue["socket"])
+        assert port > 0, "the :0 listener must advertise a real port"
+        assert status["members_up"] == 1
+        assert _counter_total(status, "fleet_joins_total") >= 1
+
+        # member_status merges the member's own status with the
+        # router-side state.
+        ms = _control(sock, "member_status")
+        block = ms["members"]["blue"]
+        assert block["state"] == "ready"
+        assert block["router_view"]["remote"] is True
+        assert block.get("transport") == "tcp"
+
+        # Drain: deliberately out of the ring, NOT dead.
+        ack = _control(sock, "drain", {"member": "blue"})
+        assert ack["ok"] and ack["member_ack"]["draining"] is True
+        status = _control(sock, "status")
+        blue = {m["id"]: m for m in status["members"]}["blue"]
+        assert blue["state"] == "draining"
+        assert status["members_draining"] == 1
+        assert status["members_dead"] == 0
+        assert status["members_up"] == 0
+
+        # Leave: gone from the member table entirely.
+        ack = _control(sock, "leave", {"member": "blue"})
+        assert ack["ok"] and ack["member"] == "blue"
+        status = _control(sock, "status")
+        assert all(m["id"] != "blue" for m in status["members"])
+    finally:
+        if member is not None:
+            _stop_member(member)
+        _stop_router(router)
+
+
+def test_tcp_member_sigterm_announces_leave(tmp_path):
+    """SIGTERM to a joined member is a *deliberate* departure: its
+    teardown sends ``leave``, so the router records a leave (never a
+    crash eject) and the ring shrinks immediately."""
+    sock = str(tmp_path / "fleet.sock")
+    router = _spawn_router(
+        sock, members=0,
+        extra_env={"SEMMERGE_FLEET_HEALTH_INTERVAL": "0.3"})
+    member = None
+    try:
+        member = _spawn_member(sock, tmp_path, member_id="ephem",
+                               join_interval="0.4")
+        _wait_members(sock, {"ephem"})
+        _stop_member(member)
+        member = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            status = _control(sock, "status")
+            if status and not any(m["id"] == "ephem"
+                                  for m in status["members"]):
+                break
+            time.sleep(0.2)
+        status = _control(sock, "status")
+        assert all(m["id"] != "ephem" for m in status["members"])
+        assert _counter_total(status, "fleet_failovers_total",
+                              reason="leave") >= 1
+    finally:
+        if member is not None:
+            _stop_member(member)
+        _stop_router(router)
+
+
+def test_router_status_reports_transport_block(tmp_path):
+    sock = str(tmp_path / "fleet.sock")
+    router = _spawn_router(sock, members=0)
+    try:
+        status = _control(sock, "status")
+        t = status["transport"]
+        assert t["tls"] is False
+        assert t["connect_timeout_s"] > 0
+        assert t["heartbeat_timeout_s"] > 0
+        assert t["resends"] >= 0
+        assert t["handoff_max"] >= 1
+        assert isinstance(status["affinity_epoch"], int)
+    finally:
+        _stop_router(router)

@@ -792,6 +792,254 @@ def run_slocost_bench(record: dict, args, backend, base, left, right,
     return 0 if ok else 1
 
 
+def _telcost_soak_leg(record: dict, rows: list,
+                      json_only: bool = False) -> bool:
+    """200-merge chaos-soak against a deliberately tight trace-store
+    budget: ~10% of the traffic carries errored/degraded outcomes
+    (protected keep reasons), the rest is subject to head sampling.
+    Gates: the store's on-disk bytes stay at or under the budget after
+    every write has landed, and 100% of the errored/degraded traces
+    survive the pruning that the budget forces."""
+    import random
+    import shutil
+    import tempfile
+
+    from semantic_merge_tpu.obs import sampling as obs_sampling
+
+    merges = 200
+    rng = random.Random(20)
+    scratch = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-telcost-"))
+    try:
+        sampler = obs_sampling.SamplingPolicy(sample_n=4,
+                                              minted_by="telcost")
+        store = obs_sampling.TraceStore(scratch / "traces",
+                                        budget_mb=0.25)
+        protected_ids = []
+        kept = 0
+        for i in range(merges):
+            tid = f"soak-{i:04d}"
+            is_err = i % 17 == 0
+            is_deg = i % 23 == 5
+            seconds = rng.uniform(0.8, 1.6)
+            decision = sampler.decide(tid, "semmerge", seconds,
+                                      error=is_err, degraded=is_deg)
+            if is_err or is_deg:
+                protected_ids.append(tid)
+            if decision.keep:
+                kept += 1
+                store.write(tid, {
+                    "schema": 1, "kind": "trace", "trace_id": tid,
+                    "verb": "semmerge", "outcome":
+                        "error" if is_err else "ok",
+                    "seconds": round(seconds, 6), "spans": rows,
+                }, decision=decision)
+        live = {p.stem for p in (scratch / "traces").glob("*.json")}
+        retained = sum(1 for tid in protected_ids if tid in live)
+        protected_pct = (100.0 * retained / len(protected_ids)
+                         if protected_ids else 100.0)
+        bytes_now = store.total_bytes()
+        pruned = live != {f"soak-{i:04d}" for i in range(merges)
+                          } and kept > len(live)
+        ok = (bytes_now <= store.budget_bytes
+              and protected_pct == 100.0 and pruned)
+        record["telemetry_soak_bytes"] = bytes_now
+        record["telemetry_soak_budget_bytes"] = store.budget_bytes
+        record["telemetry_soak_protected_pct"] = round(protected_pct, 1)
+        if not ok:
+            prior = record.get("error")
+            if bytes_now > store.budget_bytes:
+                msg = (f"telcost soak: store {bytes_now}B over the "
+                       f"{store.budget_bytes}B budget")
+            elif protected_pct < 100.0:
+                msg = (f"telcost soak: only {protected_pct:.1f}% of "
+                       f"errored/degraded traces retained")
+            else:
+                msg = ("telcost soak: budget never forced a prune — "
+                       "the leg measured nothing")
+            record["error"] = f"{prior}; {msg}" if prior else msg
+        if not json_only:
+            print(f"# soak: {merges} merges, {kept} kept, "
+                  f"{len(live)} on disk ({bytes_now}B / "
+                  f"{store.budget_bytes}B budget), "
+                  f"protected retained: {protected_pct:.1f}%",
+                  file=sys.stderr)
+        return ok
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def _telcost_triage_leg(record: dict, backend, base, left, right,
+                        json_only: bool = False) -> bool:
+    """Sustained injected-latency leg: real merges carry a real (slept)
+    ``inject.lag`` span — 2 ms during warmup, 250 ms once the
+    regression 'ships' — through the same recorder→phases→AnomalyTriage
+    path the daemon runs per request. Gates: the sustained breach
+    produces exactly one auto-captured triage bundle and its phase diff
+    names ``inject.lag`` as the suspect."""
+    import shutil
+    import tempfile
+
+    from semantic_merge_tpu.obs import anomaly as obs_anomaly
+
+    warmup, sustain = 6, 3
+    scratch = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-telcost-"))
+    try:
+        triage = obs_anomaly.AnomalyTriage(min_n=warmup, sustain=sustain)
+        bundles = []
+        for i in range(warmup + sustain):
+            lag = 0.25 if i >= warmup else 0.002
+            recorder = obs_spans.SpanRecorder(detailed=False)
+            tid = f"telcost-triage-{i}"
+            t0 = time.perf_counter()
+            with obs_spans.request_scope(tid, recorder):
+                run_merge_to_payload(backend, base, left, right)
+                with obs_spans.span("inject.lag", layer="bench"):
+                    time.sleep(lag)
+            total = time.perf_counter() - t0
+            rows = recorder.span_dicts()
+            phases: dict = {}
+            for row in rows:
+                name = str(row.get("name") or "?")
+                try:
+                    phases[name] = phases.get(name, 0.0) + \
+                        float(row.get("seconds") or 0.0)
+                except (TypeError, ValueError):
+                    continue
+            bundles.extend(triage.observe(tid, "semmerge", phases,
+                                          seconds=total, spans=rows,
+                                          root=str(scratch)))
+        hits = [b for b in bundles if b.get("phase") == "inject.lag"]
+        fired_once = len(hits) == 1
+        named = bool(hits) and \
+            hits[0].get("suspect_phase") == "inject.lag"
+        captured = bool(hits) and hits[0].get("bundle") and \
+            pathlib.Path(hits[0]["bundle"]).exists()
+        ok = fired_once and named and captured
+        record["telemetry_triage_fired"] = len(hits)
+        if not ok:
+            prior = record.get("error")
+            if not fired_once:
+                msg = (f"telcost triage: injected phase fired "
+                       f"{len(hits)} bundles, expected exactly 1")
+            elif not named:
+                msg = ("telcost triage: bundle suspect is "
+                       f"{hits[0].get('suspect_phase')!r}, not the "
+                       "injected phase")
+            else:
+                msg = "telcost triage: bundle file was not written"
+            record["error"] = f"{prior}; {msg}" if prior else msg
+        if not json_only:
+            where = hits[0]["bundle"] if captured else "none"
+            print(f"# triage: {len(hits)} bundle(s) for inject.lag, "
+                  f"suspect={hits[0].get('suspect_phase') if hits else None}"
+                  f", bundle={'ok' if captured else where}",
+                  file=sys.stderr)
+        return ok
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def run_telcost_bench(record: dict, args, backend, base, left, right,
+                      json_only: bool = False) -> int:
+    """The ``telcost`` preset: what the full telemetry pipeline costs a
+    rung-5 merge, plus two correctness legs over the same pipeline.
+
+    Overhead leg — dark = bare merge, no recorder. On = the daemon's
+    per-request posture end to end: non-detailed SpanRecorder, span→
+    phase folding, sampling verdict, window rollup, anomaly
+    observation, and the trace-store write for kept traces (mirrors
+    ``MergeDaemon._finish_telemetry``). Asserts the overhead stays
+    under 2% of dark wall time and emits ``telemetry_overhead_pct``.
+
+    Soak leg — see :func:`_telcost_soak_leg` (200-merge chaos soak:
+    store under budget, 100% errored/degraded retention). Triage leg —
+    see :func:`_telcost_triage_leg` (sustained injected latency must
+    produce one bundle whose diff names the injected phase)."""
+    import shutil
+    import tempfile
+
+    from semantic_merge_tpu.obs import agg as obs_agg
+    from semantic_merge_tpu.obs import anomaly as obs_anomaly
+    from semantic_merge_tpu.obs import sampling as obs_sampling
+
+    repeats = 5
+    # Warm compiles and caches so both arms measure steady state.
+    run_merge_to_payload(backend, base, left, right)
+
+    dark_s = time_merge(backend, base, left, right, repeats=repeats)
+
+    scratch = pathlib.Path(tempfile.mkdtemp(prefix="semmerge-telcost-"))
+    try:
+        window = obs_agg.WindowAggregator()
+        sampler = obs_sampling.SamplingPolicy(sample_n=10,
+                                              minted_by="telcost")
+        triage = obs_anomaly.AnomalyTriage()
+        store = obs_sampling.TraceStore(scratch / "traces")
+        on_s = float("inf")
+        last_rows: list = []
+        for i in range(repeats):
+            recorder = obs_spans.SpanRecorder(detailed=False)
+            tid = f"telcost-{i}"
+            t0 = time.perf_counter()
+            with obs_spans.request_scope(tid, recorder):
+                run_merge_to_payload(backend, base, left, right)
+            rows = recorder.span_dicts()
+            phases: dict = {}
+            for row in rows:
+                name = str(row.get("name") or "?")
+                try:
+                    phases[name] = phases.get(name, 0.0) + \
+                        float(row.get("seconds") or 0.0)
+                except (TypeError, ValueError):
+                    continue
+            flags = obs_sampling.outcome_flags(rows)
+            total = time.perf_counter() - t0
+            decision = sampler.decide(
+                tid, "semmerge", total, error=flags["error"],
+                degraded=flags["degraded"], breaker=flags["breaker"],
+                resolver=flags["resolver"])
+            window.observe("semmerge", total, error=flags["error"],
+                           phases=phases)
+            triage.observe(tid, "semmerge", phases, seconds=total,
+                           spans=rows, root=str(scratch))
+            if decision.keep:
+                store.write(tid, {
+                    "schema": 1, "kind": "trace", "trace_id": tid,
+                    "verb": "semmerge", "outcome": "ok",
+                    "seconds": round(total, 6), "spans": rows,
+                }, decision=decision)
+            on_s = min(on_s, time.perf_counter() - t0)
+            last_rows = rows
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+    overhead_pct = (on_s - dark_s) / dark_s * 100.0 if dark_s > 0 else 0.0
+    ok = overhead_pct < 2.0
+    record["metric"] = (
+        f"telemetry-pipeline overhead (rung-5 merge, {args.files} files x "
+        f"{args.decls} decls, sampling+window+anomaly+store on vs dark)")
+    record["value"] = round(overhead_pct, 3)
+    record["unit"] = "pct"
+    record["vs_baseline"] = round(on_s / dark_s, 4) if dark_s > 0 else 0.0
+    record["telemetry_overhead_pct"] = round(overhead_pct, 3)
+    record["telemetry_dark_ms"] = round(dark_s * 1e3, 1)
+    record["telemetry_on_ms"] = round(on_s * 1e3, 1)
+    if not ok:
+        prior = record.get("error")
+        msg = (f"telemetry overhead {overhead_pct:.2f}% exceeds "
+               f"the 2% budget")
+        record["error"] = f"{prior}; {msg}" if prior else msg
+    if not json_only:
+        print(f"# dark: {dark_s*1e3:8.1f} ms   telemetry-on: "
+              f"{on_s*1e3:8.1f} ms   overhead: {overhead_pct:+.2f}%",
+              file=sys.stderr)
+    soak_ok = _telcost_soak_leg(record, last_rows, json_only=json_only)
+    triage_ok = _telcost_triage_leg(record, backend, base, left, right,
+                                    json_only=json_only)
+    emit_record(record)
+    return 0 if ok and soak_ok and triage_ok else 1
+
+
 def run_devtail_bench(record: dict, args, backend, base, left, right,
                       json_only: bool = False) -> int:
     """The ``devtail`` preset: what device-side op-log rendering and
@@ -964,6 +1212,11 @@ PRESETS = {
     "fleetwan": {"files": 24, "decls": 4, "fleetwan": True},
     "tracecost": {"files": 10000, "decls": 4, "tracecost": True},
     "slocost": {"files": 10000, "decls": 4, "slocost": True},
+    # telcost: the PR-20 telemetry pipeline (tail sampling + window
+    # rollups + anomaly bank + trace store) on vs dark, plus the
+    # chaos-soak and injected-latency triage legs; guards
+    # telemetry_overhead_pct under the 2% budget.
+    "telcost": {"files": 10000, "decls": 4, "telcost": True},
     # devtail: the rung-5 host-tail ladder — cold vs resident-base vs
     # device-render legs; guards host_tail_ms and residency_hit_rate.
     "devtail": {"files": 10000, "decls": 4, "conflicts": True,
@@ -2673,6 +2926,7 @@ def main() -> int:
     strict_mode = False
     tracecost_mode = False
     slocost_mode = False
+    telcost_mode = False
     devtail_mode = False
     if args.preset is None and args.files is None:
         # The headline number is measured where BASELINE.json defines
@@ -2686,6 +2940,7 @@ def main() -> int:
         strict_mode = p.get("strict", False)
         tracecost_mode = p.get("tracecost", False)
         slocost_mode = p.get("slocost", False)
+        telcost_mode = p.get("telcost", False)
         devtail_mode = p.get("devtail", False)
     elif args.files is None:
         args.files = 512
@@ -2776,6 +3031,9 @@ def main() -> int:
                                    json_only=args.json_only)
     if slocost_mode:
         return run_slocost_bench(record, args, tpu, base, left, right,
+                                 json_only=args.json_only)
+    if telcost_mode:
+        return run_telcost_bench(record, args, tpu, base, left, right,
                                  json_only=args.json_only)
     if devtail_mode:
         return run_devtail_bench(record, args, tpu, base, left, right,

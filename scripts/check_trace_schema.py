@@ -27,6 +27,12 @@ Subcommand modes for the request-tracing artifacts::
         SEMMERGE_FLEET_TRACE_DIR/<trace_id>.json [...]
     python scripts/check_trace_schema.py validate_export \
         OTLP_PAYLOAD_JSON [...]
+    python scripts/check_trace_schema.py validate_sampling \
+        STATUS_OR_KEPT_TRACE_JSON [...]
+    python scripts/check_trace_schema.py validate_window \
+        STATUS_JSON [...]
+    python scripts/check_trace_schema.py validate_triage \
+        .semmerge-postmortem/<trace_id>.json [...]
 
 Exit 0 when everything conforms, 1 with one line per violation
 otherwise. The tier-1 suite imports :func:`validate_trace` /
@@ -34,9 +40,10 @@ otherwise. The tier-1 suite imports :func:`validate_trace` /
 / :func:`validate_request_traces` / :func:`validate_postmortem` /
 :func:`validate_slo` / :func:`validate_conflicts` /
 :func:`validate_fleet` / :func:`validate_transport` /
-:func:`validate_fleet_trace` / :func:`validate_export` directly
-(``tests/test_trace_schema.py``), so trace-format drift fails CI
-before it reaches a consumer.
+:func:`validate_fleet_trace` / :func:`validate_export` /
+:func:`validate_sampling` / :func:`validate_window` /
+:func:`validate_triage` directly (``tests/test_trace_schema.py``), so
+trace-format drift fails CI before it reaches a consumer.
 
 Dependency-free on purpose: the schema IS this file plus the runbook
 table, not a jsonschema document that could drift separately.
@@ -188,7 +195,7 @@ POSTMORTEM_REQUIRED = ("schema", "trace_id", "reason", "ts", "spans",
 #: Documented postmortem dump reasons (``obs/flight.py`` REASONS).
 POSTMORTEM_REASONS = ("fault-escape", "degradation", "breaker-transition",
                       "supervisor-restart", "daemon-drain", "slo-burn",
-                      "resolver-fault", "fleet-failover")
+                      "resolver-fault", "fleet-failover", "anomaly")
 
 #: Required keys of one flight-ring row (``obs/flight.py`` note()).
 FLIGHT_ROW_REQUIRED = ("name", "t", "seconds", "layer", "status", "error",
@@ -214,6 +221,9 @@ BENCH_NUMERIC_OPTIONAL = (
     "breaker_open_latency_ms", "breaker_recovery_s", "steady_rss_mb",
     "trace_overhead_pct", "trace_dark_ms", "trace_on_ms",
     "slo_overhead_pct", "slo_dark_ms", "slo_on_ms",
+    "telemetry_overhead_pct", "telemetry_dark_ms", "telemetry_on_ms",
+    "telemetry_soak_bytes", "telemetry_soak_budget_bytes",
+    "telemetry_soak_protected_pct", "telemetry_triage_fired",
     "resolution_rate", "resolve_on_ms", "resolve_off_ms",
     "gate_recompose_ms", "gate_parity_ms", "gate_typecheck_ms",
     "gate_format_ms",
@@ -349,6 +359,56 @@ SLO_METRIC_LABELS = {
 #: Documented burn-rate windows (multi-window alerting: fast ~5 min,
 #: slow ~1 h).
 SLO_WINDOWS = ("fast", "slow")
+
+#: Documented tail-sampling keep reasons (``obs/sampling.py``
+#: KEEP_REASONS): outcome keeps (error/degraded/breaker/resolver),
+#: latency keep (slow = at-or-over the rolling per-verb p99), the
+#: deterministic 1-in-N head sample, and the sampling-disabled
+#: keep-everything verdict.
+SAMPLING_KEEP_REASONS = ("error", "degraded", "breaker", "resolver",
+                         "slow", "head", "always")
+
+#: The single documented drop reason.
+SAMPLING_DROP_REASON = "sampled-out"
+
+#: ``trace_sampling_decisions_total{decision=…}`` values.
+SAMPLING_DECISIONS = ("keep", "drop")
+
+#: Label keys of the telemetry-pipeline metric series
+#: (``obs/sampling.py`` verdict/prune counters, ``obs/flight.py``
+#: bounded retention, ``obs/metrics.py`` cardinality budget).
+SAMPLING_METRIC_LABELS = {
+    "trace_sampling_decisions_total": ("decision", "reason"),
+    "trace_store_pruned_total": ("store",),
+    "postmortem_pruned_total": ("dir",),
+    "metrics_series_dropped_total": ("metric",),
+}
+
+#: Rollup windows of the streaming aggregator (``obs/agg.py``).
+WINDOW_KEYS = ("1s", "1m")
+
+#: Required keys of one window rollup block.
+WINDOW_REQUIRED = ("span_s", "count", "errors", "qps", "error_rate",
+                   "p50_ms", "p99_ms", "max_ms", "phases_ms", "verbs")
+
+#: Window gauges published into the registry (labels exactly
+#: ``("window",)`` with a documented window value).
+WINDOW_GAUGES = ("semmerge_window_qps", "semmerge_window_p50_ms",
+                 "semmerge_window_p99_ms", "semmerge_window_error_rate")
+
+#: Required keys of a triage block (``obs/anomaly.py`` _capture) inside
+#: an ``anomaly`` postmortem bundle.
+TRIAGE_REQUIRED = ("schema", "phase", "suspect_phase", "z",
+                   "threshold_z", "sustain", "offender", "baseline",
+                   "diff", "ts")
+
+#: Required keys of the triage ``offender`` / non-null ``baseline``.
+TRIAGE_SIDE_REQUIRED = ("trace_id", "verb", "seconds", "phases_ms")
+
+#: Required keys of one phase-diff row (``obs/anomaly.py``
+#: phase_diff — also the ``semmerge trace diff`` row shape).
+TRIAGE_DIFF_ROW_REQUIRED = ("phase", "a_ms", "b_ms", "delta_ms",
+                            "ratio")
 
 
 def _is_num(v: Any) -> bool:
@@ -1671,6 +1731,347 @@ def validate_events(lines: List[str]) -> List[str]:
     return errors
 
 
+def validate_sampling(data: Any) -> List[str]:
+    """Validate the tail-sampling records of a status payload or a
+    kept trace artifact: an embedded ``sampling`` verdict (Decision
+    meta — kept artifacts only ever carry ``keep: true`` with a
+    documented keep reason and a non-empty ``minted_by``), a policy
+    ``sampling`` stats block (documented decision reasons with
+    non-negative counts), a ``trace_store`` stats block (non-negative
+    count/bytes, bytes within ``budget_bytes`` when one is set), and
+    the telemetry-pipeline counters carrying their documented label
+    sets (``decision`` from the documented pair, keep reasons vs the
+    one drop reason cross-checked)."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["sampling: top level must be a JSON object"]
+    block = data.get("sampling")
+    if isinstance(block, dict) and "keep" in block:
+        # Decision meta embedded in a kept artifact.
+        if block.get("keep") is not True:
+            errors.append("sampling: a persisted artifact must carry "
+                          "keep=true (drops are never written)")
+        reason = block.get("reason")
+        if reason not in SAMPLING_KEEP_REASONS:
+            errors.append(f"sampling: kept reason {reason!r} not in "
+                          f"{SAMPLING_KEEP_REASONS}")
+        minted = block.get("minted_by")
+        if not isinstance(minted, str) or not minted:
+            errors.append("sampling: minted_by must be a non-empty "
+                          "string")
+        n = block.get("sample_n")
+        if n is not None and (not isinstance(n, int)
+                              or isinstance(n, bool) or n < 0):
+            errors.append("sampling: sample_n must be an int >= 0 or "
+                          "null")
+    elif isinstance(block, dict) and "enabled" in block:
+        # SamplingPolicy.stats() in a status payload.
+        if not isinstance(block.get("enabled"), bool):
+            errors.append("sampling: enabled must be a boolean")
+        n = block.get("sample_n")
+        if n is not None and (not isinstance(n, int)
+                              or isinstance(n, bool) or n < 1):
+            errors.append("sampling: sample_n must be an int >= 1 or "
+                          "null")
+        decisions = block.get("decisions")
+        if not isinstance(decisions, dict):
+            errors.append("sampling: decisions must be an object")
+            decisions = {}
+        allowed = SAMPLING_KEEP_REASONS + (SAMPLING_DROP_REASON,)
+        for reason, count in decisions.items():
+            if reason not in allowed:
+                errors.append(f"sampling: decision reason {reason!r} "
+                              f"not in {allowed}")
+            if not isinstance(count, int) or isinstance(count, bool) \
+                    or count < 0:
+                errors.append(f"sampling: decisions[{reason!r}] must "
+                              f"be an int >= 0")
+        p99 = block.get("p99_ms")
+        if p99 is not None:
+            if not isinstance(p99, dict):
+                errors.append("sampling: p99_ms must be an object")
+            else:
+                for verb, v in p99.items():
+                    if not _is_num(v) or v < 0:
+                        errors.append(f"sampling: p99_ms[{verb!r}] "
+                                      f"must be a number >= 0")
+    elif block is not None:
+        errors.append("sampling: block must be a Decision meta or a "
+                      "policy stats object")
+    store = data.get("trace_store")
+    if store is not None:
+        if not isinstance(store, dict):
+            errors.append("sampling: trace_store must be an object or "
+                          "null")
+        else:
+            for key in ("count", "bytes"):
+                v = store.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 0:
+                    errors.append(f"sampling: trace_store.{key} must "
+                                  f"be an int >= 0")
+            budget = store.get("budget_bytes")
+            if budget is not None and (not _is_num(budget)
+                                       or budget <= 0):
+                errors.append("sampling: trace_store.budget_bytes "
+                              "must be a number > 0 or null")
+            if _is_num(budget) and isinstance(store.get("bytes"), int) \
+                    and store["bytes"] > budget:
+                errors.append(f"sampling: trace_store over budget "
+                              f"({store['bytes']} > {budget} bytes)")
+            mc = store.get("max_count")
+            if mc is not None and (not isinstance(mc, int)
+                                   or isinstance(mc, bool) or mc < 1):
+                errors.append("sampling: trace_store.max_count must "
+                              "be an int >= 1 or null")
+    metrics = data.get("metrics", data)
+    if not isinstance(metrics, dict):
+        return errors
+    counters = metrics.get("counters", {})
+    if not isinstance(counters, dict):
+        counters = {}
+    for name, labels in SAMPLING_METRIC_LABELS.items():
+        m = counters.get(name)
+        if not isinstance(m, dict):
+            continue
+        for j, s in enumerate(m.get("series", [])):
+            got = tuple(sorted((s.get("labels") or {}).keys()))
+            if got != tuple(sorted(labels)):
+                errors.append(f"metrics.counters.{name}[{j}]: labels "
+                              f"{got} != documented "
+                              f"{tuple(sorted(labels))}")
+    verdicts = counters.get("trace_sampling_decisions_total")
+    if isinstance(verdicts, dict):
+        for j, s in enumerate(verdicts.get("series", [])):
+            labels = s.get("labels") or {}
+            decision = labels.get("decision")
+            reason = labels.get("reason")
+            w = f"metrics.counters.trace_sampling_decisions_total[{j}]"
+            if decision not in SAMPLING_DECISIONS:
+                errors.append(f"{w}: decision {decision!r} not in "
+                              f"{SAMPLING_DECISIONS}")
+            elif decision == "keep" and reason not in \
+                    SAMPLING_KEEP_REASONS:
+                errors.append(f"{w}: keep reason {reason!r} not in "
+                              f"{SAMPLING_KEEP_REASONS}")
+            elif decision == "drop" and reason != SAMPLING_DROP_REASON:
+                errors.append(f"{w}: drop reason {reason!r} != "
+                              f"{SAMPLING_DROP_REASON!r}")
+    return errors
+
+
+def _validate_window_block(win: Any, where: str) -> List[str]:
+    errors: List[str] = []
+    if not isinstance(win, dict):
+        return [f"{where}: must be an object"]
+    for key in WINDOW_REQUIRED:
+        if key not in win:
+            errors.append(f"{where}: missing key {key!r}")
+    for key in ("span_s", "qps", "error_rate", "p50_ms", "p99_ms",
+                "max_ms"):
+        if key in win and (not _is_num(win[key]) or win[key] < 0):
+            errors.append(f"{where}: {key} must be a number >= 0")
+    for key in ("count", "errors"):
+        v = win.get(key)
+        if key in win and (not isinstance(v, int)
+                           or isinstance(v, bool) or v < 0):
+            errors.append(f"{where}: {key} must be an int >= 0")
+    if isinstance(win.get("errors"), int) \
+            and isinstance(win.get("count"), int) \
+            and win["errors"] > win["count"]:
+        errors.append(f"{where}: errors > count")
+    if _is_num(win.get("p50_ms")) and _is_num(win.get("p99_ms")) \
+            and win["p50_ms"] > win["p99_ms"]:
+        errors.append(f"{where}: p50_ms > p99_ms")
+    for key in ("phases_ms", "verbs"):
+        block = win.get(key)
+        if key not in win or block is None:
+            continue
+        if not isinstance(block, dict):
+            errors.append(f"{where}: {key} must be an object")
+            continue
+        for name, v in block.items():
+            if key == "phases_ms" and (not _is_num(v) or v < 0):
+                errors.append(f"{where}: phases_ms[{name!r}] must be "
+                              f"a number >= 0")
+            if key == "verbs" and (not isinstance(v, int)
+                                   or isinstance(v, bool) or v < 0):
+                errors.append(f"{where}: verbs[{name!r}] must be an "
+                              f"int >= 0")
+    return errors
+
+
+def validate_window(data: Any) -> List[str]:
+    """Validate the streaming-aggregation records of a status payload
+    (daemon/router ``window`` block: both documented rollup windows,
+    each with its full field set, non-negative rates, errors <= count,
+    p50 <= p99) and — when a ``metrics`` block is present — the
+    window gauges carrying exactly a documented ``window`` label."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["window: top level must be a JSON object"]
+    window = data.get("window")
+    if window is not None:
+        if not isinstance(window, dict):
+            errors.append("window: block must be an object or null")
+        else:
+            for key in WINDOW_KEYS:
+                if key not in window:
+                    errors.append(f"window: missing rollup {key!r}")
+                    continue
+                errors.extend(_validate_window_block(
+                    window[key], f"window[{key!r}]"))
+            for key in window:
+                if key not in WINDOW_KEYS:
+                    errors.append(f"window: unknown rollup {key!r} "
+                                  f"not in {WINDOW_KEYS}")
+    metrics = data.get("metrics", data)
+    if not isinstance(metrics, dict):
+        return errors
+    gauges = metrics.get("gauges", {})
+    if not isinstance(gauges, dict):
+        gauges = {}
+    for gname in WINDOW_GAUGES:
+        g = gauges.get(gname)
+        if not isinstance(g, dict):
+            continue
+        for j, s in enumerate(g.get("series", [])):
+            labels = s.get("labels") or {}
+            got = tuple(sorted(labels.keys()))
+            if got != ("window",):
+                errors.append(f"metrics.gauges.{gname}[{j}]: labels "
+                              f"{got} != ('window',)")
+            elif labels.get("window") not in WINDOW_KEYS:
+                errors.append(f"metrics.gauges.{gname}[{j}]: window "
+                              f"{labels.get('window')!r} not in "
+                              f"{WINDOW_KEYS}")
+            if not _is_num(s.get("value")) or s.get("value") < 0:
+                errors.append(f"metrics.gauges.{gname}[{j}]: value "
+                              f"must be a number >= 0")
+    return errors
+
+
+def _validate_triage_side(side: Any, where: str) -> List[str]:
+    errors: List[str] = []
+    if not isinstance(side, dict):
+        return [f"{where}: must be an object"]
+    for key in TRIAGE_SIDE_REQUIRED:
+        if key not in side:
+            errors.append(f"{where}: missing key {key!r}")
+    if "trace_id" in side and (not isinstance(side["trace_id"], str)
+                               or not side["trace_id"]):
+        errors.append(f"{where}: trace_id must be a non-empty string")
+    if "verb" in side and not isinstance(side["verb"], str):
+        errors.append(f"{where}: verb must be a string")
+    if "seconds" in side and (not _is_num(side["seconds"])
+                              or side["seconds"] < 0):
+        errors.append(f"{where}: seconds must be a number >= 0")
+    phases = side.get("phases_ms")
+    if "phases_ms" in side:
+        if not isinstance(phases, dict):
+            errors.append(f"{where}: phases_ms must be an object")
+        else:
+            for name, v in phases.items():
+                if not _is_num(v) or v < 0:
+                    errors.append(f"{where}: phases_ms[{name!r}] must "
+                                  f"be a number >= 0")
+    return errors
+
+
+def validate_triage(data: Any) -> List[str]:
+    """Validate one auto-captured triage bundle: a conforming
+    ``anomaly``-reason postmortem whose ``triage`` block carries the
+    breach identity (phase, z >= 0, threshold_z > 0, sustain >= 1),
+    a conforming offender (and baseline, when one was in budget), and
+    a phase-aligned diff whose rows are sorted by descending delta
+    with ``suspect_phase`` naming the top positive contributor (or
+    null/the breached phase when nothing regressed)."""
+    errors: List[str] = []
+    if not isinstance(data, dict):
+        return ["triage: top level must be a JSON object"]
+    if data.get("reason") != "anomaly":
+        errors.append(f"triage: bundle reason {data.get('reason')!r} "
+                      f"!= 'anomaly'")
+    errors.extend(validate_postmortem(data))
+    triage = data.get("triage")
+    if not isinstance(triage, dict):
+        errors.append("triage: bundle needs a 'triage' object")
+        return errors
+    for key in TRIAGE_REQUIRED:
+        if key not in triage:
+            errors.append(f"triage: missing key {key!r}")
+    if "schema" in triage and triage["schema"] != 1:
+        errors.append(f"triage: unknown schema version "
+                      f"{triage['schema']!r}")
+    for key in ("phase", "suspect_phase"):
+        v = triage.get(key)
+        if key in triage and (not isinstance(v, str) or not v):
+            errors.append(f"triage: {key} must be a non-empty string")
+    if "z" in triage and (not _is_num(triage["z"]) or triage["z"] < 0):
+        errors.append("triage: z must be a number >= 0")
+    if "threshold_z" in triage and (not _is_num(triage["threshold_z"])
+                                    or triage["threshold_z"] <= 0):
+        errors.append("triage: threshold_z must be a number > 0")
+    sustain = triage.get("sustain")
+    if "sustain" in triage and (not isinstance(sustain, int)
+                                or isinstance(sustain, bool)
+                                or sustain < 1):
+        errors.append("triage: sustain must be an int >= 1")
+    if "ts" in triage and (not _is_num(triage["ts"])
+                           or triage["ts"] < 0):
+        errors.append("triage: ts must be a number >= 0")
+    if "offender" in triage:
+        errors.extend(_validate_triage_side(triage["offender"],
+                                            "triage.offender"))
+    if triage.get("baseline") is not None:
+        errors.extend(_validate_triage_side(triage["baseline"],
+                                            "triage.baseline"))
+    diff = triage.get("diff")
+    if "diff" in triage:
+        if not isinstance(diff, list):
+            errors.append("triage: diff must be an array")
+            diff = []
+        prev = None
+        for i, row in enumerate(diff):
+            where = f"triage.diff[{i}]"
+            if not isinstance(row, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            for key in TRIAGE_DIFF_ROW_REQUIRED:
+                if key not in row:
+                    errors.append(f"{where}: missing key {key!r}")
+            for key in ("a_ms", "b_ms"):
+                if key in row and (not _is_num(row[key])
+                                   or row[key] < 0):
+                    errors.append(f"{where}: {key} must be a number "
+                                  f">= 0")
+            if "delta_ms" in row and not _is_num(row["delta_ms"]):
+                errors.append(f"{where}: delta_ms must be a number")
+            ratio = row.get("ratio")
+            if "ratio" in row and ratio is not None \
+                    and (not _is_num(ratio) or ratio < 0):
+                errors.append(f"{where}: ratio must be a number >= 0 "
+                              f"or null")
+            delta = row.get("delta_ms")
+            if _is_num(delta):
+                if prev is not None and delta > prev:
+                    errors.append(f"{where}: diff rows not sorted by "
+                                  f"descending delta_ms")
+                prev = delta
+        if isinstance(diff, list) and diff \
+                and isinstance(diff[0], dict) \
+                and _is_num(diff[0].get("delta_ms")) \
+                and diff[0]["delta_ms"] > 0 \
+                and isinstance(triage.get("suspect_phase"), str) \
+                and isinstance(triage.get("baseline"), dict) \
+                and triage["suspect_phase"] != diff[0].get("phase"):
+            errors.append(f"triage: suspect_phase "
+                          f"{triage['suspect_phase']!r} is not the "
+                          f"top positive-delta row "
+                          f"{diff[0].get('phase')!r}")
+    return errors
+
+
 def _finish(errors: List[str]) -> int:
     for err in errors:
         print(err, file=sys.stderr)
@@ -1789,6 +2190,48 @@ def main(argv: List[str]) -> int:
                 with open(path, encoding="utf-8") as fh:
                     errors.extend(f"{path}: {e}" for e in
                                   validate_export(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
+    if argv and argv[0] == "validate_sampling":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_sampling "
+                  "STATUS_OR_KEPT_TRACE_JSON [...]", file=sys.stderr)
+            return 2
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_sampling(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
+    if argv and argv[0] == "validate_window":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_window "
+                  "STATUS_JSON [...]", file=sys.stderr)
+            return 2
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_window(json.load(fh)))
+            except (OSError, json.JSONDecodeError) as exc:
+                errors.append(f"{path}: unreadable ({exc})")
+        return _finish(errors)
+    if argv and argv[0] == "validate_triage":
+        if len(argv) < 2:
+            print("usage: check_trace_schema.py validate_triage "
+                  "TRIAGE_BUNDLE_JSON [...]", file=sys.stderr)
+            return 2
+        errors = []
+        for path in argv[1:]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    errors.extend(f"{path}: {e}" for e in
+                                  validate_triage(json.load(fh)))
             except (OSError, json.JSONDecodeError) as exc:
                 errors.append(f"{path}: unreadable ({exc})")
         return _finish(errors)

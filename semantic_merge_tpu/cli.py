@@ -47,6 +47,7 @@ import pathlib
 import shutil
 import subprocess
 import sys
+import time
 from typing import Iterable, List, Sequence
 
 from .backends.base import get_backend
@@ -283,6 +284,39 @@ def build_parser() -> argparse.ArgumentParser:
                                 "traces (SEMMERGE_FLEET_TRACE_DIR "
                                 "artifacts): route / wal_fsync / relay / "
                                 "hedge_wait / member_execute")
+    p_analyze.add_argument("--since", default=None, metavar="DURATION",
+                           help="Directory mode: only artifacts modified "
+                                "within DURATION (e.g. 90s, 15m, 2h, 1d) "
+                                "— rotated trace dirs mix epochs")
+    p_tdiff = trace_sub.add_parser(
+        "diff",
+        help="Phase-aligned diff of two trace artifacts (A = offender, "
+             "B = baseline): per-phase ms delta/ratio, top contributor "
+             "named suspect_phase — manual latency attribution, same "
+             "shape the anomaly auto-triage bundles embed")
+    p_tdiff.add_argument("a", help="Offender artifact (trace, fleet "
+                                   "trace, or triage/postmortem bundle)")
+    p_tdiff.add_argument("b", help="Baseline artifact")
+    p_tdiff.add_argument("--json", action="store_true",
+                         help="Emit the diff as JSON")
+
+    p_top = sub.add_parser(
+        "top",
+        help="Live one-screen fleet dashboard: QPS, windowed p50/p99, "
+             "queue depth, breaker states, residency hit rate, mesh "
+             "occupancy, member health — polled from the daemon/router "
+             "status + federated metrics (keys: q quit, p pause)")
+    p_top.add_argument("--socket", default=None,
+                       help="Daemon or fleet-router socket (default: the "
+                            "serve socket resolution chain)")
+    p_top.add_argument("--interval", type=float, default=2.0,
+                       help="Poll interval seconds (default 2.0)")
+    p_top.add_argument("--once", action="store_true",
+                       help="Print a single frame and exit (scripts/CI; "
+                            "also the non-TTY behavior)")
+    p_top.add_argument("--json", action="store_true",
+                       help="With --once: emit the frame's source data "
+                            "as JSON instead of the rendering")
 
     p_profile = sub.add_parser(
         "profile",
@@ -388,6 +422,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             return cmd_stats(args)
         if args.command == "trace":
             return cmd_trace(args)
+        if args.command == "top":
+            return cmd_top(args)
         if args.command == "profile":
             return cmd_profile(args)
         if args.command == "perf":
@@ -1434,13 +1470,59 @@ def _bucket_span(name: str, layer) -> str | None:
     return None
 
 
-def _analyze_artifact(path: pathlib.Path) -> dict | None:
-    """One artifact's critical-path breakdown, or None when the file is
-    not span-shaped (trace artifact or postmortem bundle)."""
+def _load_span_artifact(path: pathlib.Path) -> tuple[dict | None, int]:
+    """Load one span-shaped artifact: ``(data, corrupt_lines)``.
+
+    ``.jsonl`` artifacts (daemon ``--events`` streams, rotated span
+    logs) are salvaged line by line — a truncated tail or a corrupt row
+    skips that row and counts it instead of sinking the whole file, so
+    ``trace analyze`` keeps working on exactly the artifacts written
+    while something was going wrong."""
+    if path.suffix == ".jsonl":
+        try:
+            text = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError):
+            return None, 0
+        rows, bad = [], 0
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+        if not rows:
+            return None, bad
+        return {"spans": rows, "trace_id": None}, bad
     try:
         data = json.loads(path.read_text(encoding="utf-8"))
     except (OSError, json.JSONDecodeError, UnicodeDecodeError):
-        return None
+        return None, 0
+    return data, 0
+
+
+def _parse_duration(raw: str) -> float:
+    """``90s`` / ``15m`` / ``2h`` / ``1d`` (bare numbers = seconds) →
+    seconds. Raises ValueError on nonsense."""
+    text = str(raw).strip().lower()
+    scale = 1.0
+    if text and text[-1] in "smhd":
+        scale = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}[text[-1]]
+        text = text[:-1]
+    value = float(text)
+    if value < 0:
+        raise ValueError(f"negative duration {raw!r}")
+    return value * scale
+
+
+def _analyze_artifact(path: pathlib.Path) -> dict | None:
+    """One artifact's critical-path breakdown, or None when the file is
+    not span-shaped (trace artifact or postmortem bundle)."""
+    data, corrupt = _load_span_artifact(path)
     if not isinstance(data, dict) or not isinstance(data.get("spans"), list):
         return None
     buckets = {b: 0.0 for b in CRITICAL_PATH_BUCKETS}
@@ -1465,7 +1547,7 @@ def _analyze_artifact(path: pathlib.Path) -> dict | None:
     # so they attribute rather than extend the total.
     total = cli_total + buckets["queue_wait"] + buckets["batch_window"]
     accounted = sum(buckets.values())
-    return {
+    result = {
         "artifact": str(path),
         "trace_id": data.get("trace_id"),
         "reason": data.get("reason"),
@@ -1473,6 +1555,9 @@ def _analyze_artifact(path: pathlib.Path) -> dict | None:
         "buckets": {b: round(v, 6) for b, v in buckets.items()},
         "other_seconds": round(max(total - accounted, 0.0), 6),
     }
+    if corrupt:
+        result["corrupt_lines"] = corrupt
+    return result
 
 
 def _analyze_fleet_artifact(path: pathlib.Path) -> dict | None:
@@ -1481,10 +1566,7 @@ def _analyze_fleet_artifact(path: pathlib.Path) -> dict | None:
     member execute time is carved out of the relay legs that carried
     it, relay out of the route spans that contain them — so the shares
     attribute rather than double count."""
-    try:
-        data = json.loads(path.read_text(encoding="utf-8"))
-    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
-        return None
+    data, corrupt = _load_span_artifact(path)
     if not isinstance(data, dict) or not isinstance(data.get("spans"), list):
         return None
     wal = hedge_wait = relay_ok = route_like = 0.0
@@ -1523,7 +1605,7 @@ def _analyze_fleet_artifact(path: pathlib.Path) -> dict | None:
     }
     total = wal + route_like
     accounted = sum(buckets.values())
-    return {
+    result = {
         "artifact": str(path),
         "trace_id": data.get("trace_id"),
         "reason": data.get("reason"),
@@ -1531,6 +1613,9 @@ def _analyze_fleet_artifact(path: pathlib.Path) -> dict | None:
         "buckets": {b: round(v, 6) for b, v in buckets.items()},
         "other_seconds": round(max(total - accounted, 0.0), 6),
     }
+    if corrupt:
+        result["corrupt_lines"] = corrupt
+    return result
 
 
 def _pctl(values: List[float], q: float) -> float:
@@ -1543,6 +1628,8 @@ def _pctl(values: List[float], q: float) -> float:
 def cmd_trace(args: argparse.Namespace) -> int:
     if args.trace_command == "analyze":
         return cmd_trace_analyze(args)
+    if args.trace_command == "diff":
+        return cmd_trace_diff(args)
     return 2
 
 
@@ -1554,16 +1641,54 @@ def cmd_trace_analyze(args: argparse.Namespace) -> int:
     analyze = _analyze_fleet_artifact if fleet else _analyze_artifact
     order = FLEET_PATH_BUCKETS if fleet else CRITICAL_PATH_BUCKETS
     path = pathlib.Path(args.artifact)
+    since_s = None
+    if getattr(args, "since", None):
+        try:
+            since_s = _parse_duration(args.since)
+        except (ValueError, KeyError):
+            print(f"error: bad --since duration {args.since!r} "
+                  f"(want e.g. 90s, 15m, 2h, 1d)", file=sys.stderr)
+            return 2
     if path.is_dir():
-        results = [r for r in (analyze(p)
-                               for p in sorted(path.glob("*.json")))
-                   if r is not None]
+        candidates = sorted(list(path.glob("*.json"))
+                            + list(path.glob("*.jsonl")))
+        if since_s is not None:
+            cutoff = time.time() - since_s
+            aged = len(candidates)
+            candidates = [p for p in candidates
+                          if p.stat().st_mtime >= cutoff]
+            aged -= len(candidates)
+        else:
+            aged = 0
+        results, skipped, corrupt_lines = [], 0, 0
+        for p in candidates:
+            r = analyze(p)
+            if r is None:
+                skipped += 1
+                continue
+            corrupt_lines += int(r.pop("corrupt_lines", 0) or 0)
+            results.append(r)
+        if skipped or corrupt_lines:
+            # Rotated/chaos-era dirs legitimately hold truncated or
+            # corrupt artifacts; report what was passed over instead
+            # of crashing on it or hiding it.
+            parts = []
+            if skipped:
+                parts.append(f"{skipped} corrupt/non-span artifact(s)")
+            if corrupt_lines:
+                parts.append(f"{corrupt_lines} corrupt JSONL line(s)")
+            print(f"note: skipped {', '.join(parts)} under {path}",
+                  file=sys.stderr)
         if not results:
-            print(f"error: no span-shaped artifacts under {path}",
+            print(f"error: no span-shaped artifacts under {path}"
+                  + (f" within the last {args.since}" if since_s is not None
+                     and aged else ""),
                   file=sys.stderr)
             return 1
         summary = {
             "requests": len(results),
+            "skipped": skipped,
+            "corrupt_lines": corrupt_lines,
             "p50": {}, "p99": {},
             "results": results,
         }
@@ -1605,6 +1730,217 @@ def cmd_trace_analyze(args: argparse.Namespace) -> int:
     v = result["other_seconds"]
     print(f"{'other':<14} {v * 1e3:>10.1f} {v / total:>6.1%}")
     return 0
+
+
+def _artifact_phases(path: pathlib.Path) -> tuple[dict | None, str]:
+    """Per-phase wall seconds of one artifact, plus its display id.
+    Accepts span-shaped artifacts (trace / fleet trace / postmortem)
+    and triage bundles (whose ``offender.phases_ms`` is already a
+    phase map)."""
+    data, _corrupt = _load_span_artifact(path)
+    if not isinstance(data, dict):
+        return None, "-"
+    tid = str(data.get("trace_id") or "-")
+    triage = data.get("triage")
+    if isinstance(triage, dict) and isinstance(
+            triage.get("offender"), dict):
+        phases_ms = triage["offender"].get("phases_ms") or {}
+        return ({str(k): float(v) / 1000.0 for k, v in phases_ms.items()},
+                str(triage["offender"].get("trace_id") or tid))
+    spans = data.get("spans")
+    if not isinstance(spans, list):
+        return None, tid
+    phases: dict = {}
+    for row in spans:
+        if not isinstance(row, dict):
+            continue
+        name = str(row.get("name") or "?")
+        try:
+            phases[name] = phases.get(name, 0.0) + \
+                float(row.get("seconds") or 0.0)
+        except (TypeError, ValueError):
+            continue
+    return phases, tid
+
+
+def cmd_trace_diff(args: argparse.Namespace) -> int:
+    """Phase-aligned diff of two artifacts — the manual-attribution
+    twin of the anomaly auto-triage bundle (same diff rows, same
+    suspect_phase semantics, via :func:`obs.anomaly.phase_diff`)."""
+    from .obs import anomaly as obs_anomaly
+    path_a, path_b = pathlib.Path(args.a), pathlib.Path(args.b)
+    for path in (path_a, path_b):
+        if not path.is_file():
+            print(f"error: no artifact at {path}", file=sys.stderr)
+            return 1
+    a_phases, a_id = _artifact_phases(path_a)
+    b_phases, b_id = _artifact_phases(path_b)
+    if a_phases is None or b_phases is None:
+        bad = path_a if a_phases is None else path_b
+        print(f"error: {bad} is not a span-shaped trace artifact",
+              file=sys.stderr)
+        return 1
+    diff = obs_anomaly.phase_diff(a_phases, b_phases)
+    result = {"a": {"artifact": str(path_a), "trace_id": a_id},
+              "b": {"artifact": str(path_b), "trace_id": b_id},
+              "suspect_phase": diff["suspect_phase"],
+              "phases": diff["phases"]}
+    if args.json:
+        print(json.dumps(result, indent=2))
+        return 0
+    print(f"trace diff  A={a_id}  B={b_id}")
+    print(f"{'phase':<24} {'A ms':>10} {'B ms':>10} {'delta':>10} "
+          f"{'ratio':>7}")
+    for row in diff["phases"]:
+        ratio = f"{row['ratio']:.2f}x" if row["ratio"] is not None else "-"
+        print(f"{row['phase']:<24} {row['a_ms']:>10.1f} "
+              f"{row['b_ms']:>10.1f} {row['delta_ms']:>+10.1f} "
+              f"{ratio:>7}")
+    if diff["suspect_phase"]:
+        print(f"suspect phase: {diff['suspect_phase']}")
+    return 0
+
+
+def _top_fetch(socket_path: str | None) -> dict:
+    """One poll of the dashboard's data: daemon/router status, plus
+    member statuses through the router when the target is a fleet."""
+    from .service.client import call_control
+    status = call_control("status", path=socket_path)
+    members = None
+    if status.get("fleet"):
+        try:
+            members = call_control("member_status",
+                                   path=socket_path).get("members")
+        except Exception:
+            members = None
+    return {"status": status, "members": members}
+
+
+def _render_top_frame(snap: dict) -> str:
+    """One dashboard screen from a `_top_fetch` snapshot."""
+    status = snap["status"]
+    lines: List[str] = []
+    fleet = bool(status.get("fleet"))
+    window = status.get("window") or {}
+    w1s, w1m = window.get("1s") or {}, window.get("1m") or {}
+    head = "fleet router" if fleet else "merge daemon"
+    lines.append(
+        f"semmerge top — {head} pid {status.get('pid')}  "
+        f"uptime {status.get('uptime_s', 0):.0f}s  "
+        f"socket {status.get('socket')}")
+    lines.append(
+        f"  qps {w1s.get('qps', 0):>7.1f}/s (1s) {w1m.get('qps', 0):>7.2f}/s (1m)   "
+        f"p50 {w1m.get('p50_ms', 0):>8.1f} ms   "
+        f"p99 {w1m.get('p99_ms', 0):>8.1f} ms   "
+        f"err {w1m.get('error_rate', 0):>6.2%}")
+    res = status.get("resilience") or {}
+    breakers = res.get("breakers") or {}
+    tripped = sorted(n for n, s in breakers.items() if s != "closed")
+    lines.append(
+        f"  queue {status.get('queue_depth', 0):>3}  "
+        f"in-flight {status.get('in_flight', 0):>3}  "
+        f"served {status.get('served_total', 0):>6}  "
+        f"pressure {res.get('pressure', '-')}  "
+        f"breakers {('OPEN:' + ','.join(tripped)) if tripped else 'closed'}")
+    residency = status.get("residency") or {}
+    r_hit = (f"{residency.get('hit_rate', 0.0):.1%}"
+             if residency.get("lookups") else "-")
+    batch = status.get("batch") or {}
+    mesh = batch.get("mesh") or {}
+    mesh_occ = mesh.get("last_rows_per_chip")
+    sampling = status.get("sampling") or {}
+    store = status.get("trace_store") or {}
+    lines.append(
+        f"  residency hit {r_hit}  "
+        f"mesh occupancy {mesh_occ if mesh_occ is not None else '-'}  "
+        f"sampling {'on' if sampling.get('enabled') else 'keep-all'}  "
+        f"trace store {store.get('count', '-')} files"
+        + (f" ({store.get('bytes', 0) / 1048576.0:.1f}/"
+           f"{store.get('budget_bytes', 0) / 1048576.0:.0f} MB)"
+           if store else ""))
+    anomaly = status.get("anomaly") or {}
+    if anomaly.get("latched"):
+        lines.append(f"  ANOMALY latched: {', '.join(anomaly['latched'])}"
+                     f"  (bundles fired: {anomaly.get('fired', 0)})")
+    slo = status.get("slo")
+    if isinstance(slo, dict):
+        lines.append(f"  slo {'HEALTHY' if slo.get('healthy', True) else 'BURNING'}")
+    members = snap.get("members")
+    if fleet:
+        lines.append("")
+        lines.append(f"  {'member':<8} {'state':<10} {'qps(1m)':>8} "
+                     f"{'p99 ms':>8} {'queue':>6} {'in-fl':>6} "
+                     f"{'served':>7}")
+        rows = status.get("members") or []
+        by_id = {}
+        if isinstance(members, dict):
+            by_id = {mid: m for mid, m in members.items()
+                     if isinstance(m, dict)}
+        for view in rows:
+            if not isinstance(view, dict):
+                continue
+            mid = str(view.get("id") or "?")
+            mstat = by_id.get(mid) or {}
+            mwin = (mstat.get("window") or {}).get("1m") or {}
+            lines.append(
+                f"  {mid:<8} {str(view.get('state', '?')):<10} "
+                f"{mwin.get('qps', 0):>8.2f} "
+                f"{mwin.get('p99_ms', 0):>8.1f} "
+                f"{mstat.get('queue_depth', 0):>6} "
+                f"{mstat.get('in_flight', 0):>6} "
+                f"{mstat.get('served_total', 0):>7}")
+    return "\n".join(lines)
+
+
+def cmd_top(args: argparse.Namespace) -> int:
+    """Live one-screen dashboard. Interactive on a TTY (q quits,
+    p pauses); ``--once`` (or a non-TTY stdout) prints one frame and
+    exits, so scripts and tests get a stable surface."""
+    from .service.client import DaemonUnavailable
+    interactive = (not args.once and sys.stdout.isatty()
+                   and sys.stdin.isatty())
+    try:
+        snap = _top_fetch(args.socket)
+    except DaemonUnavailable as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    if not interactive:
+        if args.json:
+            print(json.dumps(snap, indent=2, default=str))
+        else:
+            print(_render_top_frame(snap))
+        return 0
+    import select
+    import termios
+    import tty
+    fd = sys.stdin.fileno()
+    old_attrs = termios.tcgetattr(fd)
+    paused = False
+    try:
+        tty.setcbreak(fd)
+        while True:
+            if not paused:
+                try:
+                    snap = _top_fetch(args.socket)
+                    frame = _render_top_frame(snap)
+                except DaemonUnavailable as exc:
+                    frame = f"daemon unreachable: {exc}"
+                sys.stdout.write("\x1b[2J\x1b[H" + frame
+                                 + "\n\n  q quit · p pause\n")
+                sys.stdout.flush()
+            ready, _, _ = select.select([fd], [], [],
+                                        max(0.2, args.interval))
+            if ready:
+                key = os.read(fd, 1).decode("utf-8", "replace").lower()
+                if key == "q":
+                    return 0
+                if key == "p":
+                    paused = not paused
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old_attrs)
+        sys.stdout.write("\n")
 
 
 def cmd_train_matcher(args: argparse.Namespace) -> int:

@@ -72,9 +72,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..errors import (FleetFault, MergeFault, TransportFault,
                       fault_boundary)
+from ..obs import agg as obs_agg
 from ..obs import export as obs_export
 from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
+from ..obs import sampling as obs_sampling
 from ..obs import slo as obs_slo
 from ..obs import spans as obs_spans
 from ..service import protocol, telemetry
@@ -178,6 +180,10 @@ class _Member:
         self.remote = sup is None
         self.in_ring = False
         self.draining = False
+        # When the router last *initiated* a drain of this member —
+        # health probes that started before this instant carry a
+        # pre-drain heartbeat and must not flip the member back.
+        self.drain_ts = 0.0
         self.dead = False
         self.fail_streak = 0
         self.last_fault: Optional[str] = None
@@ -286,6 +292,15 @@ class FleetRouter:
             "SEMMERGE_FLEET_STITCH", "on").strip().lower() != "off"
         self._trace_dir = os.environ.get(
             "SEMMERGE_FLEET_TRACE_DIR", "").strip() or None
+        # PR 20: the trace dir is a byte-budgeted rotating store
+        # (SEMMERGE_TRACE_BUDGET_MB / SEMMERGE_TRACE_KEEP) instead of
+        # append-forever; the router mints/merges one sampling verdict
+        # per trace (member decisions arrive in wire meta and can only
+        # be upgraded here) and keeps 1 s/1 m routed-latency rollups.
+        self._trace_store = (obs_sampling.TraceStore(self._trace_dir)
+                             if self._trace_dir else None)
+        self._sampler = obs_sampling.SamplingPolicy(minted_by="router")
+        self._window = obs_agg.WindowAggregator()
         # Sealing a stitched trace (artifact write + OTLP serialize)
         # happens off the response path: requests hand their recorder
         # to a bounded background queue; a full queue drops the trace
@@ -712,16 +727,22 @@ class FleetRouter:
                         continue
                     if not member.sup.running():
                         continue
+                t_probe = time.monotonic()
                 alive, draining = self._probe(member)
                 if alive:
                     member.fail_streak = 0
                     member.dead = False
                     if member.metrics_port is None:
                         self._discover_port(member)
-                    member.draining = draining
                     if draining:
+                        member.draining = True
                         self._set_ring(member, False, "drain")
-                    else:
+                    elif t_probe > member.drain_ts:
+                        # A probe that began before the drain verb ran
+                        # read a pre-drain heartbeat; acting on it
+                        # would undo a deliberate drain. The next tick
+                        # sees the member's real (draining) answer.
+                        member.draining = False
                         self._set_ring(member, True, "join")
                 else:
                     member.fail_streak += 1
@@ -887,6 +908,7 @@ class FleetRouter:
                 self._seen_set.add(key)
         rec = obs_spans.SpanRecorder(detailed=False) if self._stitch \
             else None
+        t_dispatch = time.monotonic()
         with obs_spans.request_scope(trace_id, rec):
             with fault_boundary("fleet:route"):
                 faults.check("fleet:route")
@@ -898,14 +920,49 @@ class FleetRouter:
                 response = self._route(method, params, key, idem, rec)
         self._wal.ack(idem)
         if rec is not None:
+            decision = self._mint_sampling(
+                trace_id, method, response, rec,
+                time.monotonic() - t_dispatch)
             try:
-                self._trace_q.put_nowait((trace_id, rec))
+                self._trace_q.put_nowait((trace_id, rec, decision))
             except queue.Full:
                 obs_metrics.REGISTRY.counter(
                     "fleet_trace_dropped_total",
                     "Stitched traces dropped on a full sealer queue."
                 ).inc(1)
         return response
+
+    def _mint_sampling(self, trace_id: str, method: str,
+                       response: Dict[str, Any],
+                       rec: obs_spans.SpanRecorder,
+                       elapsed: float) -> obs_sampling.Decision:
+        """Settle the trace's final keep/drop verdict. The winning
+        member minted one at its own terminal outcome and shipped it in
+        wire ``meta``; the router adds the criteria only it can see
+        (end-to-end latency against its rolling p99, failovers,
+        transport errors) and may *upgrade* drop→keep — never the
+        reverse — so every process agrees about this trace id."""
+        result = response.get("result") \
+            if isinstance(response, dict) else None
+        meta = result.get("meta") if isinstance(result, dict) else None
+        member_dec = obs_sampling.Decision.from_meta(
+            meta.get(obs_sampling.META_KEY)) \
+            if isinstance(meta, dict) else None
+        rows = rec.span_dicts()
+        flags = obs_sampling.outcome_flags(rows)
+        error = flags["error"] or not isinstance(result, dict)
+        failover = any(r.get("name") == "fleet.failover" for r in rows)
+        local = self._sampler.decide(
+            trace_id, method, elapsed, error=error,
+            degraded=flags["degraded"],
+            breaker=flags["breaker"] or failover,
+            resolver=flags["resolver"])
+        final = member_dec.upgrade(local) if member_dec is not None \
+            else local
+        if isinstance(meta, dict):
+            meta[obs_sampling.META_KEY] = final.to_meta()
+        self._window.observe(method, elapsed, error=error)
+        return final
 
     def _route(self, method: str, params: Dict[str, Any], key: str,
                idem: str,
@@ -1213,34 +1270,32 @@ class FleetRouter:
             item = self._trace_q.get()
             if item is None:
                 return
-            trace_id, rec = item
+            trace_id, rec, decision = item
             try:
-                self._finish_trace(trace_id, rec)
+                self._finish_trace(trace_id, rec, decision)
             except Exception:
                 logger.exception("trace seal failed for %s", trace_id)
 
-    def _finish_trace(self, trace_id: str,
-                      rec: obs_spans.SpanRecorder) -> None:
-        """Seal one stitched trace: persist the artifact when
-        ``SEMMERGE_FLEET_TRACE_DIR`` is set, ship it OTLP-ward when an
-        exporter is configured. Best-effort on both paths — a full disk
-        or a dead collector must never fail a routed merge."""
+    def _finish_trace(self, trace_id: str, rec: obs_spans.SpanRecorder,
+                      decision: Optional[obs_sampling.Decision] = None
+                      ) -> None:
+        """Seal one stitched trace: persist the artifact through the
+        byte-budgeted store when ``SEMMERGE_FLEET_TRACE_DIR`` is set,
+        ship it OTLP-ward when an exporter is configured — both only
+        for *kept* traces (a dropped verdict frees the disk and the
+        collector alike). Best-effort on both paths — a full disk or a
+        dead collector must never fail a routed merge."""
         rows = rec.span_dicts()
         if not rows:
             return
-        if self._trace_dir:
+        if decision is not None and not decision.keep:
+            return
+        if self._trace_store is not None:
             artifact = {"schema": 1, "kind": "fleet-trace",
                         "trace_id": trace_id, "router_pid": os.getpid(),
                         "socket": self._socket_path, "spans": rows}
-            try:
-                os.makedirs(self._trace_dir, exist_ok=True)
-                path = os.path.join(self._trace_dir, f"{trace_id}.json")
-                tmp = f"{path}.tmp"
-                with open(tmp, "w", encoding="utf-8") as fh:
-                    json.dump(artifact, fh, default=str)
-                os.replace(tmp, path)
-            except OSError:
-                pass
+            self._trace_store.write(trace_id, artifact,
+                                    decision=decision)
         exporter = obs_export.maybe_exporter()
         if exporter is not None:
             exporter.export_trace(trace_id, rows)
@@ -1252,6 +1307,7 @@ class FleetRouter:
         plus ``fleet_member_up`` rollups. Scrape failures count in
         ``fleet_scrape_errors_total`` and drop that member's block —
         a wedged member must not wedge the fleet scrape."""
+        self._window.publish()
         up = obs_metrics.REGISTRY.gauge(
             "fleet_member_up", "Ring membership by member (1=in ring)")
         draining = obs_metrics.REGISTRY.gauge(
@@ -1399,9 +1455,16 @@ class FleetRouter:
             if member is None:
                 return {"ok": False,
                         "error": f"unknown member {member_id!r}"}
+            # Block health-probe downgrades outright while the drain
+            # verb is in flight — any probe that starts before the
+            # member acks may still read a pre-drain heartbeat — then
+            # stamp the ack time so only genuinely-later probes (the
+            # member undraining itself) can return it to the ring.
+            member.drain_ts = float("inf")
             member.draining = True
             self._set_ring(member, False, "drain")
             result = self._member_call(member, "drain", {}, timeout=5.0)
+            member.drain_ts = time.monotonic()
             return {"ok": True, "member": member.id,
                     "member_ack": result}
         self._draining = True
@@ -1444,5 +1507,9 @@ class FleetRouter:
                                         3)},
             "stitch": self._stitch,
             "slo": self._slo.status() if self._slo is not None else None,
+            "window": self._window.window(),
+            "sampling": self._sampler.stats(),
+            "trace_store": (self._trace_store.stats()
+                            if self._trace_store is not None else None),
             "metrics": obs_metrics.REGISTRY.to_dict(),
         }

@@ -22,6 +22,11 @@ Knobs:
 - ``SEMMERGE_POSTMORTEM_DIR`` — override the bundle directory (the
   default is ``.semmerge-postmortem/`` under the caller-provided root,
   typically the merge repo's work tree).
+- ``SEMMERGE_POSTMORTEM_KEEP`` / ``SEMMERGE_POSTMORTEM_BUDGET_MB`` —
+  retention caps on the bundle directory (default 64 bundles / 64 MB;
+  ``0`` disables a cap). The directory was append-forever before PR 20;
+  now every dump prunes oldest-first past either cap and counts the
+  evictions in ``postmortem_pruned_total``.
 
 Import cost stays trivial (stdlib only — the :mod:`obs` package
 contract); the per-span cost is one dict build and a deque append
@@ -55,7 +60,13 @@ POSTMORTEM_SCHEMA = 1
 #: Documented ``reason`` values a bundle may carry.
 REASONS = ("fault-escape", "degradation", "breaker-transition",
            "supervisor-restart", "daemon-drain", "slo-burn",
-           "resolver-fault", "fleet-failover")
+           "resolver-fault", "fleet-failover", "anomaly")
+
+#: Retention caps for the bundle directory.
+ENV_KEEP = "SEMMERGE_POSTMORTEM_KEEP"
+ENV_BUDGET_MB = "SEMMERGE_POSTMORTEM_BUDGET_MB"
+DEFAULT_KEEP = 64
+DEFAULT_BUDGET_MB = 64.0
 
 _lock = threading.Lock()
 _ring: Optional[deque] = None
@@ -207,6 +218,30 @@ def dump(trace_id: Optional[str], reason: str, *,
             "postmortem_bundles_total",
             "Postmortem flight-recorder bundles written, by reason").inc(
                 1, reason=reason)
+        _prune_bundles(out_dir)
         return path
     except Exception:
         return None
+
+
+def _cap(env: str, default: float) -> Optional[float]:
+    """Parse a retention cap; ``0`` (or negative) disables it."""
+    raw = os.environ.get(env, "").strip()
+    try:
+        value = float(raw) if raw else default
+    except ValueError:
+        value = default
+    return value if value > 0 else None
+
+
+def _prune_bundles(out_dir: pathlib.Path) -> int:
+    """Enforce the bundle-directory retention caps (oldest first)."""
+    from . import sampling  # local import: keep module import cost flat
+    keep = _cap(ENV_KEEP, DEFAULT_KEEP)
+    budget = _cap(ENV_BUDGET_MB, DEFAULT_BUDGET_MB)
+    return sampling.prune_dir(
+        out_dir,
+        max_count=int(keep) if keep is not None else None,
+        max_bytes=int(budget * 1024 * 1024) if budget is not None else None,
+        counter="postmortem_pruned_total",
+        dir=str(out_dir.name))

@@ -46,6 +46,36 @@ def _label_key(labels: Dict[str, object]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
+#: Per-metric series-cardinality budget (``SEMMERGE_METRICS_MAX_SERIES``,
+#: default 512; ``0`` disables the cap). At production QPS unbounded
+#: label sets (per-repo, per-member, per-category) make the registry
+#: itself the outage — past the budget, NEW label sets collapse into one
+#: overflow series and ``metrics_series_dropped_total`` counts them.
+ENV_MAX_SERIES = "SEMMERGE_METRICS_MAX_SERIES"
+DEFAULT_MAX_SERIES = 512
+OVERFLOW_KEY: LabelKey = (("overflow", "true"),)
+SERIES_DROPPED = "metrics_series_dropped_total"
+
+
+def series_budget() -> int:
+    raw = os.environ.get(ENV_MAX_SERIES, "").strip()
+    if not raw:
+        return DEFAULT_MAX_SERIES
+    try:
+        return max(0, int(float(raw)))
+    except ValueError:
+        return DEFAULT_MAX_SERIES
+
+
+def _note_series_dropped(metric_name: str) -> None:
+    if metric_name == SERIES_DROPPED:  # the counter never recurses
+        return
+    REGISTRY.counter(
+        SERIES_DROPPED,
+        "New label sets rejected by the per-metric cardinality budget"
+    ).inc(1, metric=metric_name)
+
+
 class _Metric:
     kind = "untyped"
 
@@ -54,6 +84,18 @@ class _Metric:
         self.help = help
         self._lock = threading.Lock()
         self._series: Dict[LabelKey, object] = {}
+
+    def _admit(self, key: LabelKey) -> LabelKey:
+        """Cardinality gate (caller holds ``self._lock``): an existing
+        series always records; a NEW one past the budget is rerouted to
+        the overflow series so hot paths stay bounded either way."""
+        if key in self._series or key == OVERFLOW_KEY:
+            return key
+        budget = series_budget()
+        if budget <= 0 or len(self._series) < budget:
+            return key
+        _note_series_dropped(self.name)
+        return OVERFLOW_KEY
 
     def _labelled(self) -> List[Tuple[LabelKey, object]]:
         with self._lock:
@@ -66,6 +108,7 @@ class Counter(_Metric):
     def inc(self, value: float = 1.0, **labels: object) -> None:
         key = _label_key(labels)
         with self._lock:
+            key = self._admit(key)
             self._series[key] = self._series.get(key, 0.0) + value
 
     def value(self, **labels: object) -> float:
@@ -78,12 +121,13 @@ class Gauge(_Metric):
 
     def set(self, value: float, **labels: object) -> None:
         with self._lock:
-            self._series[_label_key(labels)] = float(value)
+            self._series[self._admit(_label_key(labels))] = float(value)
 
     def max(self, value: float, **labels: object) -> None:
         """High-water-mark update: keep the larger of current/new."""
         key = _label_key(labels)
         with self._lock:
+            key = self._admit(key)
             prev = self._series.get(key)
             if prev is None or value > prev:
                 self._series[key] = float(value)
@@ -107,6 +151,7 @@ class Histogram(_Metric):
                 **labels: object) -> None:
         key = _label_key(labels)
         with self._lock:
+            key = self._admit(key)
             series = self._series.get(key)
             if series is None:
                 # counts has one slot per finite bucket plus +Inf.

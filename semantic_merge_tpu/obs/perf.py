@@ -73,6 +73,12 @@ GUARDED_FIELDS = {
     # budget rather than a (noise-floor) measurement, so the guard
     # trips exactly when the budget does.
     "fleet_trace_overhead_pct": "lower",
+    # Telcost preset (PR-20): the full per-request telemetry pipeline
+    # (sampling verdict + window rollup + anomaly observation + trace
+    # store write) as a percent of dark merge latency. Like the fleet
+    # trace leg, the baseline anchors the documented 2% budget so the
+    # guard trips exactly when the budget does.
+    "telemetry_overhead_pct": "lower",
     # Devtail preset (PR-18): the post-kernel host tail
     # (compose_materialize + serialize, disjoint accounting) must not
     # creep back up once the device-render path owns serialization, and

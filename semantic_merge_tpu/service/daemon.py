@@ -61,8 +61,11 @@ from typing import Any, Dict, Optional
 
 from ..errors import DeadlineFault, MergeFault, WorkerFault, fault_boundary
 from ..fleet import transport as fleet_transport
+from ..obs import agg as obs_agg
+from ..obs import anomaly as obs_anomaly
 from ..obs import export as obs_export
 from ..obs import metrics as obs_metrics
+from ..obs import sampling as obs_sampling
 from ..obs import slo as obs_slo
 from ..obs import spans as obs_spans
 from ..obs import flight as obs_flight
@@ -272,6 +275,17 @@ class Daemon:
         # requests would corrupt each other.
         self._profile_lock = threading.Lock()
         self._autoprofiled = False
+        # Telemetry pipeline (PR 20): windowed rollups feed /metrics and
+        # the status `window` block; the sampling policy mints one
+        # keep/drop verdict per terminal outcome (propagated in wire
+        # meta); the anomaly bank escalates sustained per-phase
+        # regressions into triage bundles. The trace store is only
+        # live when SEMMERGE_TRACE_DIR points somewhere.
+        self._window = obs_agg.WindowAggregator()
+        self._sampler = obs_sampling.SamplingPolicy(
+            minted_by=self._fleet_member or "daemon")
+        self._anomaly = obs_anomaly.AnomalyTriage()
+        self._trace_store = obs_sampling.TraceStore.from_env()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -321,7 +335,8 @@ class Daemon:
                              daemon=True).start()
             logger.info("SLO engine active: %s",
                         "; ".join(c.text for c in self._slo.clauses))
-        self._telemetry = telemetry.maybe_start(self.status)
+        self._telemetry = telemetry.maybe_start(self.status,
+                                                self._render_metrics)
         if self._telemetry is not None:
             logger.info("telemetry listening on 127.0.0.1:%d "
                         "(/metrics, /healthz)", self._telemetry.port)
@@ -831,6 +846,7 @@ class Daemon:
                 from ..frontend.declcache import publish_metrics
                 publish_metrics()
                 self._count_request(verb, outcome)
+                self._finish_telemetry(req, verb, outcome, queue_wait)
                 if self._recorder is not None:
                     # --events: graft the request's scoped spans into
                     # the daemon-lifetime recorder, tagged by trace_id,
@@ -876,6 +892,60 @@ class Daemon:
                 sys.stdout.pop()
                 sys.stderr.pop()
         return code, out_buf.getvalue(), err_buf.getvalue(), t_start, t_end
+
+    def _finish_telemetry(self, req: _Request, verb: str, outcome: str,
+                          queue_wait: float) -> None:
+        """Terminal-outcome telemetry: mint the sampling verdict,
+        attach it to wire ``meta``, feed the window rollups and the
+        anomaly bank, and persist kept traces. Runs inside the
+        request's scope finally-block; must never raise."""
+        try:
+            total_s = time.monotonic() - req.t_accept
+            rows = req.recorder.span_dicts()
+            phases: Dict[str, float] = {}
+            for row in rows:
+                name = str(row.get("name") or "?")
+                try:
+                    phases[name] = phases.get(name, 0.0) + \
+                        float(row.get("seconds") or 0.0)
+                except (TypeError, ValueError):
+                    continue
+            flags = obs_sampling.outcome_flags(rows)
+            error_flag = flags["error"] or outcome not in (
+                "ok", "conflicts", "typecheck")
+            decision = self._sampler.decide(
+                req.trace_id, verb, total_s, error=error_flag,
+                degraded=flags["degraded"], breaker=flags["breaker"],
+                resolver=flags["resolver"])
+            self._window.observe(verb, total_s, error=error_flag,
+                                 phases=phases)
+            self._anomaly.observe(
+                req.trace_id, verb, phases, seconds=total_s,
+                spans=rows if rows else None, root=req.cwd)
+            if isinstance(req.response, dict) and \
+                    isinstance(req.response.get("result"), dict):
+                req.response["result"].setdefault("meta", {})[
+                    obs_sampling.META_KEY] = decision.to_meta()
+            if decision.keep and self._trace_store is not None:
+                self._trace_store.write(req.trace_id, {
+                    "schema": 1,
+                    "kind": "trace",
+                    "trace_id": req.trace_id,
+                    "verb": verb,
+                    "outcome": outcome,
+                    "seconds": round(total_s, 6),
+                    "queue_wait_s": round(queue_wait, 6),
+                    "spans": rows,
+                }, decision=decision)
+        except Exception:
+            logger.debug("telemetry pipeline error", exc_info=True)
+
+    def _render_metrics(self) -> str:
+        """Live ``/metrics`` exposition with the window gauges freshly
+        published — scrapes see current-window p50/p99/QPS, not the
+        values from the last request."""
+        self._window.publish()
+        return obs_metrics.REGISTRY.render_prometheus()
 
     def _repo_lock_for(self, req: _Request):
         """Same-repo ``--inplace`` requests serialize; everything else
@@ -1162,6 +1232,11 @@ class Daemon:
             "batch": scheduler.stats() if scheduler is not None else None,
             "residency": residency_mod.cache().stats(),
             "slo": self._slo.status() if self._slo is not None else None,
+            "window": self._window.window(),
+            "sampling": self._sampler.stats(),
+            "anomaly": self._anomaly.stats(),
+            "trace_store": (self._trace_store.stats()
+                            if self._trace_store is not None else None),
             "resilience": {
                 "pressure": self._pressure,
                 "rss_soft_mb": self._soft_mb,

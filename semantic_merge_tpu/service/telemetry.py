@@ -18,6 +18,14 @@ operator loopback, not an ingress):
 reported in the daemon ``status()`` payload (``metrics_port``) so
 tests and tooling can discover it. Unset/empty disables the listener
 entirely — the daemon never opens a TCP socket unless asked.
+
+``SEMMERGE_METRICS_BIND=<host>`` widens the bind address so cross-host
+fleets can scrape members directly instead of tunneling loopback — but
+only under TLS: a non-loopback bind is **refused** (the listener stays
+dark, loudly) unless the PR-19 fleet TLS material
+(``SEMMERGE_FLEET_TLS_CERT``/``_KEY``/``_CA``) is configured, in which
+case the listener serves HTTPS with the same cert, and a configured CA
+makes it mutual (scrapers must present a cert chaining to it).
 """
 from __future__ import annotations
 
@@ -27,9 +35,18 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
+from ..fleet import transport as fleet_transport
 from ..obs import metrics as obs_metrics
+from ..utils.loggingx import logger
 
 ENV_PORT = "SEMMERGE_METRICS_PORT"
+ENV_BIND = "SEMMERGE_METRICS_BIND"
+
+_LOOPBACK = ("127.0.0.1", "::1", "localhost", "")
+
+
+def _bind_host() -> str:
+    return os.environ.get(ENV_BIND, "").strip() or "127.0.0.1"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -79,8 +96,24 @@ class TelemetryServer:
 
     def __init__(self, port: int,
                  health_fn: Callable[[], dict],
-                 metrics_fn: Optional[Callable[[], str]] = None) -> None:
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+                 metrics_fn: Optional[Callable[[], str]] = None,
+                 host: Optional[str] = None) -> None:
+        bind = host if host is not None else _bind_host()
+        tls_ctx = None
+        if bind not in _LOOPBACK:
+            # Widened bind: TLS or nothing. Serving plaintext metrics
+            # on a routable interface leaks repo paths and member
+            # topology; the PR-19 fleet material secures it for free.
+            tls_ctx = fleet_transport.server_context()
+            if tls_ctx is None:
+                raise ValueError(
+                    f"refusing non-loopback metrics bind {bind!r} "
+                    f"without SEMMERGE_FLEET_TLS_CERT material")
+        self._httpd = ThreadingHTTPServer((bind, port), _Handler)
+        if tls_ctx is not None:
+            self._httpd.socket = tls_ctx.wrap_socket(
+                self._httpd.socket, server_side=True)
+        self.tls = tls_ctx is not None
         self._httpd.daemon_threads = True
         self._httpd.semmerge_health = health_fn  # type: ignore[attr-defined]
         # Optional exposition override: the fleet router serves its
@@ -124,5 +157,10 @@ def maybe_start(health_fn: Callable[[], dict],
         return None
     try:
         return TelemetryServer(port, health_fn, metrics_fn).start()
+    except ValueError as exc:
+        # Refused non-loopback bind: stay dark, but say why — a fleet
+        # operator expecting remote scrapes should not debug silence.
+        logger.error("telemetry listener disabled: %s", exc)
+        return None
     except OSError:
         return None

@@ -1,0 +1,160 @@
+"""Streaming-aggregation layer (obs/agg.py): quantile-sketch accuracy
+and merge properties, windowed rollups, and gauge publication."""
+import random
+
+import pytest
+
+from semantic_merge_tpu.obs import agg as obs_agg
+from semantic_merge_tpu.obs import metrics as obs_metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    obs_metrics.REGISTRY.reset()
+    yield
+    obs_metrics.REGISTRY.reset()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# QuantileSketch
+
+
+def test_sketch_relative_error_bound():
+    rng = random.Random(7)
+    values = [rng.lognormvariate(0.0, 1.0) for _ in range(5000)]
+    sk = obs_agg.QuantileSketch(alpha=0.01)
+    for v in values:
+        sk.observe(v)
+    values.sort()
+    for q in (0.5, 0.9, 0.99):
+        exact = values[int(q * (len(values) - 1))]
+        est = sk.quantile(q)
+        # Log-bucket guarantee: relative error bounded by alpha (plus
+        # a small rank-interpolation slop on the exact quantile).
+        assert abs(est - exact) / exact < 3 * sk.alpha
+
+
+def test_sketch_merge_equals_union_stream():
+    rng = random.Random(11)
+    a_vals = [rng.uniform(0.001, 1.0) for _ in range(800)]
+    b_vals = [rng.uniform(0.5, 10.0) for _ in range(1200)]
+    a = obs_agg.QuantileSketch(alpha=0.01)
+    b = obs_agg.QuantileSketch(alpha=0.01)
+    union = obs_agg.QuantileSketch(alpha=0.01)
+    for v in a_vals:
+        a.observe(v)
+        union.observe(v)
+    for v in b_vals:
+        b.observe(v)
+        union.observe(v)
+    merged = a.merge(b)
+    assert merged.count == union.count == len(a_vals) + len(b_vals)
+    assert merged.sum == pytest.approx(union.sum)
+    assert merged.max == union.max
+    for q in (0.1, 0.5, 0.9, 0.99):
+        # Bucket-wise addition: the merged sketch IS the union sketch.
+        assert merged.quantile(q) == union.quantile(q)
+
+
+def test_sketch_merge_alpha_mismatch_rejected():
+    a = obs_agg.QuantileSketch(alpha=0.01)
+    b = obs_agg.QuantileSketch(alpha=0.05)
+    with pytest.raises(ValueError):
+        a.merge(b)
+
+
+def test_sketch_roundtrip_dict():
+    sk = obs_agg.QuantileSketch(alpha=0.02)
+    for v in (0.0, 0.001, 0.5, 2.0, 2.0, 9.0):
+        sk.observe(v)
+    back = obs_agg.QuantileSketch.from_dict(sk.to_dict())
+    assert back.count == sk.count
+    assert back.zero == sk.zero
+    for q in (0.25, 0.5, 0.99):
+        assert back.quantile(q) == sk.quantile(q)
+
+
+def test_sketch_empty_and_zero_heavy():
+    sk = obs_agg.QuantileSketch()
+    assert sk.quantile(0.5) == 0.0
+    for _ in range(99):
+        sk.observe(0.0)
+    sk.observe(1.0)
+    assert sk.quantile(0.5) == 0.0
+    assert sk.quantile(1.0) > 0.9
+
+
+# ---------------------------------------------------------------------------
+# WindowAggregator
+
+
+def test_window_rollups_1s_and_1m():
+    clock = FakeClock()
+    win = obs_agg.WindowAggregator(clock=clock)
+    for _ in range(5):
+        win.observe("semmerge", 0.010, phases={"kernel": 0.008})
+    win.observe("semdiff", 0.050, error=True, phases={"kernel": 0.04})
+    clock.advance(1.0)  # the just-filled slot becomes the closed 1s one
+    out = win.window()
+    for key in ("1s", "1m"):
+        assert out[key]["count"] == 6
+        assert out[key]["errors"] == 1
+        assert out[key]["error_rate"] == pytest.approx(1 / 6, abs=1e-4)
+        assert out[key]["verbs"] == {"semmerge": 5, "semdiff": 1}
+        assert out[key]["phases_ms"]["kernel"] > 0
+    assert out["1s"]["span_s"] == 1.0
+    assert out["1m"]["span_s"] == 60.0
+    assert out["1s"]["qps"] == pytest.approx(6.0)
+    assert out["1m"]["qps"] == pytest.approx(6.0 / 60.0)
+    assert out["1m"]["p99_ms"] >= out["1m"]["p50_ms"] > 0
+
+
+def test_window_old_slots_age_out():
+    clock = FakeClock()
+    win = obs_agg.WindowAggregator(clock=clock)
+    win.observe("semmerge", 0.010)
+    clock.advance(120.0)
+    win.observe("semmerge", 0.020)
+    clock.advance(1.0)
+    out = win.window()
+    # The 2-minute-old request is outside both rollup windows.
+    assert out["1m"]["count"] == 1
+    assert out["1s"]["count"] == 1
+
+
+def test_window_publish_gauges():
+    clock = FakeClock()
+    win = obs_agg.WindowAggregator(clock=clock)
+    win.observe("semmerge", 0.010)
+    clock.advance(1.0)
+    win.publish(obs_metrics.REGISTRY)
+    dump = obs_metrics.REGISTRY.to_dict()
+    qps = dump["gauges"]["semmerge_window_qps"]["series"]
+    labels = {tuple(sorted(s["labels"].items())) for s in qps}
+    assert (("window", "1s"),) in labels
+    assert (("window", "1m"),) in labels
+    for name in ("semmerge_window_p50_ms", "semmerge_window_p99_ms",
+                 "semmerge_window_error_rate"):
+        assert name in dump["gauges"]
+
+
+def test_window_sketch_for_merges_slots():
+    clock = FakeClock()
+    win = obs_agg.WindowAggregator(clock=clock)
+    for i in range(30):
+        win.observe("semmerge", 0.010 + i * 0.001)
+        clock.advance(1.0)
+    sk = win.sketch_for("1m")
+    assert sk.count == 30
+    assert sk.quantile(0.5) == pytest.approx(0.0245, rel=0.2)

@@ -266,6 +266,13 @@ def test_daemon_status_reports_residency():
     d._exec_ewma = 0.0
     d._idem = {}
     d._projected_wait = lambda: 0.0
+    from semantic_merge_tpu.obs import agg as obs_agg
+    from semantic_merge_tpu.obs import anomaly as obs_anomaly
+    from semantic_merge_tpu.obs import sampling as obs_sampling
+    d._window = obs_agg.WindowAggregator()
+    d._sampler = obs_sampling.SamplingPolicy()
+    d._anomaly = obs_anomaly.AnomalyTriage()
+    d._trace_store = None
     status = d.status()
     res = status["residency"]
     assert set(res) >= {"enabled", "entries", "bytes", "budget_bytes",

@@ -1,0 +1,558 @@
+"""Production telemetry pipeline: fleet-consistent tail sampling
+(obs/sampling.py), bounded artifact stores, anomaly triage
+(obs/anomaly.py), metrics cardinality budget, the hardened trace CLI
+surfaces, and the new schema validators."""
+import concurrent.futures
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from semantic_merge_tpu.obs import anomaly as obs_anomaly
+from semantic_merge_tpu.obs import metrics as obs_metrics
+from semantic_merge_tpu.obs import sampling as obs_sampling
+
+_SCRIPT = (pathlib.Path(__file__).resolve().parent.parent
+           / "scripts" / "check_trace_schema.py")
+
+
+@pytest.fixture(scope="module")
+def schema():
+    spec = importlib.util.spec_from_file_location("check_trace_schema",
+                                                  _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    obs_metrics.REGISTRY.reset()
+    yield
+    obs_metrics.REGISTRY.reset()
+
+
+def _cli(*args, cwd=None, env=None):
+    import os
+    full_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                    PYTHONPATH=str(_SCRIPT.parent.parent))
+    if env:
+        full_env.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "semantic_merge_tpu", *args],
+        capture_output=True, text=True, cwd=cwd, env=full_env,
+        timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic head sampling & Decision semantics
+
+
+def test_head_keep_deterministic_across_processes():
+    # Pure hash of the id: every process/host agrees with no state.
+    assert obs_sampling.head_keep("trace-x", 1) is True
+    for tid in ("a", "b", "deadbeef", "trace-123"):
+        first = obs_sampling.head_keep(tid, 10)
+        assert all(obs_sampling.head_keep(tid, 10) == first
+                   for _ in range(20))
+    kept = sum(obs_sampling.head_keep(f"t{i}", 10) for i in range(5000))
+    assert 350 < kept < 650  # ~1 in 10
+
+
+def test_head_keep_concurrent_consistency():
+    tids = [f"trace-{i}" for i in range(200)]
+    expected = {t: obs_sampling.head_keep(t, 7) for t in tids}
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+        futs = {t: [pool.submit(obs_sampling.head_keep, t, 7)
+                    for _ in range(4)] for t in tids}
+        for t, fs in futs.items():
+            assert all(f.result() == expected[t] for f in fs)
+
+
+def test_decision_upgrade_keep_wins_drop_never_downgrades():
+    keep = obs_sampling.Decision(True, "error", minted_by="member")
+    drop = obs_sampling.Decision(False, obs_sampling.DROP_REASON,
+                                 minted_by="router")
+    # Router may upgrade a member drop to keep...
+    late_keep = obs_sampling.Decision(True, "slow", minted_by="router")
+    up = drop.upgrade(late_keep)
+    assert up.keep and up.reason == "slow"
+    # ...but never downgrade a member keep.
+    down = keep.upgrade(drop)
+    assert down.keep and down.reason == "error"
+    # Earliest minted keep's reason sticks.
+    assert keep.upgrade(late_keep).reason == "error"
+    assert keep.upgrade(None) is keep
+
+
+def test_decision_meta_roundtrip():
+    d = obs_sampling.Decision(True, "head", minted_by="m0", sample_n=8)
+    back = obs_sampling.Decision.from_meta(d.to_meta())
+    assert (back.keep, back.reason, back.minted_by, back.sample_n) == \
+        (True, "head", "m0", 8)
+    assert obs_sampling.Decision.from_meta(None) is None
+    assert obs_sampling.Decision.from_meta({"nope": 1}) is None
+
+
+# ---------------------------------------------------------------------------
+# SamplingPolicy
+
+
+def test_policy_disabled_by_default_keeps_everything(monkeypatch):
+    monkeypatch.delenv(obs_sampling.ENV_SAMPLE, raising=False)
+    monkeypatch.delenv(obs_sampling.ENV_BUDGET_MB, raising=False)
+    policy = obs_sampling.SamplingPolicy()
+    assert not policy.enabled
+    for i in range(50):
+        d = policy.decide(f"t{i}", "semmerge", 0.01,
+                          error=False, degraded=False,
+                          breaker=False, resolver=False)
+        assert d.keep and d.reason == "always"
+
+
+def test_policy_outcome_keeps_beat_head_drop(monkeypatch):
+    monkeypatch.setenv(obs_sampling.ENV_SAMPLE, "1000000")
+    policy = obs_sampling.SamplingPolicy()
+    assert policy.enabled
+    cases = [({"error": True}, "error"), ({"degraded": True}, "degraded"),
+             ({"breaker": True}, "breaker"), ({"resolver": True},
+                                              "resolver")]
+    for flags, reason in cases:
+        full = dict(error=False, degraded=False, breaker=False,
+                    resolver=False)
+        full.update(flags)
+        d = policy.decide("tid-any", "semmerge", 0.001, **full)
+        assert d.keep and d.reason == reason
+    # No outcome flag, astronomically sparse head sample: dropped.
+    drops = [policy.decide(f"x{i}", "semmerge", 0.001, error=False,
+                           degraded=False, breaker=False,
+                           resolver=False) for i in range(50)]
+    assert any(not d.keep for d in drops)
+    assert all(d.reason == obs_sampling.DROP_REASON
+               for d in drops if not d.keep)
+
+
+def test_policy_slow_keep_via_rolling_p99(monkeypatch):
+    monkeypatch.setenv(obs_sampling.ENV_SAMPLE, "1000000")
+    policy = obs_sampling.SamplingPolicy()
+    # Warm the per-verb window past MIN_SLOW_SAMPLES with fast merges.
+    for i in range(obs_sampling.MIN_SLOW_SAMPLES + 10):
+        policy.decide(f"warm{i}", "semmerge", 0.010, error=False,
+                      degraded=False, breaker=False, resolver=False)
+    d = policy.decide("tail", "semmerge", 0.500, error=False,
+                      degraded=False, breaker=False, resolver=False)
+    assert d.keep and d.reason == "slow"
+    stats = policy.stats()
+    assert stats["enabled"] and stats["decisions"]["slow"] >= 1
+    assert stats["p99_ms"]["semmerge"] > 0
+
+
+def test_policy_decisions_counted(monkeypatch):
+    monkeypatch.setenv(obs_sampling.ENV_SAMPLE, "1000000")
+    policy = obs_sampling.SamplingPolicy()
+    policy.decide("t", "semmerge", 0.01, error=True, degraded=False,
+                  breaker=False, resolver=False)
+    dump = obs_metrics.REGISTRY.to_dict()
+    series = dump["counters"]["trace_sampling_decisions_total"]["series"]
+    assert any(s["labels"] == {"decision": "keep", "reason": "error"}
+               for s in series)
+
+
+# ---------------------------------------------------------------------------
+# TraceStore retention
+
+
+def _write_traces(store, n, errored=()):
+    for i in range(n):
+        tid = f"trace-{i:04d}"
+        reason = "error" if i in errored else "head"
+        store.write(tid, {"schema": 1, "kind": "trace", "trace_id": tid,
+                          "spans": [{"name": "pad", "seconds": 0.001,
+                                     "meta": {"blob": "x" * 2000}}]},
+                    decision=obs_sampling.Decision(
+                        True, reason, minted_by="test"))
+
+
+def test_store_stays_under_byte_budget(tmp_path):
+    store = obs_sampling.TraceStore(tmp_path / "traces",
+                                    budget_mb=0.02)  # ~20 KiB
+    _write_traces(store, 40)
+    assert store.total_bytes() <= store.budget_bytes
+    assert 0 < store.count() < 40
+
+
+def test_store_protects_errored_traces(tmp_path):
+    store = obs_sampling.TraceStore(tmp_path / "traces", budget_mb=0.02)
+    errored = {5, 17, 31}
+    _write_traces(store, 40, errored=errored)
+    kept = {p.stem for p in (tmp_path / "traces").glob("*.json")}
+    for i in errored:
+        assert f"trace-{i:04d}" in kept  # 100% errored retention
+    assert store.total_bytes() <= store.budget_bytes
+
+
+def test_store_count_cap_evicts_oldest_first(tmp_path):
+    store = obs_sampling.TraceStore(tmp_path / "traces", max_count=5)
+    _write_traces(store, 12)
+    kept = sorted(p.stem for p in (tmp_path / "traces").glob("*.json"))
+    assert len(kept) == 5
+    assert kept == [f"trace-{i:04d}" for i in range(7, 12)]
+
+
+def test_prune_dir_two_pass_protection(tmp_path):
+    d = tmp_path / "pm"
+    d.mkdir()
+    for i in range(6):
+        (d / f"b{i}.json").write_text(json.dumps({"i": i}))
+    protected = {str(d / "b1.json"), str(d / "b4.json")}
+    removed = obs_sampling.prune_dir(
+        d, max_count=3, max_bytes=None,
+        protect=lambda p: str(p) in protected)
+    left = {p.name for p in d.glob("*.json")}
+    assert removed == 3
+    assert {"b1.json", "b4.json"} <= left and len(left) == 3
+
+
+# ---------------------------------------------------------------------------
+# Anomaly triage
+
+
+def _drive(triage, n, phases, start=0):
+    out = []
+    for i in range(start, start + n):
+        out += triage.observe(f"t{i}", "semmerge", dict(phases),
+                              seconds=sum(phases.values()))
+    return out
+
+
+def test_anomaly_fires_exactly_once_per_sustained_breach(tmp_path,
+                                                         monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv(obs_anomaly.ENV_ENABLE, "1")
+    triage = obs_anomaly.AnomalyTriage(z_threshold=4.0, min_n=8,
+                                       sustain=2)
+    base = {"parse": 0.010, "kernel": 0.020, "emit": 0.005}
+    slow = {"parse": 0.010, "kernel": 0.200, "emit": 0.005}
+    assert _drive(triage, 40, base) == []
+    bundles = _drive(triage, 6, slow, start=100)
+    assert len(bundles) == 1  # latched after the first fire
+    assert triage.stats()["fired"] == 1
+    # Recovery: sustained in-band observations unlatch...
+    assert _drive(triage, 20, base, start=200) == []
+    assert triage.stats()["latched"] == []
+    # ...and a second sustained excursion fires exactly once more.
+    assert len(_drive(triage, 6, slow, start=300)) == 1
+    assert triage.stats()["fired"] == 2
+
+
+def test_anomaly_bundle_names_injected_phase(tmp_path, monkeypatch,
+                                             schema):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv(obs_anomaly.ENV_ENABLE, "1")
+    triage = obs_anomaly.AnomalyTriage(z_threshold=4.0, min_n=8,
+                                       sustain=2)
+    base = {"parse": 0.010, "kernel": 0.020, "emit": 0.005}
+    _drive(triage, 40, base)
+    bundles = _drive(
+        triage, 6, {"parse": 0.010, "kernel": 0.300, "emit": 0.005},
+        start=50)
+    assert bundles and bundles[0]["bundle"]
+    data = json.loads(pathlib.Path(bundles[0]["bundle"]).read_text())
+    assert data["reason"] == "anomaly"
+    assert data["triage"]["suspect_phase"] == "kernel"
+    assert data["triage"]["baseline"] is not None
+    assert schema.validate_triage(data) == []
+
+
+def test_anomaly_disable_via_env(monkeypatch):
+    monkeypatch.setenv(obs_anomaly.ENV_ENABLE, "off")
+    triage = obs_anomaly.AnomalyTriage()
+    assert not triage.enabled
+    assert triage.observe("t", "semmerge", {"kernel": 99.0},
+                          seconds=99.0) == []
+    assert triage.stats()["fired"] == 0
+
+
+def test_ewma_detector_breach_not_absorbed():
+    det = obs_anomaly.EwmaDetector(z_threshold=4.0, min_n=8, sustain=2)
+    for _ in range(30):
+        assert det.observe(0.020) in ("warmup", "ok")
+    z_before = det.zscore(0.200)
+    assert det.observe(0.200) == "breach"
+    # The breaching sample must not drag the baseline toward itself.
+    assert det.zscore(0.200) == pytest.approx(z_before)
+    assert det.observe(0.200) == "fire"
+    assert det.observe(0.200) == "latched"
+
+
+def test_phase_diff_shared_shape():
+    diff = obs_anomaly.phase_diff({"a": 0.010, "b": 0.100},
+                                  {"a": 0.010, "b": 0.020})
+    assert diff["suspect_phase"] == "b"
+    assert diff["phases"][0]["phase"] == "b"
+    assert diff["phases"][0]["delta_ms"] == pytest.approx(80.0)
+    assert diff["phases"][0]["ratio"] == pytest.approx(5.0)
+    flat = obs_anomaly.phase_diff({"a": 0.01}, {"a": 0.02})
+    assert flat["suspect_phase"] is None
+
+
+# ---------------------------------------------------------------------------
+# Metrics cardinality budget
+
+
+def test_cardinality_budget_overflow_series(monkeypatch):
+    monkeypatch.setenv(obs_metrics.ENV_MAX_SERIES, "3")
+    c = obs_metrics.REGISTRY.counter("card_probe_total")
+    for i in range(10):
+        c.inc(1, key=f"k{i}")
+    dump = obs_metrics.REGISTRY.to_dict()
+    series = dump["counters"]["card_probe_total"]["series"]
+    assert len(series) <= 4  # 3 admitted + the overflow bucket
+    overflow = [s for s in series if s["labels"] == {"overflow": "true"}]
+    assert overflow and overflow[0]["value"] == 7.0
+    dropped = dump["counters"][obs_metrics.SERIES_DROPPED]["series"]
+    assert dropped[0]["labels"] == {"metric": "card_probe_total"}
+    assert dropped[0]["value"] == 7.0
+
+
+def test_cardinality_budget_existing_keys_keep_counting(monkeypatch):
+    monkeypatch.setenv(obs_metrics.ENV_MAX_SERIES, "2")
+    c = obs_metrics.REGISTRY.counter("card_probe2_total")
+    c.inc(1, k="a")
+    c.inc(1, k="b")
+    c.inc(1, k="c")  # over budget -> overflow
+    c.inc(5, k="a")  # established series unaffected by the budget
+    dump = obs_metrics.REGISTRY.to_dict()
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in dump["counters"]["card_probe2_total"]["series"]}
+    assert series[(("k", "a"),)] == 6.0
+    assert series[(("overflow", "true"),)] == 1.0
+
+
+def test_cardinality_budget_disabled_with_zero(monkeypatch):
+    monkeypatch.setenv(obs_metrics.ENV_MAX_SERIES, "0")
+    c = obs_metrics.REGISTRY.counter("card_probe3_total")
+    for i in range(600):
+        c.inc(1, key=f"k{i}")
+    dump = obs_metrics.REGISTRY.to_dict()
+    assert len(dump["counters"]["card_probe3_total"]["series"]) == 600
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+
+
+def test_trace_diff_cli(tmp_path):
+    a = {"schema": 1, "trace_id": "A", "spans": [
+        {"name": "kernel", "seconds": 0.100},
+        {"name": "parse", "seconds": 0.010}]}
+    b = {"schema": 1, "trace_id": "B", "spans": [
+        {"name": "kernel", "seconds": 0.020},
+        {"name": "parse", "seconds": 0.010}]}
+    (tmp_path / "a.json").write_text(json.dumps(a))
+    (tmp_path / "b.json").write_text(json.dumps(b))
+    res = _cli("trace", "diff", "a.json", "b.json", "--json",
+               cwd=tmp_path)
+    assert res.returncode == 0, res.stderr
+    out = json.loads(res.stdout)
+    assert out["suspect_phase"] == "kernel"
+    assert out["phases"][0]["phase"] == "kernel"
+    human = _cli("trace", "diff", "a.json", "b.json", cwd=tmp_path)
+    assert human.returncode == 0
+    assert "suspect phase: kernel" in human.stdout
+
+
+def test_trace_diff_cli_rejects_garbage(tmp_path):
+    (tmp_path / "a.json").write_text("{not json")
+    (tmp_path / "b.json").write_text(json.dumps({"spans": []}))
+    res = _cli("trace", "diff", "a.json", "b.json", cwd=tmp_path)
+    assert res.returncode == 1
+    assert "not a span-shaped trace artifact" in res.stderr
+
+
+def test_trace_analyze_survives_corrupt_artifacts(tmp_path):
+    good = {"schema": 1, "trace_id": "ok", "spans": [
+        {"name": "kernel", "seconds": 0.010, "status": "ok",
+         "depth": 0, "meta": {}}]}
+    (tmp_path / "good.json").write_text(json.dumps(good))
+    (tmp_path / "trunc.json").write_text('{"schema": 1, "spans": [')
+    (tmp_path / "mixed.jsonl").write_text(
+        json.dumps({"name": "emit", "seconds": 0.001, "status": "ok",
+                    "depth": 0, "meta": {}}) + "\n"
+        + "{corrupt line\n")
+    res = _cli("trace", "analyze", str(tmp_path), "--json")
+    assert res.returncode == 0, res.stderr
+    out = json.loads(res.stdout)
+    assert out["requests"] == 2
+    assert out["skipped"] >= 1
+    assert out["corrupt_lines"] >= 1
+    assert "skipped" in res.stderr and "corrupt" in res.stderr
+
+
+def test_trace_analyze_since_filter(tmp_path):
+    import os
+    art = {"schema": 1, "trace_id": "old", "spans": [
+        {"name": "kernel", "seconds": 0.010, "status": "ok",
+         "depth": 0, "meta": {}}]}
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(art))
+    os.utime(old, (1000, 1000))  # 1970: far outside any window
+    new = tmp_path / "new.json"
+    new.write_text(json.dumps(dict(art, trace_id="new")))
+    res = _cli("trace", "analyze", str(tmp_path), "--since", "1h",
+               "--json")
+    assert res.returncode == 0, res.stderr
+    out = json.loads(res.stdout)
+    assert out["requests"] == 1
+    assert out["results"][0]["trace_id"] == "new"
+    bad = _cli("trace", "analyze", str(tmp_path), "--since", "-3s")
+    assert bad.returncode != 0
+
+
+def test_top_once_unreachable_daemon(tmp_path):
+    res = _cli("top", "--once", "--socket",
+               str(tmp_path / "nope.sock"))
+    assert res.returncode == 1
+    assert "error:" in res.stderr
+
+
+def test_top_render_frame_shapes():
+    from semantic_merge_tpu.cli import _render_top_frame
+    status = {"pid": 1, "uptime_s": 5.0, "socket": "/tmp/x.sock",
+              "queue_depth": 2, "in_flight": 1, "served_total": 9,
+              "window": {"1s": {"qps": 3.0, "p50_ms": 4.0,
+                                "p99_ms": 9.0, "error_rate": 0.0},
+                         "1m": {"qps": 0.5, "p50_ms": 4.5,
+                                "p99_ms": 11.0, "error_rate": 0.1}},
+              "resilience": {"pressure": 0,
+                             "breakers": {"kernel": "open",
+                                          "host": "closed"}},
+              "residency": {"lookups": 10, "hit_rate": 0.8},
+              "sampling": {"enabled": True},
+              "trace_store": {"count": 3, "bytes": 1 << 20,
+                              "budget_bytes": 16 << 20},
+              "anomaly": {"latched": ["kernel"], "fired": 2},
+              "slo": {"healthy": False}}
+    frame = _render_top_frame({"status": status, "members": None})
+    assert "merge daemon pid 1" in frame
+    assert "OPEN:kernel" in frame
+    assert "residency hit 80.0%" in frame
+    assert "ANOMALY latched: kernel" in frame
+    assert "BURNING" in frame
+    # Fleet shape: member table from the member_status blocks.
+    fleet = {"fleet": True, "pid": 2, "uptime_s": 1.0,
+             "socket": "tcp://0:1", "in_flight": 0, "served_total": 4,
+             "window": {}, "members": [{"id": "m0", "state": "up"}]}
+    members = {"m0": {"window": {"1m": {"qps": 1.5, "p99_ms": 7.0}},
+                      "queue_depth": 1, "in_flight": 0,
+                      "served_total": 4}}
+    fframe = _render_top_frame({"status": fleet, "members": members})
+    assert "fleet router" in fframe
+    assert "m0" in fframe and "up" in fframe
+
+
+# ---------------------------------------------------------------------------
+# Schema validators (wired into tier-1 like the rest of the family)
+
+
+def test_validate_sampling_real_policy_stats(schema, monkeypatch):
+    monkeypatch.setenv(obs_sampling.ENV_SAMPLE, "4")
+    policy = obs_sampling.SamplingPolicy()
+    for i in range(20):
+        policy.decide(f"t{i}", "semmerge", 0.01, error=(i == 3),
+                      degraded=False, breaker=False, resolver=False)
+    payload = {"sampling": policy.stats(),
+               "metrics": obs_metrics.REGISTRY.to_dict()}
+    assert schema.validate_sampling(payload) == []
+
+
+def test_validate_sampling_rejects_drift(schema):
+    kept = {"sampling": {"keep": True, "reason": "mystery",
+                         "minted_by": "daemon", "sample_n": 4}}
+    assert any("mystery" in e for e in schema.validate_sampling(kept))
+    dropped = {"sampling": {"keep": False, "reason": "sampled-out",
+                            "minted_by": "daemon", "sample_n": 4}}
+    assert any("keep=true" in e
+               for e in schema.validate_sampling(dropped))
+    over = {"trace_store": {"count": 1, "bytes": 999,
+                            "budget_bytes": 100}}
+    assert any("over budget" in e for e in schema.validate_sampling(over))
+
+
+def test_validate_sampling_real_kept_artifact(schema, tmp_path):
+    store = obs_sampling.TraceStore(tmp_path / "traces")
+    d = obs_sampling.Decision(True, "slow", minted_by="daemon",
+                              sample_n=10)
+    path = store.write("t1", {"schema": 1, "kind": "trace",
+                              "trace_id": "t1", "spans": []},
+                       decision=d)
+    data = json.loads(pathlib.Path(path).read_text())
+    assert schema.validate_sampling(data) == []
+
+
+def test_validate_window_real_aggregator(schema):
+    from semantic_merge_tpu.obs import agg as obs_agg
+    win = obs_agg.WindowAggregator()
+    win.observe("semmerge", 0.012, phases={"kernel": 0.01})
+    win.publish(obs_metrics.REGISTRY)
+    payload = {"window": win.window(),
+               "metrics": obs_metrics.REGISTRY.to_dict()}
+    assert schema.validate_window(payload) == []
+
+
+def test_validate_window_rejects_drift(schema):
+    wb = {"span_s": 1.0, "count": 2, "errors": 3, "qps": 2.0,
+          "error_rate": 1.0, "p50_ms": 1.0, "p99_ms": 2.0,
+          "max_ms": 2.0, "phases_ms": {}, "verbs": {}}
+    bad = {"window": {"1s": wb, "1m": dict(wb, span_s=60.0)}}
+    assert any("errors > count" in e for e in schema.validate_window(bad))
+    unknown = {"window": {"1s": dict(wb, errors=0),
+                          "1m": dict(wb, errors=0, span_s=60.0),
+                          "5m": dict(wb, errors=0)}}
+    assert any("unknown rollup" in e
+               for e in schema.validate_window(unknown))
+
+
+def test_validate_triage_rejects_drift(schema, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv(obs_anomaly.ENV_ENABLE, "1")
+    triage = obs_anomaly.AnomalyTriage(z_threshold=4.0, min_n=8,
+                                       sustain=2)
+    base = {"kernel": 0.020, "emit": 0.005}
+    _drive(triage, 40, base)
+    bundles = _drive(triage, 6, {"kernel": 0.300, "emit": 0.005},
+                     start=50)
+    data = json.loads(pathlib.Path(bundles[0]["bundle"]).read_text())
+    assert schema.validate_triage(data) == []
+    unsorted_diff = json.loads(json.dumps(data))
+    unsorted_diff["triage"]["diff"].reverse()
+    assert any("not sorted" in e
+               for e in schema.validate_triage(unsorted_diff))
+    wrong_suspect = json.loads(json.dumps(data))
+    wrong_suspect["triage"]["suspect_phase"] = "emit"
+    assert any("top positive-delta" in e
+               for e in schema.validate_triage(wrong_suspect))
+    noreason = json.loads(json.dumps(data))
+    noreason["reason"] = "fault-escape"
+    assert any("!= 'anomaly'" in e
+               for e in schema.validate_triage(noreason))
+
+
+def test_validator_cli_subcommands(tmp_path, schema):
+    store = obs_sampling.TraceStore(tmp_path / "traces")
+    path = store.write("t1", {"schema": 1, "trace_id": "t1",
+                              "spans": []},
+                       decision=obs_sampling.Decision(
+                           True, "error", minted_by="daemon"))
+    res = subprocess.run(
+        [sys.executable, str(_SCRIPT), "validate_sampling", str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    assert "ok" in res.stdout
+    res2 = subprocess.run(
+        [sys.executable, str(_SCRIPT), "validate_window"],
+        capture_output=True, text=True, timeout=60)
+    assert res2.returncode == 2  # usage
